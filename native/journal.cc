@@ -1,0 +1,197 @@
+// Native append-only event journal for sharetrade_tpu.
+//
+// The reference's persistence layer is native too: LevelDB (C++) behind
+// leveldbjni backing the Akka Persistence journal (reference build.sbt:18-19,
+// application.conf:7-17). This is the TPU-framework equivalent: a minimal
+// crash-safe framed log shared byte-for-byte with the pure-Python backend
+// (sharetrade_tpu/data/journal.py):
+//
+//   record := [u32 length LE][u32 crc32 LE][payload bytes]
+//
+// Exposed as a C ABI consumed via ctypes (sharetrade_tpu/data/native.py) —
+// the environment has no pybind11, and ctypes keeps the binding dependency-free.
+//
+// Also exports stj_parse_csv: a fast "price, date" CSV parser used by the
+// ingestion path for bulk loads (reference SharePriceGetter.scala:89-101).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#if !defined(_WIN32)
+#include <unistd.h>
+#endif
+
+namespace {
+
+// CRC32 (IEEE 802.3, zlib-compatible) — table-driven, built on first use.
+uint32_t crc_table[256];
+bool crc_ready = false;
+
+void crc_init() {
+  if (crc_ready) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  crc_ready = true;
+}
+
+uint32_t crc32_of(const uint8_t* buf, size_t len) {
+  crc_init();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++) c = crc_table[(c ^ buf[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Journal {
+  FILE* fh;
+  bool fsync_each;
+};
+
+void put_u32(uint8_t* dst, uint32_t v) {
+  dst[0] = v & 0xFF; dst[1] = (v >> 8) & 0xFF;
+  dst[2] = (v >> 16) & 0xFF; dst[3] = (v >> 24) & 0xFF;
+}
+
+uint32_t get_u32(const uint8_t* src) {
+  return (uint32_t)src[0] | ((uint32_t)src[1] << 8) |
+         ((uint32_t)src[2] << 16) | ((uint32_t)src[3] << 24);
+}
+
+// Scan a journal file; return the byte offset of the end of the last intact
+// record, collecting payloads if out != nullptr (newline-delimited).
+long scan_file(const char* path, std::string* out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return 0;
+  long offset = 0;
+  uint8_t header[8];
+  std::vector<uint8_t> payload;
+  for (;;) {
+    if (fread(header, 1, 8, f) != 8) break;
+    uint32_t length = get_u32(header);
+    uint32_t crc = get_u32(header + 4);
+    payload.resize(length);
+    if (length > 0 && fread(payload.data(), 1, length, f) != length) break;
+    if (crc32_of(payload.data(), length) != crc) break;
+    if (out) {
+      out->append(reinterpret_cast<const char*>(payload.data()), length);
+      out->push_back('\n');
+    }
+    offset += 8 + (long)length;
+  }
+  fclose(f);
+  return offset;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open (create if absent) a journal for appending. Truncates any torn tail so
+// appends continue from a clean record boundary — the same recovery contract
+// as the Python backend. Returns an opaque handle, or nullptr on failure.
+void* stj_open(const char* path, int fsync_each) {
+  long valid = 0;
+  FILE* probe = fopen(path, "rb");
+  if (probe) {
+    fclose(probe);
+    valid = scan_file(path, nullptr);
+    // truncate torn tail (ignore failure; appends would still be readable
+    // up to the corruption point)
+    FILE* rw = fopen(path, "rb+");
+    if (rw) {
+#if defined(_WIN32)
+      fclose(rw);
+#else
+      if (ftruncate(fileno(rw), valid) != 0) { /* keep going */ }
+      fclose(rw);
+#endif
+    }
+  }
+  FILE* fh = fopen(path, "ab");
+  if (!fh) return nullptr;
+  Journal* j = new Journal{fh, fsync_each != 0};
+  return j;
+}
+
+// Append one payload. Returns 0 on success.
+int stj_append(void* handle, const char* payload, uint32_t length) {
+  Journal* j = static_cast<Journal*>(handle);
+  if (!j || !j->fh) return 1;
+  uint8_t header[8];
+  put_u32(header, length);
+  put_u32(header + 4, crc32_of(reinterpret_cast<const uint8_t*>(payload), length));
+  if (fwrite(header, 1, 8, j->fh) != 8) return 2;
+  if (length > 0 && fwrite(payload, 1, length, j->fh) != length) return 3;
+  if (fflush(j->fh) != 0) return 4;
+#if !defined(_WIN32)
+  if (j->fsync_each && fsync(fileno(j->fh)) != 0) return 5;
+#endif
+  return 0;
+}
+
+void stj_close(void* handle) {
+  Journal* j = static_cast<Journal*>(handle);
+  if (!j) return;
+  if (j->fh) fclose(j->fh);
+  delete j;
+}
+
+// Read every intact record's payload, newline-delimited, into a malloc'd
+// buffer (caller frees with stj_free). *out_len receives the byte count.
+// Returns nullptr when the file is missing/empty.
+void* stj_read_all(const char* path, uint64_t* out_len) {
+  std::string out;
+  scan_file(path, &out);
+  if (out.empty()) { if (out_len) *out_len = 0; return nullptr; }
+  void* buf = malloc(out.size());
+  if (!buf) { if (out_len) *out_len = 0; return nullptr; }
+  memcpy(buf, out.data(), out.size());
+  if (out_len) *out_len = out.size();
+  return buf;
+}
+
+void stj_free(void* buf) { free(buf); }
+
+// Fast "price, date" CSV parse. Emits intact rows as newline-delimited
+// "date\tprice" pairs in a malloc'd buffer (caller frees). Malformed rows are
+// dropped, mirroring the lenient Python parser.
+void* stj_parse_csv(const char* path, uint64_t* out_len) {
+  FILE* f = fopen(path, "rb");
+  if (!f) { if (out_len) *out_len = 0; return nullptr; }
+  std::string out;
+  char line[512];
+  while (fgets(line, sizeof line, f)) {
+    const char* comma = strchr(line, ',');
+    if (!comma) continue;
+    // price: leading token before the comma
+    char* endp = nullptr;
+    double price = strtod(line, &endp);
+    if (endp == line) continue;
+    while (endp < comma && (*endp == ' ' || *endp == '\t')) endp++;
+    if (endp != comma) continue;
+    // date: YYYY-MM-DD after the comma
+    const char* d = comma + 1;
+    while (*d == ' ' || *d == '\t') d++;
+    int y, m, day;
+    if (sscanf(d, "%4d-%2d-%2d", &y, &m, &day) != 3) continue;
+    if (m < 1 || m > 12 || day < 1 || day > 31) continue;
+    char row[64];
+    int n = snprintf(row, sizeof row, "%04d-%02d-%02d\t%.9g\n", y, m, day, price);
+    if (n > 0) out.append(row, (size_t)n);
+  }
+  fclose(f);
+  if (out.empty()) { if (out_len) *out_len = 0; return nullptr; }
+  void* buf = malloc(out.size());
+  if (!buf) { if (out_len) *out_len = 0; return nullptr; }
+  memcpy(buf, out.data(), out.size());
+  if (out_len) *out_len = out.size();
+  return buf;
+}
+
+}  // extern "C"

@@ -1,0 +1,28 @@
+"""sharetrade_tpu — a TPU-native RL framework for share-trading agents.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+``cosmir17/Scala-akka-tensorflow-sharetrade-helper`` (an Akka/TensorFlow-Scala
+parameter-server RL trader; see /root/reference and SURVEY.md):
+
+- ``data``       event-sourced market-data ingestion + durable journal
+                 (reference: SharePriceGetter.scala — PersistentActor + LevelDB)
+- ``env``        pure-JAX windowed trading environment, vmap/scan-friendly
+                 (reference: TrainerChildActor.scala:82-146 — the fold loop)
+- ``models``     policy networks: MLP Q-net, LSTM, Transformer (Pallas attention)
+                 (reference: QDecisionPolicyActor.scala:38-50 — the TF graph)
+- ``agents``     learners: Q-learning, REINFORCE, DQN, A2C, PPO
+                 (reference: QDecisionPolicyActor.scala:54-77 — epsilon-greedy + TD)
+- ``train``      fused jit training loops: select + env-step + TD + optimizer
+                 update in one compiled program (replacing ~230k Session.run calls
+                 serialized through one actor mailbox, SURVEY.md §3.3)
+- ``parallel``   device meshes, shard_map collectives, sharding rules
+                 (replacing the Akka broadcast Router + mailbox parameter server)
+- ``runtime``    lifecycle FSM, orchestrator, supervision/backoff, metrics
+                 (reference: TrainerRouterActor.scala — Router + BackoffSupervisor)
+- ``checkpoint`` real model/optimizer/RNG/cursor checkpointing
+                 (reference intent: QDecisionPolicyActor.scala:74,91-93 — empty stub)
+"""
+
+__version__ = "0.1.0"
+
+from sharetrade_tpu.config import FrameworkConfig  # noqa: F401

@@ -1,0 +1,199 @@
+"""Configuration system.
+
+The reference hard-codes every ML hyperparameter as Scala constants and keeps
+infrastructure config in HOCON (`application.conf`); there are no CLI flags
+(SURVEY.md §5 "Config / flag system"; reference QDecisionPolicyActor.scala:17-22,
+ShareTradeHelper.scala:20-21, TrainerRouterActor.scala:36). This module replaces
+both with one typed, file-loadable, CLI-overridable config tree.
+
+Design: plain nested dataclasses; ``from_file`` reads JSON; ``apply_overrides``
+accepts ``section.key=value`` strings (the CLI flag surface). No external deps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field, fields, is_dataclass
+from typing import Any
+
+
+@dataclass
+class DataConfig:
+    """L1 market-data layer (reference: SharePriceGetter.scala)."""
+
+    csv_path: str | None = None        # price CSV ("price, date" rows); None -> synthetic
+    synthetic_length: int = 6046       # matches the MSFT fixture's line count
+    synthetic_seed: int = 1992
+    journal_dir: str = "journal"       # event journal root (reference: LevelDB dir)
+    use_native_journal: bool = True    # prefer the C++ journal if built
+
+
+@dataclass
+class EnvConfig:
+    """L3 trading environment (reference: TrainerChildActor.scala:82-146)."""
+
+    window: int = 201                  # price history per observation
+    initial_budget: float = 2400.0     # reference ShareTradeHelper.scala:20
+    initial_shares: int = 0            # reference ShareTradeHelper.scala:21
+
+
+@dataclass
+class ModelConfig:
+    """Policy network (reference: QDecisionPolicyActor.scala:38-50)."""
+
+    kind: str = "mlp"                  # mlp | lstm | transformer
+    hidden_dim: int = 200              # reference h1Dim
+    num_actions: int = 3               # Buy / Sell / Hold
+    # transformer-only:
+    num_layers: int = 2
+    num_heads: int = 4
+    head_dim: int = 64
+    seq_block: int = 128               # pallas attention block size
+    dtype: str = "float32"             # compute dtype ("bfloat16" on TPU for speed)
+
+
+@dataclass
+class LearnerConfig:
+    """Q-learning hyperparameters (reference: QDecisionPolicyActor.scala:17-22)."""
+
+    algo: str = "qlearn"               # qlearn | pg | dqn | a2c | ppo
+    epsilon: float = 0.9
+    epsilon_ramp_steps: int = 1000     # exploit prob = min(epsilon, step/ramp)
+    gamma: float = 0.001
+    learning_rate: float = 0.01
+    optimizer: str = "adagrad"
+    # Fidelity switch: the reference updates the Q-value at the *next* state's
+    # argmax index (a bug; its rl.py ancestor uses the taken action). True =
+    # correct semantics (update taken action); False = bug-parity mode for tests.
+    update_taken_action: bool = True
+    # DQN/replay:
+    replay_capacity: int = 65536
+    replay_batch: int = 256
+    target_update_every: int = 500
+    # PPO/A2C:
+    entropy_coef: float = 0.01
+    value_coef: float = 0.5
+    clip_eps: float = 0.2
+    gae_lambda: float = 0.95
+    ppo_epochs: int = 4
+    ppo_minibatches: int = 4
+    unroll_len: int = 128
+
+
+@dataclass
+class ParallelConfig:
+    """Device-mesh layout (replaces the Akka Router/mailbox fan-out, SURVEY §2.2)."""
+
+    num_workers: int = 10              # reference noOfChildren (TrainerRouterActor.scala:36)
+    data_axis: str = "dp"
+    model_axis: str = "tp"
+    seq_axis: str = "sp"
+    pipeline_axis: str = "pp"
+    expert_axis: str = "ep"
+    mesh_shape: dict[str, int] = field(default_factory=dict)  # {} -> all devices on dp
+
+
+@dataclass
+class RuntimeConfig:
+    """Orchestration / fault tolerance (reference: TrainerRouterActor.scala:46-58)."""
+
+    chunk_steps: int = 200             # device steps per host visit (progress cadence;
+                                       # reference logs every 200 fold steps)
+    checkpoint_every_updates: int = 500  # reference cadence (stubbed there, real here)
+    checkpoint_dir: str = "checkpoints"
+    keep_checkpoints: int = 3
+    backoff_initial_s: float = 3.0     # reference Backoff.onFailure 3s
+    backoff_max_s: float = 60.0        # reference max 1 min
+    backoff_jitter: float = 0.2        # reference randomFactor
+    max_restarts: int = 10
+    poll_interval_s: float = 0.05
+    profile_dir: str | None = None     # jax.profiler trace output
+
+
+@dataclass
+class FrameworkConfig:
+    data: DataConfig = field(default_factory=DataConfig)
+    env: EnvConfig = field(default_factory=EnvConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    learner: LearnerConfig = field(default_factory=LearnerConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+    seed: int = 0
+
+    # ---- serialization ----
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "FrameworkConfig":
+        return _dataclass_from_dict(cls, d)
+
+    @classmethod
+    def from_file(cls, path: str) -> "FrameworkConfig":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+
+    # ---- CLI overrides ----
+
+    def apply_overrides(self, overrides: list[str]) -> "FrameworkConfig":
+        """Apply ``section.key=value`` strings, returning a new config.
+
+        Values are parsed as JSON when possible, else kept as strings, so
+        ``learner.gamma=0.99``, ``model.kind=lstm`` and
+        ``parallel.mesh_shape={"dp":4,"tp":2}`` all work.
+        """
+        cfg = FrameworkConfig.from_dict(self.to_dict())
+        for item in overrides:
+            if "=" not in item:
+                raise ValueError(f"override must look like section.key=value, got {item!r}")
+            dotted, raw = item.split("=", 1)
+            try:
+                value = json.loads(raw)
+            except json.JSONDecodeError:
+                value = raw
+            target: Any = cfg
+            *path, leaf = dotted.split(".")
+            for part in path:
+                if not hasattr(target, part):
+                    raise KeyError(f"unknown config section {part!r} in {dotted!r}")
+                target = getattr(target, part)
+            if not hasattr(target, leaf):
+                raise KeyError(f"unknown config key {leaf!r} in {dotted!r}")
+            setattr(target, leaf, value)
+        return cfg
+
+
+def _dataclass_from_dict(cls: type, d: dict[str, Any]) -> Any:
+    known = {f.name for f in fields(cls)}
+    unknown = set(d) - known
+    if unknown:
+        # Typos in a config file must fail loudly, matching the CLI-override path.
+        raise KeyError(f"unknown config key(s) {sorted(unknown)} for {cls.__name__}")
+    kwargs: dict[str, Any] = {}
+    for f in fields(cls):
+        if f.name not in d:
+            continue
+        v = d[f.name]
+        if is_dataclass(f.type) if isinstance(f.type, type) else False:
+            kwargs[f.name] = _dataclass_from_dict(f.type, v)
+        elif isinstance(v, dict) and f.name in _NESTED:
+            kwargs[f.name] = _dataclass_from_dict(_NESTED[f.name], v)
+        else:
+            kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+_NESTED = {
+    "data": DataConfig,
+    "env": EnvConfig,
+    "model": ModelConfig,
+    "learner": LearnerConfig,
+    "parallel": ParallelConfig,
+    "runtime": RuntimeConfig,
+}

@@ -1,0 +1,4 @@
+from sharetrade_tpu.data.ingest import PriceSeries, load_price_csv, parse_price_lines  # noqa: F401
+from sharetrade_tpu.data.journal import Journal  # noqa: F401
+from sharetrade_tpu.data.service import PriceDataService, StockDataResponse  # noqa: F401
+from sharetrade_tpu.data.synthetic import synthetic_price_series  # noqa: F401

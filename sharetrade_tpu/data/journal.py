@@ -1,0 +1,118 @@
+"""Append-only event journal with replay — the event-sourcing substrate.
+
+Reference: Akka Persistence over a LevelDB JNI journal (SharePriceGetter.scala
+persist/receiveRecover, application.conf:7-17, build.sbt:18-19). Here the
+journal is a framed binary log: each record is
+
+    [u32 length][u32 crc32][payload bytes]
+
+with JSON payloads. CRC framing makes torn tail writes detectable: replay stops
+cleanly at the first corrupt/partial record (an interrupted process loses at
+most its unflushed tail, never the prefix), which is the recovery contract the
+LevelDB journal gave the reference.
+
+Two interchangeable backends:
+- pure-Python (this module) — always available;
+- native C++ writer/reader (``native/journal.cc`` via ctypes,
+  ``sharetrade_tpu.data.native``) — same on-disk format, used when built, for
+  the host-IO throughput the DQN replay path needs (SURVEY.md §7.4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import zlib
+from typing import Any, Iterator
+
+from sharetrade_tpu.utils.logging import get_logger
+
+log = get_logger("data.journal")
+
+_HEADER = struct.Struct("<II")  # length, crc32
+
+
+class Journal:
+    """Durable append-only event log with replay.
+
+    API mirrors the event-sourcing triple the reference uses: ``append``
+    (persist), ``replay`` (receiveRecover), and truncation-on-corruption
+    recovery semantics.
+    """
+
+    def __init__(self, path: str, *, fsync: bool = False):
+        self.path = path
+        self._fsync = fsync
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        valid = self._scan_valid_prefix()
+        # Truncate any torn tail so appends continue from a clean boundary.
+        if valid is not None:
+            with open(self.path, "r+b") as f:
+                f.truncate(valid)
+        self._fh = open(self.path, "ab")
+
+    # ---- write path ----
+
+    def append(self, event: dict[str, Any]) -> None:
+        payload = json.dumps(event, separators=(",", ":")).encode()
+        record = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        with self._lock:
+            self._fh.write(record)
+            self._fh.flush()
+            if self._fsync:
+                os.fsync(self._fh.fileno())
+
+    # ---- read path ----
+
+    def replay(self) -> Iterator[dict[str, Any]]:
+        """Yield all intact events from the start of the log."""
+        if not os.path.exists(self.path):
+            return
+        with self._lock:
+            self._fh.flush()
+        with open(self.path, "rb") as f:
+            while True:
+                header = f.read(_HEADER.size)
+                if len(header) < _HEADER.size:
+                    break
+                length, crc = _HEADER.unpack(header)
+                payload = f.read(length)
+                if len(payload) < length or zlib.crc32(payload) != crc:
+                    log.warning("journal %s: stopping replay at corrupt record", self.path)
+                    break
+                yield json.loads(payload)
+
+    def _scan_valid_prefix(self) -> int | None:
+        """Byte offset of the last intact record boundary, or None if the file
+        doesn't exist / is fully intact."""
+        if not os.path.exists(self.path):
+            return None
+        offset = 0
+        with open(self.path, "rb") as f:
+            while True:
+                header = f.read(_HEADER.size)
+                if len(header) < _HEADER.size:
+                    break
+                length, crc = _HEADER.unpack(header)
+                payload = f.read(length)
+                if len(payload) < length or zlib.crc32(payload) != crc:
+                    log.warning("journal %s: torn tail at offset %d, truncating", self.path, offset)
+                    return offset
+                offset += _HEADER.size + length
+        return None
+
+    def __len__(self) -> int:
+        return sum(1 for _ in self.replay())
+
+    def close(self) -> None:
+        with self._lock:
+            self._fh.close()
+
+    def __enter__(self) -> "Journal":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

@@ -1,0 +1,105 @@
+"""ctypes binding to the native C++ journal (``native/journal.cc``).
+
+The reference's journal is native too — LevelDB (C++) behind leveldbjni
+(build.sbt:18-19). Here the native backend shares the on-disk format of the
+pure-Python :class:`~sharetrade_tpu.data.journal.Journal` ([u32 len][u32 crc]
+[json] records), so the two are interchangeable; the C++ path exists for
+host-IO throughput on the replay/streaming side (SURVEY.md §7.4).
+
+Build with ``make -C native`` (produces ``native/libstjournal.so``).
+Falls back cleanly when the library isn't built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import threading
+from typing import Any, Iterator
+
+_LIB_PATHS = [
+    os.path.join(os.path.dirname(__file__), "..", "..", "native", "libstjournal.so"),
+    os.path.join(os.path.dirname(__file__), "_native", "libstjournal.so"),
+]
+
+_lib: ctypes.CDLL | None = None
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib
+    if _lib is not None:
+        return _lib
+    for p in _LIB_PATHS:
+        p = os.path.abspath(p)
+        if os.path.exists(p):
+            lib = ctypes.CDLL(p)
+            lib.stj_open.restype = ctypes.c_void_p
+            lib.stj_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            lib.stj_append.restype = ctypes.c_int
+            lib.stj_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+            lib.stj_close.argtypes = [ctypes.c_void_p]
+            lib.stj_read_all.restype = ctypes.c_void_p
+            lib.stj_read_all.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
+            lib.stj_free.argtypes = [ctypes.c_void_p]
+            lib.stj_parse_csv.restype = ctypes.c_void_p
+            lib.stj_parse_csv.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
+            _lib = lib
+            return lib
+    return None
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativeJournal:
+    """Same contract as :class:`sharetrade_tpu.data.journal.Journal`, C++ IO."""
+
+    def __init__(self, path: str, *, fsync: bool = False):
+        lib = _load()
+        if lib is None:
+            raise ImportError("native journal library not built (make -C native)")
+        self.path = path
+        self._lib = lib
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._handle = lib.stj_open(path.encode(), 1 if fsync else 0)
+        if not self._handle:
+            raise OSError(f"stj_open failed for {path}")
+
+    def append(self, event: dict[str, Any]) -> None:
+        payload = json.dumps(event, separators=(",", ":")).encode()
+        with self._lock:
+            rc = self._lib.stj_append(self._handle, payload, len(payload))
+        if rc != 0:
+            raise OSError(f"stj_append failed rc={rc}")
+
+    def replay(self) -> Iterator[dict[str, Any]]:
+        n = ctypes.c_uint64(0)
+        buf = self._lib.stj_read_all(self.path.encode(), ctypes.byref(n))
+        if not buf:
+            return
+        try:
+            raw = ctypes.string_at(buf, n.value)
+        finally:
+            self._lib.stj_free(buf)
+        # stj_read_all returns newline-delimited JSON payloads of intact records
+        for line in raw.splitlines():
+            if line:
+                yield json.loads(line)
+
+    def __len__(self) -> int:
+        return sum(1 for _ in self.replay())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._handle:
+                self._lib.stj_close(self._handle)
+                self._handle = None
+
+    def __enter__(self) -> "NativeJournal":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

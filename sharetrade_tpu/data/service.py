@@ -1,0 +1,148 @@
+"""Event-sourced price data service (the L1 layer).
+
+Reference: ``SharePriceGetter`` — a PersistentActor that serves
+``RequestStockPrice(stock, from, to)`` with a date-sorted price map, caches
+results in memory, persists fetch events to a LevelDB journal, and rebuilds the
+cache by replaying events on restart (SharePriceGetter.scala:21-73).
+
+Here the same contract is a plain object:
+
+- ``request(symbol, start, end)`` -> ``StockDataResponse`` with the range
+  actually filtered (the reference's *intended* behavior per its spec;
+  its implementation ignores the range — SURVEY.md §4, SharePriceGetterSpec).
+- Fetches go through a pluggable ``provider`` (CSV file / synthetic generator
+  standing in for an HTTP market-data API, as the reference "fakes a http
+  query", SharePriceGetter.scala:83).
+- Every fetch is appended to the journal; construction replays the journal
+  into the in-memory cache (event-sourcing recovery).
+- Cache merges keep old values on date collisions (reference
+  ``updateStockMapIfTheresChange`` semantics).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from datetime import date
+from typing import Callable, Protocol
+
+from sharetrade_tpu.config import DataConfig
+from sharetrade_tpu.data.ingest import PriceSeries, load_price_csv
+from sharetrade_tpu.data.journal import Journal
+from sharetrade_tpu.data.synthetic import synthetic_price_series
+from sharetrade_tpu.utils.logging import get_logger
+
+log = get_logger("data.service")
+
+
+@dataclass(frozen=True)
+class StockDataResponse:
+    """Reply shape of the reference's protocol
+    (SharePriceGetter.scala:15: StockDataResponse(stockName, TreeMap))."""
+
+    symbol: str
+    series: PriceSeries
+
+
+class PriceProvider(Protocol):
+    def __call__(self, symbol: str, start: date | str | None, end: date | str | None) -> PriceSeries: ...
+
+
+def csv_provider(path: str) -> Callable[..., PriceSeries]:
+    def fetch(symbol: str, start=None, end=None) -> PriceSeries:
+        return load_price_csv(path, symbol=symbol)
+    return fetch
+
+
+def synthetic_provider(length: int = 6046, seed: int = 1992) -> Callable[..., PriceSeries]:
+    def fetch(symbol: str, start=None, end=None) -> PriceSeries:
+        return synthetic_price_series(symbol=symbol, length=length, seed=seed)
+    return fetch
+
+
+class PriceDataService:
+    def __init__(
+        self,
+        journal: Journal | None = None,
+        provider: PriceProvider | None = None,
+        config: DataConfig | None = None,
+    ):
+        cfg = config or DataConfig()
+        if provider is None:
+            if cfg.csv_path:
+                provider = csv_provider(cfg.csv_path)
+            else:
+                provider = synthetic_provider(cfg.synthetic_length, cfg.synthetic_seed)
+        self._provider = provider
+        if journal is None:
+            journal = _open_journal(os.path.join(cfg.journal_dir, "price-events.journal"),
+                                    prefer_native=cfg.use_native_journal)
+        self._journal = journal
+        self._cache: dict[str, PriceSeries] = {}
+        self._recover()
+
+    # ---- public protocol (the RequestStockPrice equivalent) ----
+
+    def request(
+        self,
+        symbol: str,
+        start: date | str | None = None,
+        end: date | str | None = None,
+    ) -> StockDataResponse:
+        if symbol not in self._cache:
+            # Fetch the FULL history on a miss and filter only the reply:
+            # caching a range-limited fetch would poison later unranged
+            # requests (and the journal) with partial data.
+            fetched = self._provider(symbol, None, None)
+            self._persist(symbol, fetched)
+            self._merge(symbol, fetched)
+        else:
+            log.debug("cache hit for %s", symbol)
+        return StockDataResponse(symbol, self._cache[symbol].range(start, end))
+
+    def refresh(self, symbol: str) -> StockDataResponse:
+        """Force a new fetch and merge (old values win collisions)."""
+        fetched = self._provider(symbol, None, None)
+        self._persist(symbol, fetched)
+        self._merge(symbol, fetched)
+        return StockDataResponse(symbol, self._cache[symbol])
+
+    def cached_symbols(self) -> list[str]:
+        return sorted(self._cache)
+
+    def close(self) -> None:
+        self._journal.close()
+
+    # ---- event sourcing ----
+
+    def _persist(self, symbol: str, series: PriceSeries) -> None:
+        self._journal.append({"type": "prices_fetched", "symbol": symbol,
+                              "series": series.to_dict()})
+
+    def _merge(self, symbol: str, fetched: PriceSeries) -> None:
+        if symbol in self._cache:
+            self._cache[symbol] = self._cache[symbol].merge_keep_old(fetched)
+        else:
+            self._cache[symbol] = fetched
+
+    def _recover(self) -> None:
+        count = 0
+        for event in self._journal.replay():
+            if event.get("type") == "prices_fetched":
+                series = PriceSeries.from_dict(event["series"])
+                self._merge(event["symbol"], series)
+                count += 1
+        if count:
+            log.info("recovered %d fetch events for %s", count, self.cached_symbols())
+
+
+def _open_journal(path: str, *, prefer_native: bool = True) -> Journal:
+    """Open the event journal, preferring the C++ backend when built."""
+    if prefer_native:
+        try:
+            from sharetrade_tpu.data.native import NativeJournal, native_available
+            if native_available():
+                return NativeJournal(path)  # type: ignore[return-value]
+        except ImportError:
+            pass
+    return Journal(path)

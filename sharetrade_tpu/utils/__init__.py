@@ -1,0 +1,2 @@
+from sharetrade_tpu.utils.logging import get_logger  # noqa: F401
+from sharetrade_tpu.utils.metrics import MetricsRegistry  # noqa: F401

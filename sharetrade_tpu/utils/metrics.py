@@ -1,0 +1,75 @@
+"""Run metrics registry.
+
+The reference's only "metrics" are the final avg/std portfolio aggregations
+(TrainerRouterActor.scala:89-94,148-151). This registry generalizes that:
+thread-safe scalar series with snapshot reads, so the orchestrator can answer
+status queries mid-run without stopping the device loop (the reference answers
+GetAvg mid-run from trained workers, TrainerRouterActorSpec.scala:81-95).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import defaultdict
+from typing import Any
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._series: dict[str, list[tuple[float, float]]] = defaultdict(list)
+        self._latest: dict[str, float] = {}
+
+    def record(self, name: str, value: float, *, ts: float | None = None) -> None:
+        ts = time.time() if ts is None else ts
+        value = float(value)
+        with self._lock:
+            self._series[name].append((ts, value))
+            self._latest[name] = value
+
+    def record_many(self, values: dict[str, float]) -> None:
+        ts = time.time()
+        for name, value in values.items():
+            self.record(name, value, ts=ts)
+
+    def latest(self, name: str, default: float | None = None) -> float | None:
+        with self._lock:
+            return self._latest.get(name, default)
+
+    def series(self, name: str) -> list[tuple[float, float]]:
+        with self._lock:
+            return list(self._series.get(name, ()))
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._latest)
+
+    def summary(self, name: str) -> dict[str, float]:
+        """Mean/std/min/max/count over a series — the avg/std aggregation the
+        reference computes over worker portfolios, generalized."""
+        values = [v for _, v in self.series(name)]
+        if not values:
+            return {"count": 0.0}
+        n = len(values)
+        mean = sum(values) / n
+        var = sum((v - mean) ** 2 for v in values) / n
+        return {
+            "count": float(n),
+            "mean": mean,
+            "std": math.sqrt(var),
+            "min": min(values),
+            "max": max(values),
+        }
+
+
+def mean_std(values: Any) -> tuple[float, float]:
+    """Population mean/std, matching the reference's aggregation
+    (TrainerRouterActor.scala:148-151: variance = E[(x-mean)^2], std = sqrt)."""
+    vals = [float(v) for v in values]
+    if not vals:
+        raise ValueError("mean_std of empty sequence")
+    m = sum(vals) / len(vals)
+    var = sum((v - m) ** 2 for v in vals) / len(vals)
+    return m, math.sqrt(var)

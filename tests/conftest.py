@@ -1,0 +1,26 @@
+"""Test harness configuration.
+
+Tests run on a *virtual 8-device CPU mesh* — the TPU analogue of the
+reference's multi-actor-in-one-JVM TestKit strategy (SURVEY.md §4: no real
+cluster; probes at boundaries + fake devices). Real-TPU behavior is exercised
+by bench.py and the driver's graft entry, not by the unit suite.
+
+Env vars must be set before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_journal_path(tmp_path):
+    return str(tmp_path / "events.journal")

@@ -1,0 +1,56 @@
+from sharetrade_tpu.config import FrameworkConfig
+
+
+def test_defaults_match_reference_constants():
+    # Reference hyperparameters: QDecisionPolicyActor.scala:17-22,
+    # ShareTradeHelper.scala:20-21, TrainerRouterActor.scala:36.
+    cfg = FrameworkConfig()
+    assert cfg.env.window == 201
+    assert cfg.env.initial_budget == 2400.0
+    assert cfg.model.hidden_dim == 200
+    assert cfg.model.num_actions == 3
+    assert cfg.learner.epsilon == 0.9
+    assert cfg.learner.gamma == 0.001
+    assert cfg.learner.learning_rate == 0.01
+    assert cfg.parallel.num_workers == 10
+
+
+def test_roundtrip_dict():
+    cfg = FrameworkConfig()
+    cfg2 = FrameworkConfig.from_dict(cfg.to_dict())
+    assert cfg2.to_dict() == cfg.to_dict()
+
+
+def test_roundtrip_file(tmp_path):
+    cfg = FrameworkConfig()
+    cfg.learner.gamma = 0.99
+    path = str(tmp_path / "cfg.json")
+    cfg.save(path)
+    loaded = FrameworkConfig.from_file(path)
+    assert loaded.learner.gamma == 0.99
+    assert loaded.to_dict() == cfg.to_dict()
+
+
+def test_overrides():
+    cfg = FrameworkConfig()
+    out = cfg.apply_overrides([
+        "learner.gamma=0.95",
+        "model.kind=lstm",
+        'parallel.mesh_shape={"dp": 4, "tp": 2}',
+        "data.csv_path=/tmp/x.csv",
+    ])
+    assert out.learner.gamma == 0.95
+    assert out.model.kind == "lstm"
+    assert out.parallel.mesh_shape == {"dp": 4, "tp": 2}
+    assert out.data.csv_path == "/tmp/x.csv"
+    # original untouched
+    assert cfg.learner.gamma == 0.001
+
+
+def test_override_unknown_key_raises():
+    cfg = FrameworkConfig()
+    import pytest
+    with pytest.raises(KeyError):
+        cfg.apply_overrides(["learner.nope=1"])
+    with pytest.raises(ValueError):
+        cfg.apply_overrides(["learner.gamma"])
