@@ -10,9 +10,6 @@
 //
 // Exposed as a C ABI consumed via ctypes (sharetrade_tpu/data/native.py) —
 // the environment has no pybind11, and ctypes keeps the binding dependency-free.
-//
-// Also exports stj_parse_csv: a fast "price, date" CSV parser used by the
-// ingestion path for bulk loads (reference SharePriceGetter.scala:89-101).
 
 #include <cstdint>
 #include <cstdio>
@@ -68,6 +65,9 @@ uint32_t get_u32(const uint8_t* src) {
 long scan_file(const char* path, std::string* out) {
   FILE* f = fopen(path, "rb");
   if (!f) return 0;
+  if (fseek(f, 0, SEEK_END) != 0) { fclose(f); return 0; }
+  long file_size = ftell(f);
+  if (file_size < 0 || fseek(f, 0, SEEK_SET) != 0) { fclose(f); return 0; }
   long offset = 0;
   uint8_t header[8];
   std::vector<uint8_t> payload;
@@ -75,6 +75,10 @@ long scan_file(const char* path, std::string* out) {
     if (fread(header, 1, 8, f) != 8) break;
     uint32_t length = get_u32(header);
     uint32_t crc = get_u32(header + 4);
+    // A length that overruns the file is a torn/corrupt header, not a real
+    // record — stop before resize() so garbage bytes can't trigger a
+    // std::bad_alloc that would escape the C ABI and abort the process.
+    if ((long)length > file_size - offset - 8) break;
     payload.resize(length);
     if (length > 0 && fread(payload.data(), 1, length, f) != length) break;
     if (crc32_of(payload.data(), length) != crc) break;
@@ -157,41 +161,5 @@ void* stj_read_all(const char* path, uint64_t* out_len) {
 }
 
 void stj_free(void* buf) { free(buf); }
-
-// Fast "price, date" CSV parse. Emits intact rows as newline-delimited
-// "date\tprice" pairs in a malloc'd buffer (caller frees). Malformed rows are
-// dropped, mirroring the lenient Python parser.
-void* stj_parse_csv(const char* path, uint64_t* out_len) {
-  FILE* f = fopen(path, "rb");
-  if (!f) { if (out_len) *out_len = 0; return nullptr; }
-  std::string out;
-  char line[512];
-  while (fgets(line, sizeof line, f)) {
-    const char* comma = strchr(line, ',');
-    if (!comma) continue;
-    // price: leading token before the comma
-    char* endp = nullptr;
-    double price = strtod(line, &endp);
-    if (endp == line) continue;
-    while (endp < comma && (*endp == ' ' || *endp == '\t')) endp++;
-    if (endp != comma) continue;
-    // date: YYYY-MM-DD after the comma
-    const char* d = comma + 1;
-    while (*d == ' ' || *d == '\t') d++;
-    int y, m, day;
-    if (sscanf(d, "%4d-%2d-%2d", &y, &m, &day) != 3) continue;
-    if (m < 1 || m > 12 || day < 1 || day > 31) continue;
-    char row[64];
-    int n = snprintf(row, sizeof row, "%04d-%02d-%02d\t%.9g\n", y, m, day, price);
-    if (n > 0) out.append(row, (size_t)n);
-  }
-  fclose(f);
-  if (out.empty()) { if (out_len) *out_len = 0; return nullptr; }
-  void* buf = malloc(out.size());
-  if (!buf) { if (out_len) *out_len = 0; return nullptr; }
-  memcpy(buf, out.data(), out.size());
-  if (out_len) *out_len = out.size();
-  return buf;
-}
 
 }  // extern "C"

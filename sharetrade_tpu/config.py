@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from dataclasses import dataclass, field, fields, is_dataclass
+from dataclasses import dataclass, field, fields
 from typing import Any
 
 
@@ -180,9 +180,9 @@ def _dataclass_from_dict(cls: type, d: dict[str, Any]) -> Any:
         if f.name not in d:
             continue
         v = d[f.name]
-        if is_dataclass(f.type) if isinstance(f.type, type) else False:
-            kwargs[f.name] = _dataclass_from_dict(f.type, v)
-        elif isinstance(v, dict) and f.name in _NESTED:
+        # Field annotations are strings under `from __future__ import
+        # annotations`, so nested sections resolve through _NESTED by name.
+        if isinstance(v, dict) and f.name in _NESTED:
             kwargs[f.name] = _dataclass_from_dict(_NESTED[f.name], v)
         else:
             kwargs[f.name] = v

@@ -42,8 +42,6 @@ def _load() -> ctypes.CDLL | None:
             lib.stj_read_all.restype = ctypes.c_void_p
             lib.stj_read_all.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
             lib.stj_free.argtypes = [ctypes.c_void_p]
-            lib.stj_parse_csv.restype = ctypes.c_void_p
-            lib.stj_parse_csv.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
             _lib = lib
             return lib
     return None

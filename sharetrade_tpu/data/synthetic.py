@@ -4,7 +4,7 @@ The reference ships a 6,046-line MSFT daily-close CSV as its market-data
 fixture (src/main/resources/MSFT-stock-prices-revised.txt, SURVEY.md §2.1 #7).
 That file is not copied here; when no CSV is configured, a seeded geometric
 random walk of the same length/scale stands in, so episode shape (and therefore
-benchmark comparability: 6,046 prices -> 5,844 scan steps) is preserved.
+benchmark comparability: 6,046 prices -> 5,845 scan steps) is preserved.
 """
 
 from __future__ import annotations

@@ -1,0 +1,21 @@
+"""L3: the trading environment, as pure JAX functions.
+
+Reference: the episode fold in ``TrainerChildActor.scala:82-146``. Here the
+fold body becomes a pure ``step`` usable under ``vmap`` (agent batches) and
+``lax.scan`` (the time axis) inside one compiled program.
+"""
+
+from sharetrade_tpu.env.trading import (  # noqa: F401
+    BUY,
+    HOLD,
+    NUM_ACTIONS,
+    SELL,
+    EnvParams,
+    EnvState,
+    env_from_prices,
+    num_steps,
+    observe,
+    portfolio_value,
+    reset,
+    step,
+)

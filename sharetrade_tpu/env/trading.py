@@ -1,0 +1,142 @@
+"""Windowed share-trading environment as pure JAX functions.
+
+Reference semantics (TrainerChildActor.scala:82-146):
+
+- Observation at step ``i``: the 201-price sliding window ``prices[i .. i+200]``
+  concatenated with ``(budget, shares)`` — 203 floats (``:90``).
+- The trade executes at ``prices[i + 201]``, the price just *after* the window
+  (``newShareValue``, ``:94``).
+- Buy: feasible iff ``budget >= price`` → budget −= price, shares += 1.
+  Sell: feasible iff ``shares > 0`` → budget += price, shares −= 1.
+  Infeasible actions degrade to Hold (``makeDecisionAccordingToAction``,
+  ``:118-123``).
+- Reward = new portfolio − current portfolio, where portfolio = budget +
+  shares × share_value and share_value is the *previous* step's trade price
+  (seeded 0.0, so the first portfolio equals the initial budget;
+  ``:84-92,136-146``).
+- Episode length = len(prices) − 201 steps (``:67``); final portfolio =
+  budget + shares × last trade price (``:68``).
+
+Fidelity note: the reference's fold reads the **constructor** budget/shares in
+``makeDecisionAccordingToAction`` instead of the folded running values
+(SURVEY.md §2.1 "quirks") — every step trades against the initial state. This
+implementation threads the running values, the behavior the fold was written
+to produce.
+
+Everything here is shape-static and branch-free (``jnp.where`` over
+``lax.cond``) so a whole episode compiles into one fused ``lax.scan`` and a
+batch of divergent agents into one ``vmap`` — no per-step host round-trips
+(the reference pays 2 actor hops + ≤4 JNI crossings per step, SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+BUY, SELL, HOLD = 0, 1, 2  # reference action order: actions = Seq(Buy, Sell, Hold)
+NUM_ACTIONS = 3
+
+
+@struct.dataclass
+class EnvParams:
+    """Static episode data: the full price series plus initial conditions.
+
+    ``window`` is static metadata (``pytree_node=False``) because it fixes
+    observation shape; ``prices`` is a device array shared by every agent in a
+    batch (the Akka broadcast of ``Train(stockData)`` becomes replication,
+    TrainerRouterActor.scala:66,88).
+    """
+
+    prices: jax.Array                                     # (T,) float32
+    initial_budget: jax.Array                             # scalar f32
+    initial_shares: jax.Array                             # scalar f32
+    window: int = struct.field(pytree_node=False, default=201)
+
+
+@struct.dataclass
+class EnvState:
+    """Per-agent mutable state threaded through the scan (the fold carry)."""
+
+    t: jax.Array            # i32 step cursor (the fold index i)
+    budget: jax.Array       # f32
+    shares: jax.Array       # f32 (integer-valued; float for uniform arithmetic)
+    share_value: jax.Array  # f32 last trade price (0.0 before the first trade)
+
+
+def env_from_prices(
+    prices, window: int = 201, initial_budget: float = 2400.0, initial_shares: int = 0
+) -> EnvParams:
+    prices = jnp.asarray(prices, dtype=jnp.float32)
+    if prices.ndim != 1:
+        raise ValueError(f"prices must be 1-D, got shape {prices.shape}")
+    if prices.shape[0] <= window + 1:
+        # Reference guard: "Stock price count should be more than Tensorflow
+        # input nodes" (TrainerChildActor.scala:69-70).
+        raise ValueError(
+            f"price count ({prices.shape[0]}) must exceed window + 1 ({window + 1})"
+        )
+    return EnvParams(
+        prices=prices,
+        initial_budget=jnp.float32(initial_budget),
+        initial_shares=jnp.float32(initial_shares),
+        window=window,
+    )
+
+
+def num_steps(params: EnvParams) -> int:
+    """Steps per episode: len(prices) − window (TrainerChildActor.scala:67)."""
+    return int(params.prices.shape[0]) - params.window
+
+
+def reset(params: EnvParams) -> EnvState:
+    zero = jnp.float32(0.0)
+    return EnvState(
+        t=jnp.int32(0),
+        budget=jnp.asarray(params.initial_budget, jnp.float32),
+        shares=jnp.asarray(params.initial_shares, jnp.float32),
+        share_value=zero,
+    )
+
+
+def observe(params: EnvParams, state: EnvState) -> jax.Array:
+    """Observation: ``prices[t : t+window] ++ (budget, shares)`` — shape (window+2,)."""
+    window_slice = jax.lax.dynamic_slice(params.prices, (state.t,), (params.window,))
+    return jnp.concatenate(
+        [window_slice, jnp.stack([state.budget, state.shares])]
+    )
+
+
+def portfolio_value(state: EnvState) -> jax.Array:
+    """budget + shares × last trade price (TrainerChildActor.scala:68,92)."""
+    return state.budget + state.shares * state.share_value
+
+
+def step(params: EnvParams, state: EnvState, action: jax.Array):
+    """Apply one action; returns ``(new_state, reward)``.
+
+    Branch-free Buy/Sell/Hold with feasibility masking, so it vectorizes
+    cleanly under ``vmap`` and stays a single fused XLA computation under
+    ``lax.scan``.
+    """
+    trade_price = params.prices[state.t + params.window]
+
+    can_buy = (action == BUY) & (state.budget >= trade_price)
+    can_sell = (action == SELL) & (state.shares > 0)
+
+    delta = jnp.where(can_buy, 1.0, jnp.where(can_sell, -1.0, 0.0)).astype(jnp.float32)
+    new_budget = state.budget - delta * trade_price
+    new_shares = state.shares + delta
+
+    current_portfolio = portfolio_value(state)
+    new_portfolio = new_budget + new_shares * trade_price
+    reward = new_portfolio - current_portfolio
+
+    new_state = EnvState(
+        t=state.t + 1,
+        budget=new_budget,
+        shares=new_shares,
+        share_value=trade_price,
+    )
+    return new_state, reward

@@ -1,0 +1,187 @@
+"""Environment semantics tests.
+
+Mirrors the behavioral contract of the reference fold
+(TrainerChildActor.scala:82-146) with the running-state fix, plus the
+vmap/scan properties the TPU design depends on (SURVEY.md §7.4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sharetrade_tpu.env import (
+    BUY,
+    HOLD,
+    SELL,
+    env_from_prices,
+    num_steps,
+    observe,
+    portfolio_value,
+    reset,
+    step,
+)
+
+WINDOW = 4  # tiny window for hand-checkable episodes
+
+
+def make_params(n=10, budget=100.0, shares=0):
+    prices = jnp.arange(1.0, n + 1.0)  # 1, 2, ..., n
+    return env_from_prices(prices, window=WINDOW, initial_budget=budget,
+                           initial_shares=shares)
+
+
+class TestConstruction:
+    def test_rejects_short_series(self):
+        # Reference guard: price count must exceed input nodes
+        # (TrainerChildActor.scala:69-70).
+        with pytest.raises(ValueError, match="must exceed"):
+            env_from_prices(jnp.ones(WINDOW + 1), window=WINDOW)
+
+    def test_num_steps(self):
+        assert num_steps(make_params(n=10)) == 6  # len - window
+
+    def test_episode_length_matches_reference_fixture_shape(self):
+        # 6,046 prices with the real 201 window -> 5,845 fold steps
+        # (SharePriceGetter fixture, TrainerChildActor.scala:67).
+        p = env_from_prices(jnp.ones(6046) * 50.0, window=201)
+        assert num_steps(p) == 5845
+
+
+class TestObservation:
+    def test_shape_and_contents(self):
+        params = make_params()
+        s = reset(params)
+        obs = observe(params, s)
+        assert obs.shape == (WINDOW + 2,)
+        np.testing.assert_allclose(obs[:WINDOW], [1, 2, 3, 4])
+        np.testing.assert_allclose(obs[WINDOW:], [100.0, 0.0])
+
+    def test_window_advances_with_cursor(self):
+        params = make_params()
+        s = reset(params)
+        s, _ = step(params, s, jnp.int32(HOLD))
+        obs = observe(params, s)
+        np.testing.assert_allclose(obs[:WINDOW], [2, 3, 4, 5])
+
+
+class TestStepSemantics:
+    def test_buy_at_post_window_price(self):
+        params = make_params()
+        s = reset(params)
+        # Trade price at t=0 is prices[window] = 5.
+        s, reward = step(params, s, jnp.int32(BUY))
+        assert float(s.budget) == 95.0
+        assert float(s.shares) == 1.0
+        assert float(s.share_value) == 5.0
+        # First portfolio = budget (share_value seeded 0); new = 95 + 1*5.
+        assert float(reward) == 0.0
+
+    def test_sell_requires_shares(self):
+        params = make_params()
+        s = reset(params)
+        s2, _ = step(params, s, jnp.int32(SELL))
+        # Infeasible sell degrades to Hold (TrainerChildActor.scala:122).
+        assert float(s2.budget) == 100.0
+        assert float(s2.shares) == 0.0
+
+    def test_buy_requires_budget(self):
+        params = make_params(budget=3.0)
+        s = reset(params)
+        s2, _ = step(params, s, jnp.int32(BUY))  # price 5 > budget 3
+        assert float(s2.budget) == 3.0
+        assert float(s2.shares) == 0.0
+
+    def test_buy_then_sell_round_trip(self):
+        params = make_params()
+        s = reset(params)
+        s, _ = step(params, s, jnp.int32(BUY))    # buy at 5
+        s, r = step(params, s, jnp.int32(SELL))   # sell at 6
+        assert float(s.budget) == 101.0
+        assert float(s.shares) == 0.0
+        # reward = (101 + 0*6) - (95 + 1*5) = 1
+        assert float(r) == 1.0
+
+    def test_hold_reward_marks_to_market(self):
+        params = make_params()
+        s = reset(params)
+        s, _ = step(params, s, jnp.int32(BUY))   # 1 share at 5
+        s, r = step(params, s, jnp.int32(HOLD))  # price moves to 6
+        # reward = (95 + 1*6) - (95 + 1*5) = 1: the held share appreciates.
+        assert float(r) == 1.0
+
+    def test_running_state_is_threaded(self):
+        # The fix for the reference's stale-constructor-state quirk
+        # (SURVEY.md §2.1): repeated Buys must drain the *running* budget.
+        params = make_params(budget=12.0)
+        s = reset(params)
+        s, _ = step(params, s, jnp.int32(BUY))  # price 5 -> budget 7
+        s, _ = step(params, s, jnp.int32(BUY))  # price 6 -> budget 1
+        s, _ = step(params, s, jnp.int32(BUY))  # price 7 > 1: degrades to Hold
+        assert float(s.budget) == 1.0
+        assert float(s.shares) == 2.0
+
+    def test_final_portfolio_identity(self):
+        params = make_params()
+        s = reset(params)
+        for a in [BUY, BUY, HOLD, SELL]:
+            s, _ = step(params, s, jnp.int32(a))
+        assert float(portfolio_value(s)) == float(s.budget) + float(s.shares) * float(
+            s.share_value
+        )
+
+
+class TestTransformFriendliness:
+    def test_full_episode_under_scan_and_jit(self):
+        params = make_params(n=20)
+        n = num_steps(params)
+
+        def body(state, action):
+            new_state, reward = step(params, state, action)
+            return new_state, reward
+
+        actions = jnp.zeros(n, dtype=jnp.int32)  # all Buy
+
+        @jax.jit
+        def run(actions):
+            return jax.lax.scan(body, reset(params), actions)
+
+        final, rewards = run(actions)
+        assert rewards.shape == (n,)
+        assert int(final.t) == n
+
+    def test_vmapped_agent_batch_diverges(self):
+        params = make_params()
+        batch = 8
+
+        def rollout(actions):
+            def body(state, a):
+                ns, r = step(params, state, a)
+                return ns, r
+            final, _ = jax.lax.scan(body, reset(params), actions)
+            return portfolio_value(final)
+
+        key = jax.random.PRNGKey(0)
+        actions = jax.random.randint(key, (batch, num_steps(params)), 0, 3)
+        portfolios = jax.jit(jax.vmap(rollout))(actions)
+        assert portfolios.shape == (batch,)
+        # Stochastic policies must actually diverge across the batch.
+        assert len(set(np.asarray(portfolios).tolist())) > 1
+
+    def test_reward_sum_telescopes_to_final_portfolio(self):
+        # Sum of portfolio-delta rewards telescopes: final portfolio =
+        # initial budget + sum(rewards). A strong whole-episode invariant.
+        params = make_params(n=30, budget=50.0)
+        key = jax.random.PRNGKey(7)
+        actions = jax.random.randint(key, (num_steps(params),), 0, 3)
+
+        def body(state, a):
+            ns, r = step(params, state, a)
+            return ns, r
+
+        final, rewards = jax.lax.scan(body, reset(params), actions)
+        np.testing.assert_allclose(
+            float(portfolio_value(final)),
+            50.0 + float(jnp.sum(rewards)),
+            rtol=1e-5,
+        )

@@ -51,17 +51,14 @@ def test_native_torn_tail_recovery(tmp_journal_path):
         assert [e["n"] for e in j.replay()] == [1, 3]
 
 
-def test_native_csv_parser(tmp_path):
-    import ctypes
-    from sharetrade_tpu.data.native import _load
-    csv = tmp_path / "p.csv"
-    csv.write_text("56.08, 1992-07-22\njunk\n57.1, 1992-07-23\n")
-    lib = _load()
-    n = ctypes.c_uint64(0)
-    buf = lib.stj_parse_csv(str(csv).encode(), ctypes.byref(n))
-    assert buf
-    raw = ctypes.string_at(buf, n.value).decode()
-    lib.stj_free(buf)
-    rows = [r.split("\t") for r in raw.strip().split("\n")]
-    assert [r[0] for r in rows] == ["1992-07-22", "1992-07-23"]
-    assert float(rows[0][1]) == pytest.approx(56.08)
+def test_native_corrupt_length_header_is_torn_tail(tmp_journal_path):
+    # A garbage header whose length field claims ~4GB must be treated as a
+    # torn tail, not allocated (a bad_alloc would abort the whole process).
+    with _native(tmp_journal_path) as nj:
+        nj.append({"n": 1})
+    with open(tmp_journal_path, "ab") as f:
+        f.write(b"\xf0\xff\xff\xff" + b"\xde\xad\xbe\xef" + b"xx")
+    with _native(tmp_journal_path) as nj:
+        assert [e["n"] for e in nj.replay()] == [1]
+        nj.append({"n": 2})
+        assert [e["n"] for e in nj.replay()] == [1, 2]
