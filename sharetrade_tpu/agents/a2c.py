@@ -1,0 +1,85 @@
+"""Advantage Actor-Critic (n-step, synchronous) — BASELINE.json config 3.
+
+The "rollout workers → shared learner" shape of the reference (10 broadcast
+workers, one parameter server; SURVEY.md §2.2) is exactly A2C's synchronous
+geometry: B parallel env agents advance ``unroll_len`` steps, then one joint
+update from bootstrapped n-step returns. Policy + value + entropy losses.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from sharetrade_tpu.agents.base import (
+    Agent, TrainState, batched_carry, batched_reset, build_optimizer,
+    portfolio_metrics,
+)
+from sharetrade_tpu.agents.rollout import (
+    collect_rollout, discounted_returns, replay_forward,
+)
+from sharetrade_tpu.config import LearnerConfig
+from sharetrade_tpu.env import trading
+from sharetrade_tpu.models.core import Model
+
+
+def make_a2c_agent(model: Model, env_params: trading.EnvParams,
+                   cfg: LearnerConfig, *, num_agents: int = 10,
+                   steps_per_chunk: int | None = None) -> Agent:
+    optimizer = build_optimizer(cfg)
+    unroll = steps_per_chunk or cfg.unroll_len
+
+    def init(key: jax.Array) -> TrainState:
+        k_params, k_rng = jax.random.split(key)
+        params = model.init(k_params)
+        return TrainState(
+            params=params, opt_state=optimizer.init(params),
+            carry=batched_carry(model, num_agents),
+            env_state=batched_reset(env_params, num_agents),
+            rng=k_rng, env_steps=jnp.int32(0), updates=jnp.int32(0),
+        )
+
+    def step(ts: TrainState):
+        ts, traj, bootstrap, init_carry = collect_rollout(
+            model, env_params, ts, unroll, num_agents)
+        returns = discounted_returns(traj.reward, traj.active,
+                                     bootstrap, cfg.gamma)
+        weight = traj.active
+        denom = jnp.maximum(jnp.sum(weight), 1.0)
+
+        def loss_fn(params):
+            logits, values = replay_forward(model, params, traj, init_carry)
+            log_probs = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                log_probs, traj.action[..., None], axis=-1)[..., 0]
+            adv = jax.lax.stop_gradient(returns - values) * weight
+            policy_loss = -jnp.sum(logp * adv) / denom
+            value_loss = jnp.sum(jnp.square(values - returns) * weight) / denom
+            entropy = -jnp.sum(
+                jnp.sum(jnp.exp(log_probs) * log_probs, axis=-1) * weight
+            ) / denom
+            total = (policy_loss + cfg.value_coef * value_loss
+                     - cfg.entropy_coef * entropy)
+            return total, (policy_loss, value_loss, entropy)
+
+        (loss, (policy_loss, value_loss, entropy)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(ts.params)
+        updates, opt_state = optimizer.update(grads, ts.opt_state, ts.params)
+        params = optax.apply_updates(ts.params, updates)
+        ts = ts.replace(params=params, opt_state=opt_state,
+                        updates=ts.updates + 1)
+        metrics = {
+            "loss": loss,
+            "policy_loss": policy_loss,
+            "value_loss": value_loss,
+            "entropy": entropy,
+            "reward_sum": jnp.sum(traj.reward),
+            "env_steps": ts.env_steps,
+            "updates": ts.updates,
+            **portfolio_metrics(ts.env_state),
+        }
+        return ts, metrics
+
+    return Agent(name="a2c", init=init, step=step,
+                 num_agents=num_agents, steps_per_chunk=unroll)

@@ -1,0 +1,116 @@
+"""Learner interface and shared RL machinery.
+
+The reference's learner is one actor whose mailbox serializes ~230k
+single-row Session.run calls (SURVEY.md §3.3). Here every learner exposes the
+same two pure functions, and the whole step loop lives on-device:
+
+- ``init(key) -> TrainState``
+- ``step(TrainState) -> (TrainState, metrics)``  — advances ``steps_per_chunk``
+  env steps for the WHOLE agent batch inside one jitted program (action
+  selection + env transition + learning update fused; §7.2's inversion).
+
+The orchestrator (runtime/) only ever calls these two functions, so the
+algorithms (Q-learning, PG, DQN, A2C, PPO) are interchangeable — the
+generalization of the reference's single hard-wired Q-policy actor that
+SURVEY.md §7.1 item 3 requires.
+
+Batching note (the explicit algorithm change demanded by SURVEY.md §7.4): the
+reference's 10 workers funnel updates through one mailbox, so the network
+changes between *every* worker's step. Here the B agents' per-step losses are
+averaged into ONE update per env step (or per unroll). With one agent the
+semantics match the reference exactly — that is the parity-test configuration.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from sharetrade_tpu.config import LearnerConfig
+from sharetrade_tpu.env import trading
+
+
+@struct.dataclass
+class TrainState:
+    """Everything a learner threads between chunks — exactly the state that
+    checkpoint/resume must capture (SURVEY.md §7.1 item 7: model + optimizer
+    + RNG + episode cursor)."""
+
+    params: Any
+    opt_state: Any
+    carry: Any               # (B, ...) model recurrent state
+    env_state: trading.EnvState  # batched (B,) episode cursors
+    rng: jax.Array
+    env_steps: jax.Array     # i32 global env-step counter (epsilon schedule input)
+    updates: jax.Array       # i32 update counter (the reference's `iteration`)
+    extras: Any = None       # algo-specific (replay buffer, target params, ...)
+
+
+@dataclass(frozen=True)
+class Agent:
+    """A learner: pure init/step plus static shape facts for the runtime."""
+
+    name: str
+    init: Callable[[jax.Array], TrainState]
+    step: Callable[[TrainState], tuple[TrainState, dict[str, jax.Array]]]
+    num_agents: int
+    steps_per_chunk: int
+
+
+def build_optimizer(cfg: LearnerConfig) -> optax.GradientTransformation:
+    """Reference: AdaGrad(0.01) (QDecisionPolicyActor.scala:50). optax's
+    default ``initial_accumulator_value=0.1`` matches TF's AdaGrad."""
+    if cfg.optimizer == "adagrad":
+        return optax.adagrad(cfg.learning_rate)
+    if cfg.optimizer == "adam":
+        return optax.adam(cfg.learning_rate)
+    if cfg.optimizer == "sgd":
+        return optax.sgd(cfg.learning_rate)
+    raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+
+
+def exploit_probability(step: jax.Array, cfg: LearnerConfig) -> jax.Array:
+    """P(exploit) = min(epsilon, step / ramp): fully random at step 0 ramping
+    to epsilon-greedy (QDecisionPolicyActor.scala:58: ``Seq(epsilon,
+    step/1000f).min``)."""
+    return jnp.minimum(jnp.float32(cfg.epsilon),
+                       step.astype(jnp.float32) / cfg.epsilon_ramp_steps)
+
+
+def epsilon_greedy(key: jax.Array, q_values: jax.Array, step: jax.Array,
+                   cfg: LearnerConfig) -> jax.Array:
+    """One agent's Buy/Sell/Hold choice (QDecisionPolicyActor.scala:58-62)."""
+    k_gate, k_rand = jax.random.split(key)
+    exploit = jax.random.uniform(k_gate) < exploit_probability(step, cfg)
+    greedy = jnp.argmax(q_values).astype(jnp.int32)
+    rand = jax.random.randint(k_rand, (), 0, q_values.shape[0], jnp.int32)
+    return jnp.where(exploit, greedy, rand)
+
+
+def batched_reset(params: trading.EnvParams, num_agents: int) -> trading.EnvState:
+    single = trading.reset(params)
+    return jax.tree.map(lambda x: jnp.broadcast_to(x, (num_agents,) + x.shape),
+                        single)
+
+
+def batched_carry(model, num_agents: int):
+    carry = model.init_carry()
+    return jax.tree.map(lambda x: jnp.broadcast_to(x, (num_agents,) + x.shape),
+                        carry)
+
+
+def portfolio_metrics(env_state: trading.EnvState) -> dict[str, jax.Array]:
+    """The router's aggregation: mean/std over worker portfolios
+    (TrainerRouterActor.scala:137-151) plus richer distribution stats."""
+    values = jax.vmap(trading.portfolio_value)(env_state)
+    return {
+        "portfolio_mean": jnp.mean(values),
+        "portfolio_std": jnp.std(values),
+        "portfolio_min": jnp.min(values),
+        "portfolio_max": jnp.max(values),
+    }

@@ -1,0 +1,214 @@
+"""DQN with an on-device replay buffer — BASELINE.json config 2.
+
+Replay lives in HBM as fixed-size circular arrays (no dynamic shapes —
+position/size are carried indices), so sampling and the TD update stay inside
+the jitted chunk. A target network (synced every ``target_update_every``
+updates) stabilizes the bootstrap — the standard upgrade over the reference's
+online Q-learning, which bootstraps from the live network
+(QDecisionPolicyActor.scala:67-68).
+
+The journal bridge (``fill_replay_from_journal`` / runtime transition
+journaling) gives the persistence-backed replay capability of the reference's
+event-sourced layer (SURVEY.md §7.4 "Replay/persistence bandwidth").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+
+from sharetrade_tpu.agents.base import (
+    Agent, TrainState, batched_carry, batched_reset, build_optimizer,
+    epsilon_greedy, exploit_probability, portfolio_metrics,
+)
+from sharetrade_tpu.config import LearnerConfig
+from sharetrade_tpu.env import trading
+from sharetrade_tpu.models.core import Model
+
+
+@struct.dataclass
+class ReplayBuffer:
+    obs: jax.Array       # (cap, obs_dim) f32
+    action: jax.Array    # (cap,) i32
+    reward: jax.Array    # (cap,) f32
+    next_obs: jax.Array  # (cap, obs_dim) f32
+    pos: jax.Array       # i32 next write index
+    size: jax.Array      # i32 valid entries
+
+    @classmethod
+    def create(cls, capacity: int, obs_dim: int) -> "ReplayBuffer":
+        return cls(
+            obs=jnp.zeros((capacity, obs_dim), jnp.float32),
+            action=jnp.zeros((capacity,), jnp.int32),
+            reward=jnp.zeros((capacity,), jnp.float32),
+            next_obs=jnp.zeros((capacity, obs_dim), jnp.float32),
+            pos=jnp.int32(0),
+            size=jnp.int32(0),
+        )
+
+    def push(self, obs, action, reward, next_obs, valid) -> "ReplayBuffer":
+        """Insert a batch of B transitions (wrapping). ``valid`` masks agents
+        whose episode already ended — their slots are written then un-counted
+        by pointing them at already-valid rows (weight-neutral because the
+        write happens before the pointer advances past them)."""
+        batch = obs.shape[0]
+        capacity = self.obs.shape[0]
+        # Only advance through valid transitions: compact them to the front.
+        order = jnp.argsort(~valid)  # valid rows first, stable
+        obs, action = obs[order], action[order]
+        reward, next_obs = reward[order], next_obs[order]
+        n_valid = jnp.sum(valid).astype(jnp.int32)
+        idx = (self.pos + jnp.arange(batch, dtype=jnp.int32)) % capacity
+        write = jnp.arange(batch) < n_valid
+        safe_idx = jnp.where(write, idx, (self.pos - 1) % capacity)
+        return self.replace(
+            obs=self.obs.at[safe_idx].set(
+                jnp.where(write[:, None], obs, self.obs[safe_idx])),
+            action=self.action.at[safe_idx].set(
+                jnp.where(write, action, self.action[safe_idx])),
+            reward=self.reward.at[safe_idx].set(
+                jnp.where(write, reward, self.reward[safe_idx])),
+            next_obs=self.next_obs.at[safe_idx].set(
+                jnp.where(write[:, None], next_obs, self.next_obs[safe_idx])),
+            pos=(self.pos + n_valid) % capacity,
+            size=jnp.minimum(self.size + n_valid, capacity),
+        )
+
+    def sample(self, key: jax.Array, batch: int):
+        idx = jax.random.randint(key, (batch,), 0,
+                                 jnp.maximum(self.size, 1))
+        return (self.obs[idx], self.action[idx],
+                self.reward[idx], self.next_obs[idx])
+
+
+@struct.dataclass
+class DQNExtras:
+    target_params: object
+    replay: ReplayBuffer
+
+
+def make_dqn_agent(model: Model, env_params: trading.EnvParams,
+                   cfg: LearnerConfig, *, num_agents: int = 10,
+                   steps_per_chunk: int = 200) -> Agent:
+    optimizer = build_optimizer(cfg)
+    horizon = trading.num_steps(env_params)
+    obs_dim = model.obs_dim
+
+    def init(key: jax.Array) -> TrainState:
+        k_params, k_rng = jax.random.split(key)
+        params = model.init(k_params)
+        return TrainState(
+            params=params, opt_state=optimizer.init(params),
+            carry=batched_carry(model, num_agents),
+            env_state=batched_reset(env_params, num_agents),
+            rng=k_rng, env_steps=jnp.int32(0), updates=jnp.int32(0),
+            extras=DQNExtras(
+                target_params=jax.tree.map(jnp.copy, params),
+                replay=ReplayBuffer.create(cfg.replay_capacity, obs_dim)),
+        )
+
+    def q_batch(params, obs_batch):
+        outs, _ = jax.vmap(lambda o: model.apply(params, o, ()))(obs_batch)
+        return outs.logits
+
+    def one_step(ts: TrainState, _):
+        rng, k_act, k_sample = jax.random.split(ts.rng, 3)
+        act_keys = jax.random.split(k_act, num_agents)
+        active = ts.env_state.t < horizon
+
+        obs = jax.vmap(trading.observe, in_axes=(None, 0))(env_params, ts.env_state)
+        q_sel = q_batch(ts.params, obs)
+        actions = jax.vmap(lambda k, q: epsilon_greedy(k, q, ts.env_steps, cfg))(
+            act_keys, q_sel)
+        stepped, rewards = jax.vmap(trading.step, in_axes=(None, 0, 0))(
+            env_params, ts.env_state, actions)
+        env_state = jax.tree.map(
+            lambda new, old: jnp.where(
+                active.reshape((-1,) + (1,) * (new.ndim - 1)), new, old),
+            stepped, ts.env_state)
+        rewards = jnp.where(active, rewards, 0.0)
+        next_obs = jax.vmap(trading.observe, in_axes=(None, 0))(env_params, env_state)
+
+        replay = ts.extras.replay.push(obs, actions, rewards, next_obs, active)
+
+        def td_loss(params):
+            b_obs, b_act, b_rew, b_next = replay.sample(k_sample, cfg.replay_batch)
+            q_s = q_batch(params, b_obs)
+            q_next = jax.lax.stop_gradient(
+                q_batch(ts.extras.target_params, b_next))
+            target = b_rew + cfg.gamma * jnp.max(q_next, axis=-1)
+            predicted = jnp.take_along_axis(q_s, b_act[:, None], axis=-1)[:, 0]
+            return jnp.mean(jnp.square(predicted - target))
+
+        # Learn only once the buffer can fill a batch.
+        ready = replay.size >= cfg.replay_batch
+        loss, grads = jax.value_and_grad(td_loss)(ts.params)
+        updates, opt_state = optimizer.update(grads, ts.opt_state, ts.params)
+        new_params = optax.apply_updates(ts.params, updates)
+        params = jax.tree.map(lambda new, old: jnp.where(ready, new, old),
+                              new_params, ts.params)
+        opt_state = jax.tree.map(lambda new, old: jnp.where(ready, new, old),
+                                 opt_state, ts.opt_state)
+        n_updates = ts.updates + jnp.where(ready, 1, 0)
+
+        # Hard target sync every target_update_every updates.
+        sync = ready & (n_updates % cfg.target_update_every == 0)
+        target_params = jax.tree.map(
+            lambda t, p: jnp.where(sync, p, t),
+            ts.extras.target_params, params)
+
+        ts = ts.replace(
+            params=params, opt_state=opt_state, env_state=env_state, rng=rng,
+            env_steps=ts.env_steps + jnp.where(jnp.any(active), 1, 0),
+            updates=n_updates,
+            extras=DQNExtras(target_params=target_params, replay=replay),
+        )
+        return ts, (jnp.where(ready, loss, 0.0), jnp.sum(rewards))
+
+    def step(ts: TrainState):
+        ts, (losses, rewards) = jax.lax.scan(
+            one_step, ts, None, length=steps_per_chunk)
+        metrics = {
+            "loss": jnp.mean(losses),
+            "reward_sum": jnp.sum(rewards),
+            "replay_size": ts.extras.replay.size,
+            "exploit_prob": exploit_probability(ts.env_steps, cfg),
+            "env_steps": ts.env_steps,
+            "updates": ts.updates,
+            **portfolio_metrics(ts.env_state),
+        }
+        return ts, metrics
+
+    return Agent(name="dqn", init=init, step=step,
+                 num_agents=num_agents, steps_per_chunk=steps_per_chunk)
+
+
+def journal_transitions(journal, obs, actions, rewards, next_obs) -> None:
+    """Append a batch of transitions to an event journal (host side) — the
+    durable replay trail (reference capability: Akka-persistence journal,
+    SharePriceGetter.scala:37; generalized to experience data here)."""
+    journal.append({
+        "type": "transitions",
+        "obs": np.asarray(obs).tolist(),
+        "action": np.asarray(actions).tolist(),
+        "reward": np.asarray(rewards).tolist(),
+        "next_obs": np.asarray(next_obs).tolist(),
+    })
+
+
+def fill_replay_from_journal(replay: ReplayBuffer, journal) -> ReplayBuffer:
+    """Replay journaled transitions into the device buffer (offline/warm-start
+    path — the event-sourcing recovery pattern applied to experience)."""
+    for event in journal.replay():
+        if event.get("type") != "transitions":
+            continue
+        obs = jnp.asarray(event["obs"], jnp.float32)
+        valid = jnp.ones((obs.shape[0],), bool)
+        replay = replay.push(
+            obs, jnp.asarray(event["action"], jnp.int32),
+            jnp.asarray(event["reward"], jnp.float32),
+            jnp.asarray(event["next_obs"], jnp.float32), valid)
+    return replay

@@ -1,0 +1,75 @@
+"""REINFORCE (vanilla policy gradient).
+
+The reference's Python ancestor (rl.py, cited in its README) is a policy-
+gradient trader — BASELINE.json config 1. Monte-Carlo returns-to-go with a
+batch-mean baseline; one update per unroll.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from sharetrade_tpu.agents.base import (
+    Agent, TrainState, batched_carry, batched_reset, build_optimizer,
+    portfolio_metrics,
+)
+from sharetrade_tpu.agents.rollout import (
+    collect_rollout, discounted_returns, replay_forward,
+)
+from sharetrade_tpu.config import LearnerConfig
+from sharetrade_tpu.env import trading
+from sharetrade_tpu.models.core import Model
+
+
+def make_pg_agent(model: Model, env_params: trading.EnvParams,
+                  cfg: LearnerConfig, *, num_agents: int = 10,
+                  steps_per_chunk: int | None = None) -> Agent:
+    optimizer = build_optimizer(cfg)
+    unroll = steps_per_chunk or cfg.unroll_len
+
+    def init(key: jax.Array) -> TrainState:
+        k_params, k_rng = jax.random.split(key)
+        params = model.init(k_params)
+        return TrainState(
+            params=params, opt_state=optimizer.init(params),
+            carry=batched_carry(model, num_agents),
+            env_state=batched_reset(env_params, num_agents),
+            rng=k_rng, env_steps=jnp.int32(0), updates=jnp.int32(0),
+        )
+
+    def step(ts: TrainState):
+        ts, traj, bootstrap, init_carry = collect_rollout(
+            model, env_params, ts, unroll, num_agents)
+        returns = discounted_returns(traj.reward, traj.active,
+                                     bootstrap, cfg.gamma)
+        weight = traj.active
+        denom = jnp.maximum(jnp.sum(weight), 1.0)
+        baseline = jnp.sum(returns * weight) / denom
+        adv = (returns - baseline) * weight
+
+        def loss_fn(params):
+            logits, _ = replay_forward(model, params, traj, init_carry)
+            logp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits), traj.action[..., None], axis=-1
+            )[..., 0]
+            return -jnp.sum(logp * jax.lax.stop_gradient(adv)) / denom
+
+        loss, grads = jax.value_and_grad(loss_fn)(ts.params)
+        updates, opt_state = optimizer.update(grads, ts.opt_state, ts.params)
+        params = optax.apply_updates(ts.params, updates)
+        ts = ts.replace(params=params, opt_state=opt_state,
+                        updates=ts.updates + 1)
+        metrics = {
+            "loss": loss,
+            "reward_sum": jnp.sum(traj.reward),
+            "return_mean": baseline,
+            "env_steps": ts.env_steps,
+            "updates": ts.updates,
+            **portfolio_metrics(ts.env_state),
+        }
+        return ts, metrics
+
+    return Agent(name="pg", init=init, step=step,
+                 num_agents=num_agents, steps_per_chunk=unroll)

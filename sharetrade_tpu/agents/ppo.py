@@ -1,0 +1,134 @@
+"""PPO with GAE — BASELINE.json config 4 (LSTM policy capable).
+
+Clipped surrogate objective over multiple epochs of minibatch updates, all
+inside one jitted chunk (epochs and minibatch sweeps are ``lax.scan``s, not
+Python loops — XLA sees a single static program).
+
+Recurrence: minibatches cut across the *agent* axis, never the time axis, so
+each minibatch replays full sequences from the unroll's initial carry and
+LSTM gradients flow through time correctly (the standard sequence-preserving
+PPO+RNN scheme).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from sharetrade_tpu.agents.base import (
+    Agent, TrainState, batched_carry, batched_reset, build_optimizer,
+    portfolio_metrics,
+)
+from sharetrade_tpu.agents.rollout import (
+    collect_rollout, gae_advantages, replay_forward,
+)
+from sharetrade_tpu.config import LearnerConfig
+from sharetrade_tpu.env import trading
+from sharetrade_tpu.models.core import Model
+from sharetrade_tpu.utils.logging import get_logger
+
+
+def make_ppo_agent(model: Model, env_params: trading.EnvParams,
+                   cfg: LearnerConfig, *, num_agents: int = 10,
+                   steps_per_chunk: int | None = None) -> Agent:
+    optimizer = build_optimizer(cfg)
+    unroll = steps_per_chunk or cfg.unroll_len
+    # Largest divisor of num_agents not exceeding the configured count keeps
+    # minibatch SGD meaningful when the two don't divide evenly (e.g. 10
+    # agents / 4 requested -> 2 minibatches of 5, not a silent full batch).
+    requested = max(1, min(cfg.ppo_minibatches, num_agents))
+    num_minibatches = max(d for d in range(1, requested + 1)
+                          if num_agents % d == 0)
+    if num_minibatches != requested:
+        get_logger("agents.ppo").warning(
+            "ppo_minibatches=%d does not divide num_agents=%d; using %d",
+            cfg.ppo_minibatches, num_agents, num_minibatches)
+    mb_size = num_agents // num_minibatches
+
+    def init(key: jax.Array) -> TrainState:
+        k_params, k_rng = jax.random.split(key)
+        params = model.init(k_params)
+        return TrainState(
+            params=params, opt_state=optimizer.init(params),
+            carry=batched_carry(model, num_agents),
+            env_state=batched_reset(env_params, num_agents),
+            rng=k_rng, env_steps=jnp.int32(0), updates=jnp.int32(0),
+        )
+
+    def minibatch_loss(params, traj_mb, carry_mb, adv_mb, ret_mb):
+        logits, values = replay_forward(model, params, traj_mb, carry_mb)
+        log_probs = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            log_probs, traj_mb.action[..., None], axis=-1)[..., 0]
+        weight = traj_mb.active
+        denom = jnp.maximum(jnp.sum(weight), 1.0)
+
+        # Advantage normalization over the minibatch's active steps.
+        adv_mean = jnp.sum(adv_mb * weight) / denom
+        adv_var = jnp.sum(jnp.square(adv_mb - adv_mean) * weight) / denom
+        adv = (adv_mb - adv_mean) * jax.lax.rsqrt(adv_var + 1e-8)
+
+        ratio = jnp.exp(logp - traj_mb.logp)
+        clipped = jnp.clip(ratio, 1.0 - cfg.clip_eps, 1.0 + cfg.clip_eps)
+        policy_loss = -jnp.sum(
+            jnp.minimum(ratio * adv, clipped * adv) * weight) / denom
+        value_loss = jnp.sum(jnp.square(values - ret_mb) * weight) / denom
+        entropy = -jnp.sum(
+            jnp.sum(jnp.exp(log_probs) * log_probs, axis=-1) * weight) / denom
+        total = (policy_loss + cfg.value_coef * value_loss
+                 - cfg.entropy_coef * entropy)
+        return total, (policy_loss, value_loss, entropy)
+
+    def step(ts: TrainState):
+        ts, traj, bootstrap, init_carry = collect_rollout(
+            model, env_params, ts, unroll, num_agents)
+        advantages = gae_advantages(traj.reward, traj.value, traj.active,
+                                    bootstrap, cfg.gamma, cfg.gae_lambda)
+        returns = advantages + traj.value
+
+        def epoch_body(carry, _):
+            params, opt_state, rng = carry
+            rng, k_perm = jax.random.split(rng)
+            perm = jax.random.permutation(k_perm, num_agents)
+
+            def mb_body(carry, mb_idx):
+                params, opt_state = carry
+                idx = jax.lax.dynamic_slice_in_dim(
+                    perm, mb_idx * mb_size, mb_size)
+                traj_mb = jax.tree.map(lambda x: x[:, idx], traj)
+                carry_mb = jax.tree.map(lambda x: x[idx], init_carry)
+                (loss, aux), grads = jax.value_and_grad(
+                    minibatch_loss, has_aux=True)(
+                    params, traj_mb, carry_mb,
+                    advantages[:, idx], returns[:, idx])
+                updates, opt_state = optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), (loss, *aux)
+
+            (params, opt_state), losses = jax.lax.scan(
+                mb_body, (params, opt_state), jnp.arange(num_minibatches))
+            return (params, opt_state, rng), losses
+
+        (params, opt_state, rng), losses = jax.lax.scan(
+            epoch_body, (ts.params, ts.opt_state, ts.rng), None,
+            length=cfg.ppo_epochs)
+        total, policy_l, value_l, entropy = (jnp.mean(x) for x in losses)
+
+        ts = ts.replace(
+            params=params, opt_state=opt_state, rng=rng,
+            updates=ts.updates + cfg.ppo_epochs * num_minibatches)
+        metrics = {
+            "loss": total,
+            "policy_loss": policy_l,
+            "value_loss": value_l,
+            "entropy": entropy,
+            "reward_sum": jnp.sum(traj.reward),
+            "env_steps": ts.env_steps,
+            "updates": ts.updates,
+            **portfolio_metrics(ts.env_state),
+        }
+        return ts, metrics
+
+    return Agent(name="ppo", init=init, step=step,
+                 num_agents=num_agents, steps_per_chunk=unroll)

@@ -1,0 +1,132 @@
+"""Online Q-learning — the reference algorithm, fused on-device.
+
+One scan iteration here does what one fold step + four Session.run calls do
+in the reference (SURVEY.md §3.3): epsilon-greedy selection
+(QDecisionPolicyActor.scala:58-62), env transition
+(TrainerChildActor.scala:118-146), TD(0) target
+(QDecisionPolicyActor.scala:66-73), and the AdaGrad update — for the whole
+agent batch at once, with no host involvement.
+
+TD-target index: the reference writes the target at the **next** state's
+argmax index (QDecisionPolicyActor.scala:69-71); its rl.py ancestor — and
+textbook Q-learning — uses the *taken* action. ``cfg.update_taken_action``
+selects (True = textbook, the default; False = reference-bug parity). The
+elementwise square loss ``(y - q)²`` reduces to the single updated
+coordinate because y equals q everywhere else — implemented directly as the
+single-coordinate TD error.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from sharetrade_tpu.agents.base import (
+    Agent,
+    TrainState,
+    batched_carry,
+    batched_reset,
+    build_optimizer,
+    epsilon_greedy,
+    exploit_probability,
+    portfolio_metrics,
+)
+from sharetrade_tpu.config import LearnerConfig
+from sharetrade_tpu.env import trading
+from sharetrade_tpu.models.core import Model
+
+
+def make_qlearn_agent(model: Model, env_params: trading.EnvParams,
+                      cfg: LearnerConfig, *, num_agents: int = 10,
+                      steps_per_chunk: int = 200) -> Agent:
+    optimizer = build_optimizer(cfg)
+    horizon = trading.num_steps(env_params)
+
+    def init(key: jax.Array) -> TrainState:
+        k_params, k_rng = jax.random.split(key)
+        params = model.init(k_params)
+        return TrainState(
+            params=params,
+            opt_state=optimizer.init(params),
+            carry=batched_carry(model, num_agents),
+            env_state=batched_reset(env_params, num_agents),
+            rng=k_rng,
+            env_steps=jnp.int32(0),
+            updates=jnp.int32(0),
+        )
+
+    def apply_batch(params, obs_batch, carry_batch):
+        outs, carries = jax.vmap(
+            lambda o, c: model.apply(params, o, c))(obs_batch, carry_batch)
+        return outs.logits, carries
+
+    def one_step(ts: TrainState, _):
+        rng, k_act = jax.random.split(ts.rng)
+        act_keys = jax.random.split(k_act, num_agents)
+
+        # Freeze agents whose episode is over (chunking may overrun the horizon).
+        active = ts.env_state.t < horizon  # (B,) bool
+
+        obs = jax.vmap(trading.observe, in_axes=(None, 0))(env_params, ts.env_state)
+        q_sel, carry_new = apply_batch(ts.params, obs, ts.carry)
+        actions = jax.vmap(lambda k, q: epsilon_greedy(k, q, ts.env_steps, cfg))(
+            act_keys, q_sel)
+
+        stepped, rewards = jax.vmap(trading.step, in_axes=(None, 0, 0))(
+            env_params, ts.env_state, actions)
+        env_state = jax.tree.map(
+            lambda new, old: jnp.where(
+                active.reshape((-1,) + (1,) * (new.ndim - 1)), new, old),
+            stepped, ts.env_state)
+        rewards = jnp.where(active, rewards, 0.0)
+        next_obs = jax.vmap(trading.observe, in_axes=(None, 0))(env_params, env_state)
+
+        def td_loss(params):
+            q_s, _ = apply_batch(params, obs, ts.carry)          # (B, A)
+            q_next, _ = apply_batch(params, next_obs, carry_new)
+            q_next = jax.lax.stop_gradient(q_next)
+            target = rewards + cfg.gamma * jnp.max(q_next, axis=-1)
+            idx = jnp.where(
+                cfg.update_taken_action,
+                actions,
+                jnp.argmax(q_next, axis=-1).astype(jnp.int32),  # reference bug
+            )
+            predicted = jnp.take_along_axis(q_s, idx[:, None], axis=-1)[:, 0]
+            per_agent = jnp.square(predicted - target) * active
+            return jnp.sum(per_agent) / jnp.maximum(jnp.sum(active), 1)
+
+        loss, grads = jax.value_and_grad(td_loss)(ts.params)
+        any_active = jnp.any(active)
+        updates, opt_state = optimizer.update(grads, ts.opt_state, ts.params)
+        new_params = optax.apply_updates(ts.params, updates)
+        params = jax.tree.map(
+            lambda new, old: jnp.where(any_active, new, old),
+            new_params, ts.params)
+        opt_state = jax.tree.map(
+            lambda new, old: jnp.where(any_active, new, old),
+            opt_state, ts.opt_state)
+
+        ts = ts.replace(
+            params=params, opt_state=opt_state, carry=carry_new,
+            env_state=env_state, rng=rng,
+            env_steps=ts.env_steps + jnp.where(any_active, 1, 0),
+            updates=ts.updates + jnp.where(any_active, 1, 0),
+        )
+        return ts, (loss, jnp.sum(rewards))
+
+    def step(ts: TrainState):
+        ts, (losses, rewards) = jax.lax.scan(
+            one_step, ts, None, length=steps_per_chunk)
+        metrics = {
+            "loss": jnp.mean(losses),
+            "reward_sum": jnp.sum(rewards),
+            "exploit_prob": exploit_probability(ts.env_steps, cfg),
+            "env_steps": ts.env_steps,
+            "updates": ts.updates,
+            **portfolio_metrics(ts.env_state),
+        }
+        return ts, metrics
+
+    return Agent(name="qlearn", init=init, step=step,
+                 num_agents=num_agents, steps_per_chunk=steps_per_chunk)

@@ -1,0 +1,129 @@
+"""On-policy rollout collection shared by PG / A2C / PPO.
+
+One ``lax.scan`` gathers a ``(T, B, ...)`` trajectory block for the whole
+agent batch — the TPU inversion of the reference's per-step worker↔learner
+mailbox round-trips (SURVEY.md §7.2). Losses recompute the forward pass from
+the stored observations (and the unroll's *initial* recurrent carry, so
+recurrent policies differentiate through time correctly).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from sharetrade_tpu.agents.base import TrainState
+from sharetrade_tpu.env import trading
+from sharetrade_tpu.models.core import Model
+
+
+class StepData(NamedTuple):
+    """One time-slice of a trajectory, batched over agents."""
+
+    obs: jax.Array      # (B, obs_dim)
+    action: jax.Array   # (B,) i32
+    logp: jax.Array     # (B,) log-prob of the sampled action (behavior policy)
+    value: jax.Array    # (B,) critic estimate at obs
+    reward: jax.Array   # (B,)
+    active: jax.Array   # (B,) f32 1.0 while the episode is running
+
+
+def collect_rollout(model: Model, env_params: trading.EnvParams,
+                    ts: TrainState, unroll_len: int, num_agents: int):
+    """Roll the policy forward ``unroll_len`` steps.
+
+    Returns ``(new_ts, traj, bootstrap_value, init_carry)`` where ``traj``
+    stacks :class:`StepData` along a leading time axis, ``bootstrap_value`` is
+    V(s_T) for return bootstrapping, and ``init_carry`` is the recurrent state
+    the unroll started from (needed to replay the forward pass in losses).
+    """
+    horizon = trading.num_steps(env_params)
+    init_carry = ts.carry
+
+    def one_step(carry, _):
+        env_state, model_carry, rng = carry
+        rng, k_act = jax.random.split(rng)
+        act_keys = jax.random.split(k_act, num_agents)
+
+        active = (env_state.t < horizon).astype(jnp.float32)
+        obs = jax.vmap(trading.observe, in_axes=(None, 0))(env_params, env_state)
+        outs, new_model_carry = jax.vmap(
+            lambda o, c: model.apply(ts.params, o, c))(obs, model_carry)
+        actions = jax.vmap(
+            lambda k, lg: jax.random.categorical(k, lg))(act_keys, outs.logits)
+        actions = actions.astype(jnp.int32)
+        logp = jax.vmap(
+            lambda lg, a: jax.nn.log_softmax(lg)[a])(outs.logits, actions)
+
+        stepped, rewards = jax.vmap(trading.step, in_axes=(None, 0, 0))(
+            env_params, env_state, actions)
+        mask = active.astype(bool)
+        new_env = jax.tree.map(
+            lambda new, old: jnp.where(
+                mask.reshape((-1,) + (1,) * (new.ndim - 1)), new, old),
+            stepped, env_state)
+        rewards = rewards * active
+
+        data = StepData(obs=obs, action=actions, logp=logp,
+                        value=outs.value, reward=rewards, active=active)
+        return (new_env, new_model_carry, rng), data
+
+    (env_state, model_carry, rng), traj = jax.lax.scan(
+        one_step, (ts.env_state, ts.carry, ts.rng), None, length=unroll_len)
+
+    # Bootstrap value for the state the unroll stopped at.
+    final_obs = jax.vmap(trading.observe, in_axes=(None, 0))(env_params, env_state)
+    final_outs, _ = jax.vmap(
+        lambda o, c: model.apply(ts.params, o, c))(final_obs, model_carry)
+    bootstrap = final_outs.value * (env_state.t < horizon).astype(jnp.float32)
+
+    steps_taken = jnp.sum(traj.active[:, 0] > 0).astype(jnp.int32)
+    new_ts = ts.replace(env_state=env_state, carry=model_carry, rng=rng,
+                        env_steps=ts.env_steps + steps_taken)
+    return new_ts, traj, bootstrap, init_carry
+
+
+def replay_forward(model: Model, params: Any, traj: StepData, init_carry):
+    """Recompute (logits, values) along a stored trajectory under ``params``,
+    threading the recurrent carry — the differentiable forward for losses."""
+
+    def one_step(model_carry, obs_t):
+        outs, new_carry = jax.vmap(
+            lambda o, c: model.apply(params, o, c))(obs_t, model_carry)
+        return new_carry, (outs.logits, outs.value)
+
+    _, (logits, values) = jax.lax.scan(one_step, init_carry, traj.obs)
+    return logits, values  # (T, B, A), (T, B)
+
+
+def discounted_returns(rewards: jax.Array, active: jax.Array,
+                       bootstrap: jax.Array, gamma: float) -> jax.Array:
+    """Returns-to-go R_t = r_t + γ R_{t+1}, seeded with the bootstrap value;
+    computed as a reverse scan over the time axis. Shapes (T, B)."""
+
+    def backward(r_next, inputs):
+        reward, live = inputs
+        r = reward + gamma * r_next * live
+        return r, r
+
+    _, returns = jax.lax.scan(backward, bootstrap,
+                              (rewards, active), reverse=True)
+    return returns
+
+
+def gae_advantages(rewards, values, active, bootstrap, gamma, lam):
+    """Generalized Advantage Estimation over (T, B) arrays."""
+    next_values = jnp.concatenate([values[1:], bootstrap[None]], axis=0)
+
+    def backward(adv_next, inputs):
+        reward, value, next_value, live = inputs
+        delta = reward + gamma * next_value * live - value
+        adv = delta + gamma * lam * adv_next * live
+        return adv, adv
+
+    _, advantages = jax.lax.scan(
+        backward, jnp.zeros_like(bootstrap),
+        (rewards, values, next_values, active), reverse=True)
+    return advantages

@@ -1,0 +1,10 @@
+"""Checkpoint/resume — the reference's declared-but-empty capability made real.
+
+Reference: ``saveSnapshot`` fires every 500 updates with an EMPTY body
+(QDecisionPolicyActor.scala:74,91-93), with unused Saver/CheckpointSaver
+imports signaling intent (SURVEY.md §5). Here the full training state —
+model params, optimizer state, RNG, env cursors, algorithm extras — persists
+atomically and restores bit-exact (SURVEY.md §7.1 item 7).
+"""
+
+from sharetrade_tpu.checkpoint.manager import CheckpointManager  # noqa: F401

@@ -1,0 +1,117 @@
+"""Atomic, retained, resumable checkpoints of the full TrainState.
+
+Layout (one directory per checkpoint, like an orbax step dir):
+
+    <dir>/ckpt_0000000500/state.msgpack   flax-serialized TrainState pytree
+    <dir>/ckpt_0000000500/meta.json       step, wall time, user metadata
+
+Write protocol: serialize into ``<dir>/tmp-<step>-<pid>`` then ``os.replace``
+to the final name — a torn write can never look like a complete checkpoint
+(the same crash-safety contract as the framed journal, data/journal.py). The
+newest ``keep`` checkpoints are retained; older ones are pruned after a
+successful save, never before.
+
+Host-side Python is the right tool here (checkpointing is host IO —
+SURVEY.md §2.4); arrays are fetched with ``jax.device_get`` and restored with
+the caller's template TrainState, so sharded states come back placed however
+the caller's ``device_put``/shardings dictate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any
+
+import jax
+from flax import serialization
+
+from sharetrade_tpu.utils.logging import get_logger
+
+log = get_logger("checkpoint")
+
+_PREFIX = "ckpt_"
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, *, keep: int = 3):
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    # ---- save ----
+
+    def save(self, step: int, train_state: Any,
+             metadata: dict[str, Any] | None = None) -> str:
+        host_state = jax.device_get(train_state)
+        payload = serialization.to_bytes(host_state)
+        meta = {"step": int(step), "saved_at": time.time(),
+                **(metadata or {})}
+
+        tmp = os.path.join(self.directory, f"tmp-{step}-{os.getpid()}")
+        final = os.path.join(self.directory, f"{_PREFIX}{step:010d}")
+        os.makedirs(tmp, exist_ok=True)
+        with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
+            f.write(payload)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        if os.path.isdir(final):  # re-saving the same step: replace wholesale
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+        log.info("saved checkpoint step=%d (%d bytes)", step, len(payload))
+        self._prune()
+        return final
+
+    # ---- restore ----
+
+    def steps(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith(_PREFIX) and os.path.isfile(
+                    os.path.join(self.directory, name, "meta.json")):
+                try:
+                    out.append(int(name[len(_PREFIX):]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def restore(self, template: Any, step: int | None = None) -> tuple[Any, int]:
+        """Restore into the structure of ``template`` (an uninitialized or
+        freshly-initialized TrainState). Returns ``(state, step)``."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}")
+        path = os.path.join(self.directory, f"{_PREFIX}{step:010d}")
+        with open(os.path.join(path, "state.msgpack"), "rb") as f:
+            payload = f.read()
+        state = serialization.from_bytes(jax.device_get(template), payload)
+        log.info("restored checkpoint step=%d", step)
+        return state, step
+
+    def metadata(self, step: int) -> dict[str, Any]:
+        path = os.path.join(self.directory, f"{_PREFIX}{step:010d}", "meta.json")
+        with open(path) as f:
+            return json.load(f)
+
+    # ---- retention ----
+
+    def _prune(self) -> None:
+        steps = self.steps()
+        for old in steps[:-self.keep] if self.keep > 0 else []:
+            shutil.rmtree(os.path.join(
+                self.directory, f"{_PREFIX}{old:010d}"), ignore_errors=True)
+            log.debug("pruned checkpoint step=%d", old)
+        # Abandoned tmp dirs from crashed writers are garbage-collected too.
+        for name in os.listdir(self.directory):
+            if name.startswith("tmp-"):
+                full = os.path.join(self.directory, name)
+                if time.time() - os.path.getmtime(full) > 3600:
+                    shutil.rmtree(full, ignore_errors=True)

@@ -1,0 +1,140 @@
+"""Command-line driver — the ShareTradeHelper entry point, with flags.
+
+Reference: ``object ShareTradeHelper extends App`` wires the system with
+hard-coded constants and polls ``IsEverythingDone`` every 5 s
+(ShareTradeHelper.scala:14-48). Here the same flow takes a config file +
+``--set section.key=value`` overrides (the flag surface the reference lacks,
+SURVEY.md §5), runs the compiled training loop, and reports the avg/std
+portfolio aggregation plus throughput.
+
+    python -m sharetrade_tpu.cli train [--config cfg.json] [--set k=v ...]
+    python -m sharetrade_tpu.cli query --config cfg.json   # inspect data layer
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from sharetrade_tpu.config import FrameworkConfig
+from sharetrade_tpu.data.service import PriceDataService
+from sharetrade_tpu.utils.logging import configure, get_logger
+
+log = get_logger("cli")
+
+
+def _load_config(args) -> FrameworkConfig:
+    cfg = (FrameworkConfig.from_file(args.config) if args.config
+           else FrameworkConfig())
+    if args.set:
+        cfg = cfg.apply_overrides(args.set)
+    return cfg
+
+
+def cmd_train(args) -> int:
+    from sharetrade_tpu.runtime import Orchestrator, ReplyState
+    from sharetrade_tpu.parallel import build_mesh
+
+    cfg = _load_config(args)
+    service = PriceDataService(config=cfg.data)
+    orch = None
+    try:
+        response = service.request(args.symbol, args.start, args.end)
+        prices = response.series.prices
+        log.info("loaded %d prices for %s", len(prices), args.symbol)
+
+        mesh = build_mesh(cfg.parallel) if args.mesh else None
+        if mesh is not None:
+            # The agent batch shards over dp; round workers up to a multiple
+            # so the default 10 workers still run on an 8-chip mesh.
+            dp = mesh.shape.get(cfg.parallel.data_axis, 1)
+            if cfg.parallel.num_workers % dp:
+                adjusted = ((cfg.parallel.num_workers + dp - 1) // dp) * dp
+                log.warning("num_workers=%d not divisible by dp=%d; using %d",
+                            cfg.parallel.num_workers, dp, adjusted)
+                cfg.parallel.num_workers = adjusted
+        orch = Orchestrator(cfg, mesh=mesh)
+        t0 = time.perf_counter()
+        orch.send_training_data(prices)
+        orch.start_training(background=True)
+
+        # Driver poll loop (ShareTradeHelper.scala:32-48), with a sane cadence.
+        poll_s = cfg.runtime.poll_interval_s
+        while not orch.wait(timeout=poll_s):
+            snap = orch.snapshot()
+            if snap and args.verbose:
+                log.info("progress: env_steps=%s portfolio_mean=%.2f",
+                         snap.get("env_steps"), snap.get("portfolio_mean", 0.0))
+        elapsed = time.perf_counter() - t0
+
+        done = orch.is_everything_done()
+        avg, std = orch.get_avg(), orch.get_std()
+        if done.state is not ReplyState.COMPLETED or not avg.ok:
+            log.error("training did not complete: %s (last error: %r)",
+                      done, orch.last_error)
+            return 1
+        snap = orch.snapshot()
+        total_agent_steps = snap.get("env_steps", 0.0) * cfg.parallel.num_workers
+        # The reference's final log line (ShareTradeHelper.scala:46), plus rate.
+        log.info("The average of the portfolios: %.4f, the standard deviation: %.4f",
+                 avg.value, std.value)
+        print(json.dumps({
+            "avg_portfolio": avg.value,
+            "std_portfolio": std.value,
+            "env_steps": snap.get("env_steps"),
+            "updates": snap.get("updates"),
+            "agent_steps_per_sec": total_agent_steps / max(elapsed, 1e-9),
+            "elapsed_s": elapsed,
+            "restarts": orch.restarts,
+        }))
+        return 0
+    finally:
+        if orch is not None:
+            orch.stop()
+        service.close()
+
+
+def cmd_query(args) -> int:
+    cfg = _load_config(args)
+    service = PriceDataService(config=cfg.data)
+    response = service.request(args.symbol, args.start, args.end)
+    series = response.series
+    print(json.dumps({
+        "symbol": response.symbol,
+        "rows": len(series),
+        "first": str(series.dates[0]) if len(series) else None,
+        "last": str(series.dates[-1]) if len(series) else None,
+    }))
+    service.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="sharetrade_tpu")
+    parser.add_argument("--verbose", action="store_true")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    for name, fn in [("train", cmd_train), ("query", cmd_query)]:
+        p = sub.add_parser(name)
+        p.add_argument("--config", default=None, help="JSON config file")
+        p.add_argument("--set", action="append", default=[],
+                       metavar="SECTION.KEY=VALUE", help="config override")
+        p.add_argument("--symbol", default="MSFT")
+        # The reference asks for 1992-01-01..2015-01-01 (ShareTradeHelper.scala:23)
+        p.add_argument("--start", default=None)
+        p.add_argument("--end", default=None)
+        p.add_argument("--verbose", action="store_true")
+        if name == "train":
+            p.add_argument("--mesh", action="store_true",
+                           help="shard over all visible devices")
+        p.set_defaults(fn=fn)
+
+    args = parser.parse_args(argv)
+    configure()
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,43 @@
+"""Policy networks (L2 compute core).
+
+Reference: the single inline TF graph in QDecisionPolicyActor.scala:38-50.
+Here the model zoo is a registry keyed by ``ModelConfig.kind`` so learners are
+model-agnostic (SURVEY.md §7.1 item 3: one policy/learner interface covering
+the BASELINE.json config ladder).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from sharetrade_tpu.config import ModelConfig
+from sharetrade_tpu.models.core import Model, ModelOut, dense, dense_init  # noqa: F401
+from sharetrade_tpu.models.lstm import lstm_policy
+from sharetrade_tpu.models.mlp import ac_mlp, q_mlp
+from sharetrade_tpu.models.transformer import transformer_policy
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+
+
+def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
+                parity: bool = False) -> Model:
+    """Construct the policy network for ``cfg.kind``.
+
+    ``head="q"`` selects the Q-value head (valid for MLP only — the reference
+    network); ``head="ac"`` selects actor-critic heads. ``parity=True`` (with
+    kind=mlp, head=q) reproduces the reference graph bit-for-bit in
+    architecture: constant 0.1 biases, ReLU output, stddev-1 init.
+    """
+    dtype = _DTYPES[cfg.dtype]
+    if cfg.kind == "mlp":
+        if head == "q":
+            return q_mlp(obs_dim, cfg.hidden_dim, cfg.num_actions,
+                         parity=parity, dtype=dtype)
+        return ac_mlp(obs_dim, cfg.hidden_dim, cfg.num_actions, dtype=dtype)
+    if cfg.kind == "lstm":
+        return lstm_policy(obs_dim, cfg.hidden_dim, cfg.num_actions, dtype=dtype)
+    if cfg.kind == "transformer":
+        return transformer_policy(
+            obs_dim, cfg.num_actions, num_layers=cfg.num_layers,
+            num_heads=cfg.num_heads, head_dim=cfg.head_dim, dtype=dtype)
+    raise ValueError(f"unknown model kind {cfg.kind!r}")

@@ -1,0 +1,64 @@
+"""Model interface shared by every policy network.
+
+The reference has exactly one network — the TF graph built inline in
+``QDecisionPolicyActor.scala:38-50`` — and its "interface" is the actor's
+message protocol. Here the interface is three pure functions, so any model
+slots under ``vmap`` (agent batches), ``lax.scan`` (time), and ``shard_map``
+(devices) without special cases:
+
+- ``init(key) -> params``              parameter pytree
+- ``apply(params, obs, carry) -> (ModelOut, carry)``   one observation
+- ``init_carry() -> carry``            recurrent state seed (``()`` if none)
+
+``ModelOut.logits`` doubles as Q-values for value-based agents (a Q-head's
+outputs and a policy head's logits occupy the same slot); ``ModelOut.value``
+is the critic estimate for actor-critic agents (zeros for plain Q/PG heads,
+keeping the pytree structure uniform across model kinds).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ModelOut(NamedTuple):
+    logits: jax.Array  # (num_actions,) action preferences / Q-values
+    value: jax.Array   # scalar critic estimate (0.0 for valueless heads)
+
+
+@dataclass(frozen=True)
+class Model:
+    """A policy network as a bundle of pure functions (stateless module)."""
+
+    init: Callable[[jax.Array], Any]
+    apply: Callable[[Any, jax.Array, Any], tuple[ModelOut, Any]]
+    init_carry: Callable[[], Any] = field(default=lambda: ())
+    obs_dim: int = 0
+    num_actions: int = 3
+    name: str = "model"
+
+
+def dense_init(key: jax.Array, in_dim: int, out_dim: int, *,
+               scale: float | None = None, dtype=jnp.float32) -> dict[str, jax.Array]:
+    """Dense layer params. Default init is He-normal (std = sqrt(2/in)).
+
+    ``scale`` overrides the stddev — the reference uses plain
+    ``RandomNormalInitializer()`` (stddev 1.0) for both layers
+    (QDecisionPolicyActor.scala:41,45); parity mode passes ``scale=1.0``.
+    """
+    std = jnp.sqrt(2.0 / in_dim) if scale is None else scale
+    w = jax.random.normal(key, (in_dim, out_dim), dtype) * jnp.asarray(std, dtype)
+    return {"w": w, "b": jnp.zeros((out_dim,), dtype)}
+
+
+def dense(params: dict[str, jax.Array], x: jax.Array) -> jax.Array:
+    # preferred_element_type keeps MXU accumulation in f32 even when
+    # params/activations are bf16 (pallas_guide.md: "Missing preferred_element_type").
+    return (
+        jnp.dot(x, params["w"], preferred_element_type=jnp.float32).astype(x.dtype)
+        + params["b"]
+    )

@@ -1,0 +1,49 @@
+"""Recurrent (LSTM) actor-critic policy.
+
+No equivalent exists in the reference (its "memory" is the 201-price sliding
+window re-fed every step, SURVEY.md §5 "Long-context"); this is the
+forward-looking PPO+LSTM configuration from BASELINE.json config 4. The carry
+``(h, c)`` threads through the same ``lax.scan`` that carries the env state,
+so recurrence costs no extra host round-trips.
+
+The cell computes all four gates as ONE fused (obs+hidden) x 4*hidden matmul —
+a single MXU-friendly contraction instead of eight small ones.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sharetrade_tpu.models.core import Model, ModelOut, dense, dense_init
+
+
+def lstm_policy(obs_dim: int = 203, hidden_dim: int = 200, num_actions: int = 3,
+                *, dtype=jnp.float32) -> Model:
+    def init(key):
+        k_in, k_cell, k_pi, k_v = jax.random.split(key, 4)
+        return {
+            "input": dense_init(k_in, obs_dim, hidden_dim, dtype=dtype),
+            # fused gate weights: [x ; h] -> (i, f, g, o), each hidden_dim wide
+            "gates": dense_init(k_cell, 2 * hidden_dim, 4 * hidden_dim, dtype=dtype),
+            "policy": dense_init(k_pi, hidden_dim, num_actions, scale=0.01, dtype=dtype),
+            "value": dense_init(k_v, hidden_dim, 1, dtype=dtype),
+        }
+
+    def init_carry():
+        zeros = jnp.zeros((hidden_dim,), dtype)
+        return (zeros, zeros)
+
+    def apply(params, obs, carry):
+        h_prev, c_prev = carry
+        x = jax.nn.relu(dense(params["input"], obs.astype(dtype)))
+        gates = dense(params["gates"], jnp.concatenate([x, h_prev]))
+        i, f, g, o = jnp.split(gates, 4)
+        c = jax.nn.sigmoid(f + 1.0) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        logits = dense(params["policy"], h).astype(jnp.float32)
+        value = dense(params["value"], h).astype(jnp.float32)[0]
+        return ModelOut(logits=logits, value=value), (h, c)
+
+    return Model(init=init, apply=apply, init_carry=init_carry,
+                 obs_dim=obs_dim, num_actions=num_actions, name="lstm")

@@ -1,0 +1,90 @@
+"""MLP policy networks.
+
+``q_mlp`` reproduces the reference's network exactly
+(QDecisionPolicyActor.scala:38-50):
+
+    h1 = relu(x @ w1 + 0.1)      w1: (203, 200), RandomNormal init
+    q  = relu(h1 @ w2 + 0.1)     w2: (200, 3),   RandomNormal init
+
+Two faithful oddities, kept behind ``parity=True`` (the default matches the
+reference so numeric-parity tests are possible; ``parity=False`` gives the
+conventional variant):
+
+- the biases are *constants* (``tf.constant(0.1)``), not trained variables —
+  only w1/w2 receive gradients;
+- the output layer is ReLU'd, clamping Q-values at 0.
+
+``ac_mlp`` is the actor-critic generalization (policy logits + value head)
+used by the PG/A2C/PPO learners (SURVEY.md §7.1 item 3).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sharetrade_tpu.models.core import Model, ModelOut, dense, dense_init
+
+
+def q_mlp(obs_dim: int = 203, hidden_dim: int = 200, num_actions: int = 3,
+          *, parity: bool = True, dtype=jnp.float32) -> Model:
+    """The reference Q-network. ``parity=True`` = constant 0.1 biases +
+    ReLU output + stddev-1.0 normal init (QDecisionPolicyActor.scala:41-47)."""
+
+    scale = 1.0 if parity else None
+
+    def init(key):
+        k1, k2 = jax.random.split(key)
+        p1 = dense_init(k1, obs_dim, hidden_dim, scale=scale, dtype=dtype)
+        p2 = dense_init(k2, hidden_dim, num_actions, scale=scale, dtype=dtype)
+        if parity:
+            # Constant biases: drop them from the trainable pytree entirely;
+            # apply() adds the 0.1 inline (reference b1/b2 are tf.constant).
+            p1 = {"w": p1["w"]}
+            p2 = {"w": p2["w"]}
+        return {"layer1": p1, "layer2": p2}
+
+    def apply(params, obs, carry):
+        x = obs.astype(dtype)
+        if parity:
+            h = jax.nn.relu(
+                jnp.dot(x, params["layer1"]["w"], preferred_element_type=jnp.float32)
+                .astype(dtype) + jnp.asarray(0.1, dtype)
+            )
+            q = jax.nn.relu(
+                jnp.dot(h, params["layer2"]["w"], preferred_element_type=jnp.float32)
+                .astype(dtype) + jnp.asarray(0.1, dtype)
+            )
+        else:
+            h = jax.nn.relu(dense(params["layer1"], x))
+            q = dense(params["layer2"], h)  # no output ReLU: unclamped Q-values
+        out = ModelOut(logits=q.astype(jnp.float32), value=jnp.float32(0.0))
+        return out, carry
+
+    return Model(init=init, apply=apply, obs_dim=obs_dim,
+                 num_actions=num_actions, name="q_mlp")
+
+
+def ac_mlp(obs_dim: int = 203, hidden_dim: int = 200, num_actions: int = 3,
+           *, dtype=jnp.float32) -> Model:
+    """Two-layer torso with separate policy and value heads."""
+
+    def init(key):
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        return {
+            "torso1": dense_init(k1, obs_dim, hidden_dim, dtype=dtype),
+            "torso2": dense_init(k2, hidden_dim, hidden_dim, dtype=dtype),
+            "policy": dense_init(k3, hidden_dim, num_actions, scale=0.01, dtype=dtype),
+            "value": dense_init(k4, hidden_dim, 1, dtype=dtype),
+        }
+
+    def apply(params, obs, carry):
+        x = obs.astype(dtype)
+        h = jax.nn.relu(dense(params["torso1"], x))
+        h = jax.nn.relu(dense(params["torso2"], h))
+        logits = dense(params["policy"], h).astype(jnp.float32)
+        value = dense(params["value"], h).astype(jnp.float32)[0]
+        return ModelOut(logits=logits, value=value), carry
+
+    return Model(init=init, apply=apply, obs_dim=obs_dim,
+                 num_actions=num_actions, name="ac_mlp")

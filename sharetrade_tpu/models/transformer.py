@@ -1,0 +1,100 @@
+"""Transformer tick-series policy (BASELINE.json config 5).
+
+Treats the observation's price window as a *sequence* instead of a flat
+feature vector — the long-context capability the reference lacks entirely
+(SURVEY.md §5: windows iterated, never modeled as sequences). Each tick
+becomes a token carrying (price, log-return, position); the (budget, shares)
+portfolio scalars are appended as a final summary token whose output embedding
+feeds the policy/value heads. Causal attention runs through the Pallas flash
+kernel on TPU (sharetrade_tpu/ops/attention.py).
+
+Prices are normalized by the window's last price so the policy is
+scale-invariant across decades of price levels (the 1992 MSFT window differs
+from 2015's by an order of magnitude).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sharetrade_tpu.models.core import Model, ModelOut, dense, dense_init
+from sharetrade_tpu.ops.attention import flash_attention
+
+
+def _layer_norm(x, scale, bias, eps=1e-6):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
+                       num_layers: int = 2, num_heads: int = 4,
+                       head_dim: int = 64, mlp_ratio: int = 4,
+                       dtype=jnp.float32, use_pallas: bool | None = None) -> Model:
+    window = obs_dim - 2           # price ticks; final token holds the portfolio
+    seq_len = window + 1
+    d_model = num_heads * head_dim
+
+    def init(key):
+        keys = jax.random.split(key, 4 + 6 * num_layers)
+        params = {
+            "embed": dense_init(keys[0], 3, d_model, dtype=dtype),
+            "pos": jax.random.normal(keys[1], (seq_len, d_model), dtype) * 0.02,
+            "policy": dense_init(keys[2], d_model, num_actions, scale=0.01, dtype=dtype),
+            "value": dense_init(keys[3], d_model, 1, dtype=dtype),
+            "blocks": [],
+            "final_ln": {"scale": jnp.ones((d_model,), dtype),
+                         "bias": jnp.zeros((d_model,), dtype)},
+        }
+        for i in range(num_layers):
+            k = keys[4 + 6 * i: 4 + 6 * (i + 1)]
+            params["blocks"].append({
+                "ln1": {"scale": jnp.ones((d_model,), dtype),
+                        "bias": jnp.zeros((d_model,), dtype)},
+                "qkv": dense_init(k[0], d_model, 3 * d_model, dtype=dtype),
+                "proj": dense_init(k[1], d_model, d_model,
+                                   scale=0.02 / max(num_layers, 1), dtype=dtype),
+                "ln2": {"scale": jnp.ones((d_model,), dtype),
+                        "bias": jnp.zeros((d_model,), dtype)},
+                "mlp_in": dense_init(k[2], d_model, mlp_ratio * d_model, dtype=dtype),
+                "mlp_out": dense_init(k[3], mlp_ratio * d_model, d_model,
+                                      scale=0.02 / max(num_layers, 1), dtype=dtype),
+            })
+        return params
+
+    def tokenize(obs):
+        prices = obs[:window].astype(jnp.float32)
+        budget, shares = obs[window], obs[window + 1]
+        anchor = jnp.maximum(prices[-1], 1e-6)
+        rel = prices / anchor - 1.0
+        log_ret = jnp.concatenate(
+            [jnp.zeros((1,)), jnp.log(jnp.maximum(prices[1:], 1e-6))
+             - jnp.log(jnp.maximum(prices[:-1], 1e-6))])
+        tick_tokens = jnp.stack(
+            [rel, log_ret, jnp.zeros_like(rel)], axis=-1)        # (window, 3)
+        portfolio_token = jnp.array(
+            [budget / (anchor * 100.0), shares / 100.0, 1.0], jnp.float32)
+        return jnp.concatenate([tick_tokens, portfolio_token[None, :]])  # (seq, 3)
+
+    def apply(params, obs, carry):
+        tokens = tokenize(obs).astype(dtype)
+        x = dense(params["embed"], tokens) + params["pos"]        # (seq, d_model)
+        for blk in params["blocks"]:
+            h = _layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
+            qkv = dense(blk["qkv"], h).reshape(seq_len, 3, num_heads, head_dim)
+            # kernel expects (batch, heads, seq, head_dim)
+            q, k, v = (qkv[:, j].transpose(1, 0, 2)[None] for j in range(3))
+            attn = flash_attention(q, k, v, causal=True, use_pallas=use_pallas)
+            attn = attn[0].transpose(1, 0, 2).reshape(seq_len, d_model).astype(dtype)
+            x = x + dense(blk["proj"], attn)
+            h = _layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
+            x = x + dense(blk["mlp_out"], jax.nn.gelu(dense(blk["mlp_in"], h)))
+        summary = _layer_norm(x[-1], params["final_ln"]["scale"],
+                              params["final_ln"]["bias"])
+        logits = dense(params["policy"], summary).astype(jnp.float32)
+        value = dense(params["value"], summary).astype(jnp.float32)[0]
+        return ModelOut(logits=logits, value=value), carry
+
+    return Model(init=init, apply=apply, obs_dim=obs_dim,
+                 num_actions=num_actions, name="transformer")

@@ -1,0 +1,9 @@
+"""Custom TPU ops (Pallas kernels) with XLA fallbacks.
+
+The reference's only "ops layer" is libtensorflow's CPU kernels behind JNI
+(reference build.sbt:21). Here the hot ops are hand-written for the TPU memory
+hierarchy where XLA's fusion isn't enough; everything falls back to pure-XLA
+implementations off-TPU so the unit suite runs on the CPU mesh.
+"""
+
+from sharetrade_tpu.ops.attention import flash_attention, reference_attention  # noqa: F401

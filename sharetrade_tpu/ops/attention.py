@@ -1,0 +1,186 @@
+"""Causal multi-head attention: Pallas flash kernel + XLA fallback.
+
+The transformer tick-series policy (BASELINE.json config 5) attends over price
+windows. On TPU the forward pass runs as a Pallas flash-attention kernel —
+blocked online softmax, O(T) VMEM instead of the O(T²) score matrix in HBM —
+following the playbook in /opt/skills/guides/pallas_guide.md (grid/BlockSpec
+tiling, fori_loop over K blocks, broadcasted_iota masks).
+
+Gradients: ``flash_attention`` carries a ``jax.custom_vjp`` whose backward
+pass recomputes attention with the XLA reference implementation. Forward
+(rollout-heavy RL: thousands of policy evaluations per update) gets the
+kernel; the update path pays one rematerialized T² softmax, which at tick-
+window lengths is well inside VMEM-friendly territory. A fused Pallas
+backward is a later optimization, not a semantic change.
+
+Shapes: (batch, heads, seq, head_dim) throughout. Sequence and head_dim are
+padded to TPU tile multiples inside the wrapper (lane = 128, guide §Tiling);
+zero-padded K columns are masked to -inf, zero-padded D columns contribute
+nothing to QKᵀ and are sliced off the output.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+BLOCK_Q = 128
+BLOCK_K = 128
+LANE = 128
+
+_NEG_INF = -1e30
+
+
+def reference_attention(q, k, v, *, causal: bool = True, sm_scale: float | None = None):
+    """Plain XLA attention — the numeric ground truth for the kernel."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        t_q, t_k = scores.shape[-2], scores.shape[-1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (t_q, t_k), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (t_q, t_k), 1)
+        scores = jnp.where(col <= row, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  sm_scale: float, seq_len: int, kv_len: int):
+    """One (batch*head, q-block) program: online-softmax over K blocks."""
+    q_block = q_ref.shape[1]
+    head_dim = q_ref.shape[2]
+    qi = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d)
+
+    num_k_blocks = pl.cdiv(kv_len, block_k)
+    if causal:
+        # Blocks entirely above the causal frontier contribute nothing.
+        last_row = (qi + 1) * q_block - 1
+        num_k_blocks = jnp.minimum(num_k_blocks, pl.cdiv(last_row + 1, block_k))
+
+    row_ids = qi * q_block + jax.lax.broadcasted_iota(
+        jnp.int32, (q_block, block_k), 0)
+
+    def body(kb, carry):
+        acc, m_prev, l_prev = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # (bq, bk)
+
+        col_ids = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (q_block, block_k), 1)
+        mask = col_ids < seq_len  # padding columns are not real keys
+        if causal:
+            mask = mask & (col_ids <= row_ids)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((q_block, head_dim), jnp.float32)
+    m0 = jnp.full((q_block,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((q_block,), jnp.float32)
+    acc, _, l = jax.lax.fori_loop(0, num_k_blocks, body, (acc0, m0, l0))
+
+    # Fully-masked (padding) query rows have l == 0; emit zeros, not NaNs.
+    l_safe = jnp.where(l > 0, l, 1.0)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def _pad_to(x, axis, multiple):
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_forward(q, k, v, causal: bool, sm_scale: float, interpret: bool):
+    batch, heads, seq_len, head_dim = q.shape
+    kv_len = k.shape[2]
+
+    qp = _pad_to(_pad_to(q, 2, BLOCK_Q), 3, LANE)
+    kp = _pad_to(_pad_to(k, 2, BLOCK_K), 3, LANE)
+    vp = _pad_to(_pad_to(v, 2, BLOCK_K), 3, LANE)
+    d_pad = qp.shape[-1]  # post-padding width (a LANE multiple, any head_dim)
+    qp = qp.reshape(batch * heads, -1, d_pad)
+    kp = kp.reshape(batch * heads, -1, d_pad)
+    vp = vp.reshape(batch * heads, -1, d_pad)
+    bh, t_pad, _ = qp.shape
+    kv_pad = kp.shape[1]
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=BLOCK_K, causal=causal,
+        sm_scale=sm_scale, seq_len=seq_len, kv_len=kv_pad)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, t_pad // BLOCK_Q),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, kv_pad, d_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, kv_pad, d_pad), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, d_pad), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_pad, d_pad), q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp)
+
+    out = out.reshape(batch, heads, t_pad, d_pad)
+    return out[:, :, :seq_len, :head_dim]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention(q, k, v, causal, sm_scale, interpret):
+    return _flash_forward(q, k, v, causal, sm_scale, interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale, interpret):
+    return _flash_forward(q, k, v, causal, sm_scale, interpret), (q, k, v)
+
+
+def _flash_bwd_rule(causal, sm_scale, interpret, residuals, g):
+    # Rematerialized backward through the XLA reference (see module docstring).
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: reference_attention(
+            q_, k_, v_, causal=causal, sm_scale=sm_scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    sm_scale: float | None = None,
+                    use_pallas: bool | None = None):
+    """Causal MHA over (batch, heads, seq, head_dim).
+
+    ``use_pallas=None`` auto-selects: the kernel on TPU, the XLA reference
+    elsewhere (the unit suite runs the kernel through the Pallas interpreter
+    separately — tests/test_ops.py — so both paths stay covered).
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected (batch, heads, seq, head_dim), got {q.shape}")
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if not use_pallas:
+        return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    interpret = jax.default_backend() != "tpu"
+    return _flash_attention(q, k, v, causal, sm_scale, interpret)

@@ -1,0 +1,27 @@
+"""Parallelism layer: meshes, shardings, collectives, sequence parallelism.
+
+Replaces the reference's Akka Router + mailbox parameter server (SURVEY.md
+§2.2-2.3) with jax.sharding meshes and XLA collectives over ICI/DCN.
+"""
+
+from sharetrade_tpu.parallel.collectives import (  # noqa: F401
+    all_gather,
+    all_reduce_mean,
+    all_reduce_sum,
+    broadcast_from,
+    reduce_scatter,
+    ring_shift,
+)
+from sharetrade_tpu.parallel.mesh import AXIS_ORDER, build_mesh, init_distributed  # noqa: F401
+from sharetrade_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_attention_sharded,
+    sequence_sharding,
+)
+from sharetrade_tpu.parallel.sharding import (  # noqa: F401
+    batch_axis_sharding,
+    make_parallel_step,
+    mlp_tp_rules,
+    param_shardings,
+    train_state_shardings,
+)

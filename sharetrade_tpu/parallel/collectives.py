@@ -1,0 +1,49 @@
+"""Named-axis collective helpers.
+
+The reference's entire communication layer is actor mailboxes: broadcast
+routing (TrainerRouterActor.scala:66), ask-based gather (:137-139), and the
+mailbox-serialized parameter server (QDecisionPolicyActor.scala:54-77). The
+TPU-native equivalents are XLA collectives over ICI/DCN — these helpers name
+the correspondence once so call sites read as intent.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def all_reduce_mean(x, axis: str):
+    """Gradient/metric averaging — replaces the serialized UpdateQ stream."""
+    return jax.lax.pmean(x, axis)
+
+
+def all_reduce_sum(x, axis: str):
+    return jax.lax.psum(x, axis)
+
+
+def all_gather(x, axis: str, *, tiled: bool = False):
+    """Result aggregation — replaces the router's ask(GetPortfolio) fan-in."""
+    return jax.lax.all_gather(x, axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str):
+    """Sharded reduction (ZeRO-style optimizer sharding building block)."""
+    return jax.lax.psum_scatter(x, axis, tiled=True)
+
+
+def ring_shift(x, axis: str, *, reverse: bool = False):
+    """One ring hop (the ring-attention/pipeline transfer primitive)."""
+    n = jax.lax.axis_size(axis)
+    if reverse:
+        perm = [(i, (i - 1) % n) for i in range(n)]
+    else:
+        perm = [(i, (i + 1) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def broadcast_from(x, axis: str, src: int = 0):
+    """Replicate one shard's value to the whole axis (router broadcast)."""
+    idx = jax.lax.axis_index(axis)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis)

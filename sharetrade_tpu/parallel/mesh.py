@@ -1,0 +1,66 @@
+"""Device mesh construction (replacing the Akka Router fan-out, SURVEY.md §2.2).
+
+The reference's "cluster" is 10 actors in one JVM with remoting stubbed
+(build.sbt:13, README.md:13). Here scale-out is a named ``jax.sharding.Mesh``:
+axes dp/tp/sp/pp/ep are declared up front and shardings annotate how each
+tensor spreads over them; XLA inserts the ICI/DCN collectives (scaling-book
+recipe: pick a mesh, annotate, let the compiler place communication).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from sharetrade_tpu.config import ParallelConfig
+from sharetrade_tpu.utils.logging import get_logger
+
+log = get_logger("parallel.mesh")
+
+AXIS_ORDER = ("dp", "tp", "sp", "pp", "ep")
+
+
+def build_mesh(cfg: ParallelConfig | None = None, devices=None) -> Mesh:
+    """Build a mesh from ``cfg.mesh_shape`` (e.g. ``{"dp": 4, "tp": 2}``).
+
+    Empty/missing shape puts every device on the data axis — the moral
+    equivalent of the reference's "all workers under one broadcast router".
+    Axis sizes must multiply to the device count (a partial mesh would
+    silently idle chips).
+    """
+    cfg = cfg or ParallelConfig()
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+
+    shape = dict(cfg.mesh_shape) if cfg.mesh_shape else {}
+    if not shape:
+        shape = {cfg.data_axis: devices.size}
+    names = [a for a in AXIS_ORDER if shape.get(a, 1) > 1]
+    if not names:
+        names = [cfg.data_axis]
+    sizes = [shape.get(a, 1) for a in names]
+    total = int(np.prod(sizes))
+    if total != devices.size:
+        raise ValueError(
+            f"mesh shape {dict(zip(names, sizes))} needs {total} devices, "
+            f"got {devices.size}")
+    mesh = Mesh(devices.reshape(sizes), tuple(names))
+    log.info("mesh %s over %d devices", dict(zip(names, sizes)), devices.size)
+    return mesh
+
+
+def init_distributed() -> bool:
+    """Multi-host bring-up (the reference's never-built Akka Cluster tier,
+    README.md:13). Under a multi-host TPU slice the coordinator address and
+    process indices come from the TPU runtime; elsewhere this is a no-op.
+    Returns True when running multi-process."""
+    import os
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+            "MEGASCALE_COORDINATOR_ADDRESS"):
+        jax.distributed.initialize()
+        log.info("distributed: process %d of %d",
+                 jax.process_index(), jax.process_count())
+        return True
+    return jax.process_count() > 1

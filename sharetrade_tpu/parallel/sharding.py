@@ -1,0 +1,141 @@
+"""Sharding rules: how TrainState tensors spread over the mesh.
+
+Replaces the reference's implicit placement (everything in one JVM heap, one
+TF session owning the only parameter copy) with explicit PartitionSpecs:
+
+- batch-leading state (env cursors, carries, replay rows) shards over ``dp``;
+- parameters/optimizer state replicate by default, or shard over ``tp`` via
+  path rules (the mechanism SURVEY.md §2.2 asks for even though the reference
+  model is tiny);
+- scalars (rng, counters) replicate.
+
+With these in/out shardings on a jitted step, XLA turns the loss mean over
+the dp-sharded batch into an ICI all-reduce — the parameter-server mailbox
+(QDecisionPolicyActor.scala:54-77) become a collective (SURVEY.md §7.2).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sharetrade_tpu.agents.base import TrainState
+
+
+def batch_axis_sharding(mesh: Mesh, data_axis: str = "dp"):
+    """P(dp, None, ...) for arrays whose leading dim is the agent batch."""
+    return NamedSharding(mesh, P(data_axis))
+
+
+def param_shardings(params: Any, mesh: Mesh, rules: dict[str, P] | None = None):
+    """Map each param leaf to a NamedSharding.
+
+    ``rules`` maps a '/'-joined path *suffix* to a PartitionSpec, e.g.
+    ``{"layer1/w": P(None, "tp"), "layer2/w": P("tp", None)}`` for Megatron-
+    style column→row sharding of the MLP. Unmatched leaves replicate.
+    """
+    rules = rules or {}
+
+    def leaf_sharding(path, leaf):
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        for suffix, spec in rules.items():
+            if key.endswith(suffix):
+                return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, params)
+
+
+def mlp_tp_rules(model_axis: str = "tp") -> dict[str, P]:
+    """Column-parallel first layer, row-parallel second — one all-reduce at
+    the output, the classic Megatron split mapped onto ICI."""
+    return {
+        "layer1/w": P(None, model_axis),
+        "layer2/w": P(model_axis, None),
+        "torso1/w": P(None, model_axis),
+        "torso2/w": P(model_axis, None),
+        "qkv/w": P(None, model_axis),
+        "proj/w": P(model_axis, None),
+        "mlp_in/w": P(None, model_axis),
+        "mlp_out/w": P(model_axis, None),
+    }
+
+
+def train_state_shardings(ts: TrainState, mesh: Mesh, *,
+                          data_axis: str = "dp",
+                          param_rules: dict[str, P] | None = None) -> TrainState:
+    """Build the TrainState-shaped pytree of NamedShardings for jit in/out."""
+    replicate = NamedSharding(mesh, P())
+    batch = NamedSharding(mesh, P(data_axis))
+
+    p_shard = param_shardings(ts.params, mesh, param_rules)
+
+    # Optimizer accumulators (AdaGrad sums, Adam moments) embed a params-
+    # shaped subtree, so an opt leaf's path *ends with* some param's full
+    # path (e.g. `.0.sum_of_squares.layer1.w` ends with `layer1/w`). Match
+    # on that path suffix plus shape — never shape alone, which picks the
+    # wrong spec when two differently-sharded params share a shape.
+    def _path_keys(path):
+        return tuple(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+    param_items = [
+        (_path_keys(path), leaf.shape, sharding)
+        for (path, leaf), sharding in zip(
+            jax.tree_util.tree_flatten_with_path(ts.params)[0],
+            jax.tree.leaves(p_shard))
+    ]
+
+    def opt_leaf(path, leaf):
+        keys = _path_keys(path)
+        for pkeys, pshape, sharding in param_items:
+            if (len(keys) >= len(pkeys) and keys[-len(pkeys):] == pkeys
+                    and getattr(leaf, "shape", None) == pshape):
+                return sharding
+        return replicate
+
+    def batched_leaf(leaf):
+        return batch if getattr(leaf, "ndim", 0) >= 1 else replicate
+
+    return TrainState(
+        params=p_shard,
+        opt_state=jax.tree_util.tree_map_with_path(opt_leaf, ts.opt_state),
+        carry=jax.tree.map(batched_leaf, ts.carry),
+        env_state=jax.tree.map(batched_leaf, ts.env_state),
+        rng=replicate,
+        env_steps=replicate,
+        updates=replicate,
+        extras=jax.tree.map(batched_leaf, ts.extras) if ts.extras is not None else None,
+    )
+
+
+def make_parallel_step(agent, mesh: Mesh, *, data_axis: str = "dp",
+                       param_rules: dict[str, P] | None = None):
+    """jit the agent's chunk step with mesh shardings.
+
+    Returns ``(place, step)``: ``place(ts)`` device_puts a freshly-initialized
+    TrainState onto the mesh; ``step`` is the compiled chunk function with
+    donated input (the TrainState is consumed each call — no HBM double-
+    buffering of parameters).
+    """
+    replicate = NamedSharding(mesh, P())
+    cache: dict[str, Any] = {}  # sharding pytree + jitted fn, built once
+
+    def _ensure(ts):
+        if "fn" not in cache:
+            sh = train_state_shardings(ts, mesh, data_axis=data_axis,
+                                       param_rules=param_rules)
+            cache["sh"] = sh
+            cache["fn"] = jax.jit(agent.step, in_shardings=(sh,),
+                                  out_shardings=(sh, replicate),
+                                  donate_argnums=0)
+        return cache
+
+    def place(ts: TrainState) -> TrainState:
+        return jax.device_put(ts, _ensure(ts)["sh"])
+
+    def compiled(ts):
+        return _ensure(ts)["fn"](ts)
+
+    return place, compiled

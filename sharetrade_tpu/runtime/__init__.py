@@ -1,0 +1,22 @@
+"""Runtime: lifecycle FSM, orchestration, supervision (L4/L5).
+
+Reference: TrainerRouterActor + BackoffSupervisor + the ShareTradeHelper
+driver loop (SURVEY.md §3.1, §3.5), re-designed as a host-side orchestrator
+over a compiled device loop (§7.2's architectural inversion).
+"""
+
+from sharetrade_tpu.runtime.lifecycle import (  # noqa: F401
+    Lifecycle,
+    Phase,
+    QueryReply,
+    ReplyState,
+)
+from sharetrade_tpu.runtime.orchestrator import (  # noqa: F401
+    DEFAULT_ERROR_POLICY,
+    ESCALATE,
+    RESTART,
+    RESUME,
+    STOP,
+    Orchestrator,
+    run_end_to_end,
+)

@@ -1,0 +1,346 @@
+"""The training orchestrator — L4/L5 of the reference, actor-free.
+
+What the reference spreads across ShareTradeHelper (driver poll loop),
+TrainerRouterActor (broadcast + lifecycle + aggregation + supervision) and
+BackoffSupervisor wrappers (SURVEY.md §3.1, §3.5), this one host-side object
+owns:
+
+- the lifecycle FSM (awaiting-data → ready → training → trained/completed),
+  with StartTraining stashing (TrainerRouterActor.scala:75-76);
+- the chunked device loop: the agent's jitted ``step`` advances
+  ``chunk_steps`` env steps per host visit; between chunks the host snapshots
+  metrics, so ``get_avg``/``get_std`` answer **without stopping the device**
+  (the reference interrupts trained workers with ask(GetPortfolio);
+  SURVEY.md §7.4 "Queryability");
+- supervision: a failing chunk triggers exponential-backoff restart from the
+  latest checkpoint (initial 3 s, cap 60 s, jitter 0.2 — the reference's
+  Backoff.onFailure envelope, TrainerRouterActor.scala:46-52) up to
+  ``max_restarts``, then FAILED (the Escalate arm of its decider);
+- checkpoint cadence: every ``checkpoint_every_updates`` updates — the
+  reference's intended-but-stubbed every-500 (QDecisionPolicyActor.scala:74);
+- a typed error policy — the reference's OneForOneStrategy decider maps
+  exception classes to Resume/Restart/Stop/Escalate
+  (TrainerRouterActor.scala:53-58); ``error_policy`` maps exception types to
+  the same four verbs (resume = keep state and continue; restart =
+  backoff + restore from checkpoint; stop = mark FAILED; escalate = re-raise);
+- test seams: ``step_override`` replaces the compiled step (the overridable
+  ``train()`` seam, TrainerRouterActorSpec.scala:144-153) and
+  ``fault_hook`` injects failures mid-run (the PoisonPill chaos seam,
+  :97-115).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from sharetrade_tpu.agents import build_agent
+from sharetrade_tpu.agents.base import Agent, TrainState
+from sharetrade_tpu.checkpoint import CheckpointManager
+from sharetrade_tpu.config import FrameworkConfig
+from sharetrade_tpu.env import trading
+from sharetrade_tpu.parallel import build_mesh, make_parallel_step
+from sharetrade_tpu.runtime.lifecycle import Lifecycle, Phase, QueryReply, ReplyState
+from sharetrade_tpu.utils.logging import EventLog, get_logger
+from sharetrade_tpu.utils.metrics import MetricsRegistry
+from sharetrade_tpu.utils.profiling import StepTimer, Tracer
+
+log = get_logger("runtime.orchestrator")
+
+
+#: Supervision verbs (the Akka directive vocabulary).
+RESUME, RESTART, STOP, ESCALATE = "resume", "restart", "stop", "escalate"
+
+#: Default decider, mirroring TrainerRouterActor.scala:53-58
+#: (ArithmeticException→Resume, NullPointer→Restart, IllegalArgument→Stop,
+#: anything else→Escalate... except here unknown errors Restart, because on
+#: TPU transient device errors are the common case and restart-from-
+#: checkpoint is the designed recovery path).
+DEFAULT_ERROR_POLICY: dict[type, str] = {
+    ArithmeticError: RESUME,
+    AttributeError: RESTART,
+    ValueError: STOP,
+    KeyboardInterrupt: ESCALATE,
+}
+
+
+class Orchestrator:
+    def __init__(self, cfg: FrameworkConfig, *,
+                 mesh=None,
+                 checkpoints: CheckpointManager | None = None,
+                 event_log: EventLog | None = None,
+                 step_override: Callable[[TrainState], tuple[TrainState, dict]] | None = None,
+                 fault_hook: Callable[[int, dict], None] | None = None,
+                 error_policy: dict[type, str] | None = None):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.lifecycle = Lifecycle()
+        self.metrics = MetricsRegistry()
+        self.checkpoints = checkpoints or CheckpointManager(
+            cfg.runtime.checkpoint_dir, keep=cfg.runtime.keep_checkpoints)
+        self.events = event_log or EventLog(None)
+        self.tracer = Tracer(cfg.runtime.profile_dir)
+        self._step_override = step_override
+        self._fault_hook = fault_hook
+        self._error_policy = (DEFAULT_ERROR_POLICY if error_policy is None
+                              else error_policy)
+
+        self.agent: Agent | None = None
+        self.env_params: trading.EnvParams | None = None
+        self._ts: TrainState | None = None
+        self._step_fn = None
+        self._snapshot: dict[str, float] = {}
+        self._snapshot_lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.restarts = 0
+        self.last_error: BaseException | None = None
+
+    # ------------------------------------------------------------------
+    # protocol: SendTrainingData (TrainerRouterActor.scala:77-81)
+    # ------------------------------------------------------------------
+
+    def send_training_data(self, prices: np.ndarray | Any) -> None:
+        self.env_params = trading.env_from_prices(
+            prices, window=self.cfg.env.window,
+            initial_budget=self.cfg.env.initial_budget,
+            initial_shares=self.cfg.env.initial_shares)
+        self.agent = build_agent(self.cfg, self.env_params)
+        self._build_step()
+        self._ts = self._place(self.agent.init(
+            jax.random.PRNGKey(self.cfg.seed)))
+        self.lifecycle.to(Phase.READY)
+        self.events.emit("training_data_received",
+                         episode_steps=trading.num_steps(self.env_params))
+        # Honor a stashed StartTraining (reference stash/unstashAll, :75-76).
+        if self.lifecycle.start_requested:
+            self.start_training(
+                background=getattr(self, "_stashed_background", True))
+
+    def _build_step(self) -> None:
+        if self._step_override is not None:
+            self._place = lambda ts: ts
+            self._step_fn = self._step_override
+        elif self.mesh is not None:
+            self._place, self._step_fn = make_parallel_step(
+                self.agent, self.mesh, data_axis=self.cfg.parallel.data_axis)
+        else:
+            self._place = lambda ts: ts
+            self._step_fn = jax.jit(self.agent.step)
+
+    # ------------------------------------------------------------------
+    # protocol: StartTraining (TrainerRouterActor.scala:86-88)
+    # ------------------------------------------------------------------
+
+    def start_training(self, *, background: bool = True) -> None:
+        if self.lifecycle.phase is Phase.AWAITING_DATA:
+            self.lifecycle.start_requested = True  # stashed until data
+            self._stashed_background = background
+            log.info("StartTraining stashed until training data arrives")
+            return
+        if self.lifecycle.phase not in (Phase.READY, Phase.COMPLETED,
+                                        Phase.TRAINED, Phase.FAILED):
+            log.info("already training; ignoring StartTraining")
+            return
+        if self.lifecycle.phase is not Phase.READY:
+            self.initialise()
+        self.lifecycle.to(Phase.TRAINING)
+        self._stop.clear()
+        if background:
+            self._thread = threading.Thread(
+                target=self._run_supervised, name="trainer", daemon=True)
+            self._thread.start()
+        else:
+            self._run_supervised()
+
+    # protocol: Initialise (TrainerChildActor.scala:57-59) — re-arm for a
+    # fresh episode keeping learned parameters.
+    def initialise(self) -> None:
+        if self.agent is None or self._ts is None:
+            return
+        fresh = self.agent.init(jax.random.PRNGKey(self.cfg.seed))
+        self._ts = self._place(fresh.replace(
+            params=self._ts.params, opt_state=self._ts.opt_state,
+            updates=self._ts.updates))
+        self.lifecycle.to(Phase.READY)
+
+    # ------------------------------------------------------------------
+    # the supervised device loop (BackoffSupervisor + Terminated respawn)
+    # ------------------------------------------------------------------
+
+    def _run_supervised(self) -> None:
+        rt = self.cfg.runtime
+        horizon = trading.num_steps(self.env_params)
+        chunk_idx = 0
+        last_ckpt_updates = 0  # reference guards iteration != 0 (:74)
+        timer = StepTimer(rt.chunk_steps, self.cfg.parallel.num_workers)
+        self.tracer.start()
+        timer.tick()
+        while not self._stop.is_set():
+            try:
+                with self.tracer.span(f"train_chunk_{chunk_idx}"):
+                    ts, metrics = self._step_fn(self._ts)
+                # Commit the new state BEFORE any hook can raise: the mesh
+                # step donates its input, so the old state is already dead.
+                self._ts = ts
+                metrics = {k: float(np.asarray(v)) for k, v in metrics.items()}
+                if self._fault_hook is not None:
+                    self._fault_hook(chunk_idx, metrics)
+                chunk_idx += 1
+                metrics.update(timer.tick())
+                with self._snapshot_lock:
+                    self._snapshot = metrics
+                self.metrics.record_many(metrics)
+
+                updates = int(metrics.get("updates", 0))
+                if (rt.checkpoint_every_updates > 0
+                        and updates // rt.checkpoint_every_updates
+                        > last_ckpt_updates // rt.checkpoint_every_updates):
+                    self.checkpoints.save(updates, self._ts)
+                    self.events.emit("checkpoint", updates=updates)
+                last_ckpt_updates = updates
+
+                if int(metrics.get("env_steps", 0)) >= horizon:
+                    self.checkpoints.save(updates, self._ts)
+                    self.lifecycle.to(Phase.TRAINED)
+                    self.lifecycle.to(Phase.COMPLETED)
+                    self.tracer.stop()
+                    self.events.emit("training_completed",
+                                     env_steps=int(metrics["env_steps"]),
+                                     **timer.summary())
+                    log.info("training completed at %d env steps", horizon)
+                    return
+            except Exception as exc:  # supervision decider
+                self.last_error = exc
+                verb = self._decide(exc)
+                self.events.emit("worker_failed", error=repr(exc), verb=verb,
+                                 restarts=self.restarts + 1)
+                if verb == RESUME:
+                    log.warning("resuming after %r (policy: resume)", exc)
+                    self._ensure_live_state()
+                    continue
+                if verb == STOP:
+                    self.lifecycle.force(Phase.FAILED)
+                    self.tracer.stop()
+                    log.error("stopping after %r (policy: stop)", exc)
+                    return
+                if verb == ESCALATE:
+                    self.lifecycle.force(Phase.FAILED)
+                    self.tracer.stop()
+                    raise
+                self.restarts += 1
+                if self.restarts > rt.max_restarts:
+                    self.lifecycle.force(Phase.FAILED)
+                    self.tracer.stop()
+                    log.error("restart budget exhausted: %r", exc)
+                    return
+                delay = min(rt.backoff_initial_s * 2 ** (self.restarts - 1),
+                            rt.backoff_max_s)
+                delay *= 1.0 + random.uniform(-rt.backoff_jitter,
+                                              rt.backoff_jitter)
+                log.warning("chunk failed (%r); restart %d/%d in %.2fs",
+                            exc, self.restarts, rt.max_restarts, delay)
+                if self._stop.wait(delay):
+                    return
+                self._restore_or_reinit()
+
+    def _ensure_live_state(self) -> None:
+        """A failure inside the donated-input step can leave self._ts holding
+        deleted buffers; resume-in-place is then impossible and we fall back
+        to restore."""
+        leaves = jax.tree.leaves(self._ts)
+        if any(getattr(l, "is_deleted", lambda: False)() for l in leaves):
+            log.warning("state was donated into the failed step; restoring")
+            self._restore_or_reinit()
+
+    def _decide(self, exc: BaseException) -> str:
+        for etype, verb in self._error_policy.items():
+            if isinstance(exc, etype):
+                return verb
+        return RESTART
+
+    def _restore_or_reinit(self) -> None:
+        """Restore the latest checkpoint, else restart the episode from
+        scratch — respawn-and-retrain (TrainerRouterActor.scala:116-120,
+        141-146)."""
+        template = self.agent.init(jax.random.PRNGKey(self.cfg.seed))
+        try:
+            state, step = self.checkpoints.restore(template)
+            self._ts = self._place(state)
+            self.events.emit("restored", step=step)
+        except FileNotFoundError:
+            self._ts = self._place(template)
+            self.events.emit("reinitialized")
+
+    # ------------------------------------------------------------------
+    # queries (IsEverythingDone / GetAvg / GetStd; ShareTradeHelper.scala:35-39)
+    # ------------------------------------------------------------------
+
+    def is_everything_done(self) -> QueryReply:
+        phase = self.lifecycle.phase
+        if phase is Phase.AWAITING_DATA:
+            return QueryReply(ReplyState.NO_TRAINING_DATA)
+        if phase in (Phase.READY, Phase.TRAINING):
+            return QueryReply(ReplyState.TRAINING_NOT_COMPLETED)
+        if phase is Phase.FAILED:
+            return QueryReply(ReplyState.NOT_COMPUTED)
+        return QueryReply(ReplyState.COMPLETED)
+
+    def _stat(self, key: str) -> QueryReply:
+        phase = self.lifecycle.phase
+        if phase is Phase.AWAITING_DATA:
+            return QueryReply(ReplyState.NO_TRAINING_DATA)
+        with self._snapshot_lock:
+            value = self._snapshot.get(key)
+        if value is None:
+            return QueryReply(ReplyState.NOT_COMPUTED)
+        # Mid-run replies use the latest chunk snapshot — progressive stats
+        # (the reference answers from whichever workers finished; here every
+        # agent contributes continuously).
+        return QueryReply(ReplyState.RESULT, value)
+
+    def get_avg(self) -> QueryReply:
+        return self._stat("portfolio_mean")
+
+    def get_std(self) -> QueryReply:
+        return self._stat("portfolio_std")
+
+    def snapshot(self) -> dict[str, float]:
+        with self._snapshot_lock:
+            return dict(self._snapshot)
+
+    # ------------------------------------------------------------------
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Join the training thread (the driver's poll loop, minus polling)."""
+        if self._thread is None:
+            return True
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    @property
+    def train_state(self) -> TrainState | None:
+        return self._ts
+
+
+def run_end_to_end(cfg: FrameworkConfig, prices, *, use_mesh: bool = False,
+                   background: bool = False) -> Orchestrator:
+    """The ShareTradeHelper main flow: data → orchestrator → train →
+    aggregate (ShareTradeHelper.scala:14-48), in one call."""
+    mesh = build_mesh(cfg.parallel) if use_mesh else None
+    orch = Orchestrator(cfg, mesh=mesh)
+    orch.start_training(background=True)   # stashed: data not sent yet
+    orch.send_training_data(prices)        # unstashes and launches
+    if not background:
+        orch.wait()
+    return orch

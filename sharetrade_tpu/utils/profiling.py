@@ -1,0 +1,104 @@
+"""Tracing/profiling — the subsystem the reference lacks entirely.
+
+Reference status (SURVEY.md §5): no tracing of any kind; TF's SummarySaver is
+imported but never used (QDecisionPolicyActor.scala:8); the only timing
+signal is a progress log every 200 fold steps. Here:
+
+- :class:`Tracer` wraps ``jax.profiler`` device traces (XPlane output,
+  viewable in TensorBoard/XProf) gated by config, with annotated host-side
+  ``TraceAnnotation`` spans so chunk boundaries show up in the timeline;
+- :class:`StepTimer` measures per-chunk wall time and derives steps/sec,
+  feeding the metrics registry (the throughput series BASELINE.md needs).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+from sharetrade_tpu.utils.logging import get_logger
+
+log = get_logger("utils.profiling")
+
+
+class Tracer:
+    """Device + host tracing around training chunks.
+
+    ``profile_dir=None`` disables everything at zero cost (the config
+    default, RuntimeConfig.profile_dir).
+    """
+
+    def __init__(self, profile_dir: str | None = None):
+        self.profile_dir = profile_dir
+        self._active = False
+
+    def start(self) -> None:
+        if self.profile_dir and not self._active:
+            jax.profiler.start_trace(self.profile_dir)
+            self._active = True
+            log.info("profiler trace started -> %s", self.profile_dir)
+
+    def stop(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            log.info("profiler trace written to %s", self.profile_dir)
+
+    @contextlib.contextmanager
+    def trace(self):
+        self.start()
+        try:
+            yield self
+        finally:
+            self.stop()
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        """Named host annotation visible in the device timeline."""
+        if self.profile_dir:
+            with jax.profiler.TraceAnnotation(name):
+                yield
+        else:
+            yield
+
+
+@dataclass
+class StepTimer:
+    """Per-chunk wall-clock accounting → steps/sec metrics."""
+
+    chunk_steps: int
+    num_agents: int
+    _last: float | None = None
+    history: list[float] = field(default_factory=list)
+
+    def tick(self) -> dict[str, float]:
+        """Call once per completed chunk; returns throughput metrics."""
+        now = time.perf_counter()
+        if self._last is None:
+            self._last = now
+            return {}
+        dt = now - self._last
+        self._last = now
+        self.history.append(dt)
+        agent_steps = self.chunk_steps * self.num_agents
+        return {
+            "chunk_seconds": dt,
+            "env_steps_per_sec": self.chunk_steps / dt if dt > 0 else 0.0,
+            "agent_steps_per_sec": agent_steps / dt if dt > 0 else 0.0,
+        }
+
+    def summary(self) -> dict[str, float]:
+        if not self.history:
+            return {}
+        total = sum(self.history)
+        chunks = len(self.history)
+        return {
+            "chunks_timed": float(chunks),
+            "total_seconds": total,
+            "mean_chunk_seconds": total / chunks,
+            "mean_agent_steps_per_sec":
+                self.chunk_steps * self.num_agents * chunks / total,
+        }

@@ -1,26 +1,51 @@
 """Test harness configuration.
 
-Tests run on a *virtual 8-device CPU mesh* — the TPU analogue of the
-reference's multi-actor-in-one-JVM TestKit strategy (SURVEY.md §4: no real
-cluster; probes at boundaries + fake devices). Real-TPU behavior is exercised
-by bench.py and the driver's graft entry, not by the unit suite.
+Environment reality (discovered, not assumed): the axon sitecustomize imports
+and initializes JAX against the real TPU chip at interpreter startup, so
+``JAX_PLATFORMS`` cannot be changed here — the unit suite runs on the TPU
+when one is attached (honest coverage: the Pallas kernels execute compiled,
+not interpreted). Multi-device sharding tests use an explicit 8-device CPU
+mesh instead: the CPU PJRT client initializes lazily, so setting
+``xla_force_host_platform_device_count`` here — before anything touches
+``jax.devices("cpu")`` — still yields 8 virtual devices (the TPU analogue of
+the reference's multi-actor-in-one-JVM TestKit strategy, SURVEY.md §4).
 
-Env vars must be set before jax is imported anywhere.
+Numeric parity assertions need f32 matmuls; the TPU default is bf16-precision
+MXU passes, so matmul precision is pinned to "highest" suite-wide (unit tests
+check correctness, not throughput).
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
 
 
 @pytest.fixture
 def tmp_journal_path(tmp_path):
     return str(tmp_path / "events.journal")
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, (
+        "expected 8 virtual CPU devices (xla_force_host_platform_device_count)")
+    return devices[:8]
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh(cpu_devices):
+    """8-device dp mesh on the virtual CPU client for sharding tests."""
+    from jax.sharding import Mesh
+    return Mesh(np.array(cpu_devices).reshape(8), ("dp",))

@@ -1,0 +1,223 @@
+"""Learner tests: schedule parity, TD math, replay semantics, every algorithm
+end-to-end under jit on a tiny environment."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sharetrade_tpu.agents import build_agent
+from sharetrade_tpu.agents.base import epsilon_greedy, exploit_probability
+from sharetrade_tpu.agents.dqn import ReplayBuffer, fill_replay_from_journal
+from sharetrade_tpu.agents.qlearn import make_qlearn_agent
+from sharetrade_tpu.config import FrameworkConfig, LearnerConfig
+from sharetrade_tpu.data.journal import Journal
+from sharetrade_tpu.env import trading
+from sharetrade_tpu.models.mlp import q_mlp
+
+WINDOW = 8
+
+
+def tiny_env(n=64, budget=500.0):
+    prices = jnp.linspace(10.0, 20.0, n)
+    return trading.env_from_prices(prices, window=WINDOW, initial_budget=budget)
+
+
+def tiny_config(algo, **learner_kw):
+    cfg = FrameworkConfig()
+    cfg.learner.algo = algo
+    for k, v in learner_kw.items():
+        setattr(cfg.learner, k, v)
+    cfg.env.window = WINDOW
+    cfg.model.hidden_dim = 16
+    cfg.model.num_layers = 1
+    cfg.model.num_heads = 2
+    cfg.model.head_dim = 8
+    cfg.parallel.num_workers = 4
+    cfg.runtime.chunk_steps = 8
+    cfg.learner.unroll_len = 8
+    cfg.learner.replay_capacity = 256
+    cfg.learner.replay_batch = 16
+    return cfg
+
+
+class TestEpsilonSchedule:
+    """QDecisionPolicyActor.scala:58: exploit iff rand < min(0.9, step/1000)."""
+
+    def test_ramp_values(self):
+        cfg = LearnerConfig()
+        for step, want in [(0, 0.0), (500, 0.5), (900, 0.9), (5000, 0.9)]:
+            got = float(exploit_probability(jnp.int32(step), cfg))
+            assert got == pytest.approx(want), step
+
+    def test_step_zero_is_uniform_random(self):
+        # At step 0 exploit prob is 0: action never comes from argmax.
+        cfg = LearnerConfig()
+        q = jnp.array([100.0, -100.0, -100.0])  # argmax = 0, overwhelmingly
+        keys = jax.random.split(jax.random.PRNGKey(0), 300)
+        acts = jax.vmap(lambda k: epsilon_greedy(k, q, jnp.int32(0), cfg))(keys)
+        counts = np.bincount(np.asarray(acts), minlength=3)
+        assert (counts > 50).all()  # all three actions occur ~uniformly
+
+    def test_late_steps_mostly_greedy(self):
+        cfg = LearnerConfig()
+        q = jnp.array([-5.0, 10.0, -5.0])
+        keys = jax.random.split(jax.random.PRNGKey(1), 300)
+        acts = jax.vmap(lambda k: epsilon_greedy(k, q, jnp.int32(10_000), cfg))(keys)
+        frac_greedy = float(np.mean(np.asarray(acts) == 1))
+        assert 0.85 < frac_greedy < 0.99  # ~ 0.9 + 0.1/3
+
+
+class TestQLearnTD:
+    def _run_one_step(self, update_taken_action):
+        env_params = tiny_env()
+        cfg = LearnerConfig(update_taken_action=update_taken_action)
+        model = q_mlp(obs_dim=WINDOW + 2, hidden_dim=4, parity=True)
+        agent = make_qlearn_agent(model, env_params, cfg,
+                                  num_agents=1, steps_per_chunk=1)
+        ts = agent.init(jax.random.PRNGKey(42))
+        ts2, metrics = jax.jit(agent.step)(ts)
+        return ts, ts2, metrics, model, env_params, cfg
+
+    def test_one_step_matches_independent_computation(self):
+        ts, ts2, metrics, model, env_params, cfg = self._run_one_step(True)
+
+        # Replicate the step with straight-line code (no scan, no masking).
+        rng, k_act = jax.random.split(ts.rng)
+        act_key = jax.random.split(k_act, 1)[0]
+        obs = trading.observe(env_params, jax.tree.map(lambda x: x[0], ts.env_state))
+        q_s, _ = model.apply(ts.params, obs, ())
+        action = epsilon_greedy(act_key, q_s.logits, ts.env_steps, cfg)
+        env1, reward = trading.step(
+            env_params, jax.tree.map(lambda x: x[0], ts.env_state), action)
+        next_obs = trading.observe(env_params, env1)
+
+        def loss(params):
+            q, _ = model.apply(params, obs, ())
+            qn, _ = model.apply(params, next_obs, ())
+            target = reward + cfg.gamma * jnp.max(jax.lax.stop_gradient(qn.logits))
+            return jnp.square(q.logits[action] - target)
+
+        grads = jax.grad(loss)(ts.params)
+        opt = optax.adagrad(cfg.learning_rate)
+        updates, _ = opt.update(grads, opt.init(ts.params), ts.params)
+        want = optax.apply_updates(ts.params, updates)
+
+        for got_leaf, want_leaf in zip(jax.tree.leaves(ts2.params),
+                                       jax.tree.leaves(want)):
+            np.testing.assert_allclose(np.asarray(got_leaf),
+                                       np.asarray(want_leaf), rtol=1e-5, atol=1e-6)
+        assert int(ts2.updates) == 1 and int(ts2.env_steps) == 1
+
+    def test_bug_parity_mode_differs(self):
+        # The reference updates the NEXT state's argmax index
+        # (QDecisionPolicyActor.scala:69-71); textbook updates the taken
+        # action. With enough steps the two must produce different params.
+        def run(taken):
+            env_params = tiny_env()
+            cfg = LearnerConfig(update_taken_action=taken)
+            # parity=False: the parity head's output ReLU can kill every
+            # gradient at tiny widths, making the two modes trivially equal.
+            model = q_mlp(obs_dim=WINDOW + 2, hidden_dim=4, parity=False)
+            agent = make_qlearn_agent(model, env_params, cfg,
+                                      num_agents=2, steps_per_chunk=20)
+            ts0 = agent.init(jax.random.PRNGKey(7))
+            ts, _ = jax.jit(agent.step)(ts0)
+            return ts0.params, ts.params
+
+        p0, p_fixed = run(True)
+        _, p_bug = run(False)
+        trained = [float(np.abs(np.asarray(a) - np.asarray(b)).max())
+                   for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p_fixed))]
+        assert max(trained) > 0, "training was a no-op; test is vacuous"
+        diffs = [float(np.abs(np.asarray(a) - np.asarray(b)).max())
+                 for a, b in zip(jax.tree.leaves(p_fixed), jax.tree.leaves(p_bug))]
+        assert max(diffs) > 0
+
+    def test_horizon_freeze(self):
+        # Chunks past episode end must not step envs or update params.
+        env_params = tiny_env(n=WINDOW + 3)  # 3-step episode
+        cfg = LearnerConfig()
+        model = q_mlp(obs_dim=WINDOW + 2, hidden_dim=4)
+        agent = make_qlearn_agent(model, env_params, cfg,
+                                  num_agents=2, steps_per_chunk=10)
+        ts = agent.init(jax.random.PRNGKey(0))
+        ts, _ = jax.jit(agent.step)(ts)
+        assert int(ts.env_steps) == 3
+        assert int(ts.updates) == 3
+        assert np.asarray(ts.env_state.t).tolist() == [3, 3]
+        ts2, _ = jax.jit(agent.step)(ts)
+        assert int(ts2.env_steps) == 3  # fully frozen
+        for a, b in zip(jax.tree.leaves(ts.params), jax.tree.leaves(ts2.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestReplayBuffer:
+    def test_push_wraps_and_masks(self):
+        rb = ReplayBuffer.create(8, 3)
+        obs = jnp.arange(12.0).reshape(4, 3)
+        rb = rb.push(obs, jnp.zeros(4, jnp.int32), jnp.ones(4),
+                     obs + 100, jnp.array([True, True, False, True]))
+        assert int(rb.size) == 3 and int(rb.pos) == 3
+        # Valid rows compacted: rows 0, 1, 3 stored.
+        np.testing.assert_allclose(np.asarray(rb.obs[:3, 0]), [0.0, 3.0, 9.0])
+        for _ in range(3):
+            rb = rb.push(obs, jnp.zeros(4, jnp.int32), jnp.ones(4),
+                         obs + 100, jnp.ones(4, bool))
+        assert int(rb.size) == 8  # capacity-clamped
+        assert int(rb.pos) == (3 + 12) % 8
+
+    def test_sample_in_range(self):
+        rb = ReplayBuffer.create(16, 2)
+        rb = rb.push(jnp.ones((4, 2)), jnp.ones(4, jnp.int32) * 2,
+                     jnp.ones(4), jnp.zeros((4, 2)), jnp.ones(4, bool))
+        o, a, r, n = rb.sample(jax.random.PRNGKey(0), 32)
+        assert o.shape == (32, 2) and (np.asarray(a) == 2).all()
+
+    def test_journal_fill(self, tmp_journal_path):
+        with Journal(tmp_journal_path) as j:
+            j.append({"type": "transitions",
+                      "obs": [[1.0, 2.0]], "action": [1],
+                      "reward": [0.5], "next_obs": [[3.0, 4.0]]})
+            rb = fill_replay_from_journal(ReplayBuffer.create(4, 2), j)
+        assert int(rb.size) == 1
+        np.testing.assert_allclose(np.asarray(rb.obs[0]), [1.0, 2.0])
+
+
+@pytest.mark.parametrize("algo", ["qlearn", "pg", "dqn", "a2c", "ppo"])
+def test_every_algorithm_trains_a_chunk(algo):
+    cfg = tiny_config(algo)
+    env_params = tiny_env()
+    agent = build_agent(cfg, env_params)
+    ts = agent.init(jax.random.PRNGKey(0))
+    step = jax.jit(agent.step)
+    ts2, metrics = step(ts)
+    # Params changed, counters advanced, metrics finite.
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(ts.params), jax.tree.leaves(ts2.params)))
+    assert changed, f"{algo}: params did not change"
+    assert int(ts2.env_steps) > 0
+    assert int(ts2.updates) > 0
+    for k, v in metrics.items():
+        assert np.isfinite(np.asarray(v)).all(), f"{algo}: {k} not finite"
+    # Second chunk composes (scan carry shapes stable).
+    ts3, _ = step(ts2)
+    assert int(ts3.env_steps) >= int(ts2.env_steps)
+
+
+@pytest.mark.parametrize("kind", ["lstm", "transformer"])
+def test_recurrent_and_attention_policies_with_ppo(kind):
+    cfg = tiny_config("ppo")
+    cfg.model.kind = kind
+    env_params = tiny_env()
+    agent = build_agent(cfg, env_params)
+    ts = agent.init(jax.random.PRNGKey(0))
+    ts2, metrics = jax.jit(agent.step)(ts)
+    assert np.isfinite(float(metrics["loss"]))
+    if kind == "lstm":
+        # Carry must have evolved over the unroll.
+        h0 = np.asarray(ts.carry[0])
+        h1 = np.asarray(ts2.carry[0])
+        assert not np.allclose(h0, h1)

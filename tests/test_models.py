@@ -1,0 +1,118 @@
+"""Model zoo: shapes, reference-parity properties, transform-friendliness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sharetrade_tpu.config import ModelConfig
+from sharetrade_tpu.models import build_model
+from sharetrade_tpu.models.mlp import ac_mlp, q_mlp
+
+OBS_DIM = 203
+
+
+def _obs(key):
+    return jax.random.uniform(key, (OBS_DIM,), minval=0.0, maxval=100.0)
+
+
+class TestQMLPParity:
+    """Architecture parity with QDecisionPolicyActor.scala:38-50."""
+
+    def test_param_shapes_match_reference_graph(self):
+        model = q_mlp(parity=True)
+        params = model.init(jax.random.PRNGKey(0))
+        assert params["layer1"]["w"].shape == (203, 200)  # w1
+        assert params["layer2"]["w"].shape == (200, 3)    # w2
+        # Biases are tf.constant in the reference -> not trainable params.
+        assert "b" not in params["layer1"] and "b" not in params["layer2"]
+        n = sum(p.size for p in jax.tree.leaves(params))
+        assert n == 203 * 200 + 200 * 3  # ~41.2k (SURVEY.md §6)
+
+    def test_output_relu_clamps_at_zero(self):
+        # Reference: q = relu(...) — Q-values can never go negative.
+        model = q_mlp(parity=True)
+        params = model.init(jax.random.PRNGKey(1))
+        out, _ = model.apply(params, _obs(jax.random.PRNGKey(2)), ())
+        assert out.logits.shape == (3,)
+        assert bool(jnp.all(out.logits >= 0.0))
+
+    def test_forward_matches_hand_computed(self):
+        model = q_mlp(obs_dim=4, hidden_dim=2, num_actions=3, parity=True)
+        params = {"layer1": {"w": jnp.ones((4, 2))},
+                  "layer2": {"w": jnp.ones((2, 3)) * 0.5}}
+        obs = jnp.array([1.0, 2.0, 3.0, 4.0])
+        out, _ = model.apply(params, obs, ())
+        # h = relu(10 + 0.1) = 10.1 each; q = relu(10.1*2*0.5 + 0.1) = 10.2
+        np.testing.assert_allclose(np.asarray(out.logits), [10.2] * 3, rtol=1e-6)
+
+    def test_non_parity_has_trainable_biases_and_no_output_relu(self):
+        model = q_mlp(parity=False)
+        params = model.init(jax.random.PRNGKey(0))
+        assert "b" in params["layer1"] and "b" in params["layer2"]
+
+
+class TestHeads:
+    @pytest.mark.parametrize("kind", ["mlp", "lstm", "transformer"])
+    def test_build_apply_shapes(self, kind):
+        cfg = ModelConfig(kind=kind, hidden_dim=32, num_layers=1,
+                          num_heads=2, head_dim=16)
+        model = build_model(cfg, OBS_DIM)
+        params = model.init(jax.random.PRNGKey(0))
+        out, carry = model.apply(params, _obs(jax.random.PRNGKey(1)),
+                                 model.init_carry())
+        assert out.logits.shape == (3,)
+        assert out.value.shape == ()
+        assert jnp.isfinite(out.logits).all()
+
+    def test_lstm_carry_evolves_and_affects_output(self):
+        cfg = ModelConfig(kind="lstm", hidden_dim=16)
+        model = build_model(cfg, OBS_DIM)
+        params = model.init(jax.random.PRNGKey(0))
+        obs = _obs(jax.random.PRNGKey(1))
+        out1, carry1 = model.apply(params, obs, model.init_carry())
+        out2, carry2 = model.apply(params, obs, carry1)
+        assert not np.allclose(np.asarray(carry1[0]), np.asarray(carry2[0]))
+        assert not np.allclose(np.asarray(out1.logits), np.asarray(out2.logits))
+
+    def test_transformer_scale_invariance(self):
+        # Price normalization: scaling the whole window (and budget) by 10x
+        # must leave the policy's decision unchanged.
+        cfg = ModelConfig(kind="transformer", num_layers=1, num_heads=2, head_dim=16)
+        model = build_model(cfg, OBS_DIM)
+        params = model.init(jax.random.PRNGKey(0))
+        prices = jnp.linspace(50.0, 60.0, 201)
+        obs1 = jnp.concatenate([prices, jnp.array([2400.0, 3.0])])
+        obs2 = jnp.concatenate([prices * 10, jnp.array([24000.0, 3.0])])
+        out1, _ = model.apply(params, obs1, ())
+        out2, _ = model.apply(params, obs2, ())
+        np.testing.assert_allclose(np.asarray(out1.logits),
+                                   np.asarray(out2.logits), rtol=1e-4)
+
+    def test_vmap_over_agent_batch(self):
+        model = ac_mlp(OBS_DIM, 32)
+        params = model.init(jax.random.PRNGKey(0))
+        obs_batch = jax.random.uniform(jax.random.PRNGKey(1), (8, OBS_DIM))
+        outs, _ = jax.vmap(lambda o: model.apply(params, o, ()))(obs_batch)
+        assert outs.logits.shape == (8, 3)
+
+    def test_gradients_flow(self):
+        model = ac_mlp(OBS_DIM, 16)
+        params = model.init(jax.random.PRNGKey(0))
+        obs = _obs(jax.random.PRNGKey(1))
+
+        def loss(p):
+            out, _ = model.apply(p, obs, ())
+            return jnp.sum(out.logits ** 2) + out.value ** 2
+
+        grads = jax.grad(loss)(params)
+        norms = [float(jnp.linalg.norm(g)) for g in jax.tree.leaves(grads)]
+        assert all(np.isfinite(norms)) and any(n > 0 for n in norms)
+
+    def test_bfloat16_compute(self):
+        cfg = ModelConfig(kind="mlp", hidden_dim=32, dtype="bfloat16")
+        model = build_model(cfg, OBS_DIM)
+        params = model.init(jax.random.PRNGKey(0))
+        out, _ = model.apply(params, _obs(jax.random.PRNGKey(1)), ())
+        # Heads cast back to f32 for numerics downstream (TD targets etc).
+        assert out.logits.dtype == jnp.float32

@@ -1,0 +1,43 @@
+"""Tracing/profiling subsystem (SURVEY.md §5: absent in reference, required here)."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+
+from sharetrade_tpu.utils.profiling import StepTimer, Tracer
+
+
+class TestStepTimer:
+    def test_first_tick_is_baseline(self):
+        t = StepTimer(chunk_steps=10, num_agents=4)
+        assert t.tick() == {}
+        m = t.tick()
+        assert m["chunk_seconds"] > 0
+        assert m["agent_steps_per_sec"] > 0
+        assert t.summary()["chunks_timed"] == 1.0
+
+    def test_rates_consistent(self):
+        t = StepTimer(chunk_steps=100, num_agents=10)
+        t.tick()
+        m = t.tick()
+        assert abs(m["agent_steps_per_sec"] / m["env_steps_per_sec"] - 10.0) < 1e-6
+
+
+class TestTracer:
+    def test_disabled_is_noop(self):
+        tracer = Tracer(None)
+        with tracer.trace():
+            with tracer.span("x"):
+                pass  # no profiler started, no error
+
+    def test_device_trace_written(self, tmp_path):
+        tracer = Tracer(str(tmp_path))
+        with tracer.trace():
+            with tracer.span("matmul"):
+                x = jnp.ones((64, 64))
+                jax.block_until_ready(x @ x)
+        # jax.profiler writes xplane protos under plugins/profile/<ts>/.
+        found = glob.glob(str(tmp_path / "**" / "*.xplane.pb"), recursive=True)
+        assert found, f"no xplane trace under {tmp_path}: {os.listdir(tmp_path)}"

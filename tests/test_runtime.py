@@ -1,0 +1,217 @@
+"""Orchestrator lifecycle, mid-run queries, supervision & chaos tests.
+
+Mirrors TrainerRouterActorSpec (SURVEY.md §4): the ML backend is stubbed at
+the same seam (``step_override`` = the anonymous-subclass ``train()``
+override), lifecycle queries are asserted in every phase, and failures are
+injected mid-run to assert self-healing.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sharetrade_tpu.checkpoint import CheckpointManager
+from sharetrade_tpu.config import FrameworkConfig
+from sharetrade_tpu.runtime import (
+    ESCALATE, RESUME, STOP, Orchestrator, Phase, QueryReply, ReplyState,
+    run_end_to_end,
+)
+from sharetrade_tpu.runtime.lifecycle import Lifecycle
+
+WINDOW = 8
+PRICES = np.linspace(10.0, 20.0, 72, dtype=np.float32)  # 64-step episode
+
+
+def fast_cfg(tmp_path, algo="qlearn"):
+    cfg = FrameworkConfig()
+    cfg.learner.algo = algo
+    cfg.env.window = WINDOW
+    cfg.model.hidden_dim = 8
+    cfg.parallel.num_workers = 4
+    cfg.runtime.chunk_steps = 16
+    cfg.runtime.checkpoint_every_updates = 32
+    cfg.runtime.checkpoint_dir = str(tmp_path / "ckpts")
+    cfg.runtime.backoff_initial_s = 0.01
+    cfg.runtime.backoff_max_s = 0.05
+    cfg.runtime.max_restarts = 3
+    return cfg
+
+
+class TestLifecycleFSM:
+    def test_legal_path(self):
+        lc = Lifecycle()
+        for phase in [Phase.READY, Phase.TRAINING, Phase.TRAINED,
+                      Phase.COMPLETED, Phase.READY]:
+            lc.to(phase)
+        assert lc.phase is Phase.READY
+
+    def test_illegal_transition_rejected(self):
+        lc = Lifecycle()
+        with pytest.raises(RuntimeError, match="illegal"):
+            lc.to(Phase.TRAINED)
+
+
+class TestQueriesPerPhase:
+    """The reply protocol per phase (TrainerRouterActorSpec:46-79)."""
+
+    def test_before_data(self, tmp_path):
+        orch = Orchestrator(fast_cfg(tmp_path))
+        assert orch.is_everything_done().state is ReplyState.NO_TRAINING_DATA
+        assert orch.get_avg().state is ReplyState.NO_TRAINING_DATA
+        assert orch.get_std().state is ReplyState.NO_TRAINING_DATA
+
+    def test_start_training_stashed_until_data(self, tmp_path):
+        # StartTraining before data must not crash and must fire once data
+        # arrives (stash/unstashAll, TrainerRouterActor.scala:75-81).
+        orch = Orchestrator(fast_cfg(tmp_path))
+        orch.start_training(background=False)
+        assert orch.lifecycle.phase is Phase.AWAITING_DATA
+        orch.send_training_data(PRICES)  # unstashes; runs inline to completion
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+
+    def test_after_data_before_training(self, tmp_path):
+        orch = Orchestrator(fast_cfg(tmp_path))
+        orch.send_training_data(PRICES)
+        assert orch.is_everything_done().state is ReplyState.TRAINING_NOT_COMPLETED
+        assert orch.get_avg().state is ReplyState.NOT_COMPUTED
+
+    def test_completed_serves_results(self, tmp_path):
+        orch = run_end_to_end(fast_cfg(tmp_path), PRICES)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        avg, std = orch.get_avg(), orch.get_std()
+        assert avg.ok and std.ok
+        assert avg.value > 0 and std.value >= 0
+        assert repr(avg).startswith("Result(")
+
+
+class TestMidRunQueries:
+    def test_query_during_training_not_blocking(self, tmp_path):
+        """GetAvg mid-run answers from the latest snapshot without stopping
+        the device loop (TrainerRouterActorSpec:81-95)."""
+        cfg = fast_cfg(tmp_path)
+        gate = threading.Event()
+        seen_mid_run: list[QueryReply] = []
+
+        def slow_step(ts):
+            gate.wait(5)
+            import sharetrade_tpu.agents as agents_mod
+            return real_step(ts)
+
+        orch = Orchestrator(cfg)
+        orch.send_training_data(PRICES)
+        # Build the real step AFTER data arrival, wrap it with a gate.
+        real_step = jax.jit(orch.agent.step)
+        orch._step_fn = slow_step
+
+        orch.start_training(background=True)
+        time.sleep(0.05)
+        seen_mid_run.append(orch.is_everything_done())
+        seen_mid_run.append(orch.get_avg())
+        gate.set()
+        assert orch.wait(30)
+        assert seen_mid_run[0].state is ReplyState.TRAINING_NOT_COMPLETED
+        # First chunk hadn't finished: NotComputed is the honest mid-run reply.
+        assert seen_mid_run[1].state is ReplyState.NOT_COMPUTED
+        assert orch.get_avg().ok
+
+
+class TestSupervision:
+    def test_fault_injection_heals_and_completes(self, tmp_path):
+        """Kill the trainer mid-run; it must restart with backoff, restore
+        from checkpoint, and still complete (TrainerRouterActorSpec:97-115)."""
+        cfg = fast_cfg(tmp_path)
+        fail_at = {1}
+
+        def chaos(chunk_idx, metrics):
+            if chunk_idx in fail_at:
+                fail_at.discard(chunk_idx)
+                raise RuntimeError("injected PoisonPill")
+
+        orch = Orchestrator(cfg, fault_hook=chaos)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.restarts == 1
+        assert orch.get_avg().ok
+
+    def test_restart_budget_exhaustion_fails(self, tmp_path):
+        cfg = fast_cfg(tmp_path)
+
+        def always_fail(chunk_idx, metrics):
+            raise RuntimeError("persistent failure")
+
+        orch = Orchestrator(cfg, fault_hook=always_fail)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.lifecycle.phase is Phase.FAILED
+        assert orch.restarts == cfg.runtime.max_restarts + 1
+        assert orch.is_everything_done().state is ReplyState.NOT_COMPUTED
+
+    def test_error_policy_stop(self, tmp_path):
+        cfg = fast_cfg(tmp_path)
+
+        def bad(chunk_idx, metrics):
+            raise ValueError("bad input")  # policy: stop (IllegalArgument analogue)
+
+        orch = Orchestrator(cfg, fault_hook=bad)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.lifecycle.phase is Phase.FAILED
+        assert orch.restarts == 0  # stopped, not restarted
+
+    def test_error_policy_resume(self, tmp_path):
+        cfg = fast_cfg(tmp_path)
+        hits = []
+
+        def flaky(chunk_idx, metrics):
+            if chunk_idx == 0 and not hits:
+                hits.append(1)
+                raise ArithmeticError("transient")  # policy: resume
+
+        orch = Orchestrator(cfg, fault_hook=flaky)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.restarts == 0  # resumed in place
+
+
+class TestStubbedStepSeam:
+    def test_lifecycle_without_ml(self, tmp_path):
+        """Full lifecycle with fake compute — the TestKit seam where
+        train() is overridden to sleep-and-return-10.0
+        (TrainerRouterActorSpec:144-153)."""
+        cfg = fast_cfg(tmp_path)
+        horizon = len(PRICES) - WINDOW
+        calls = []
+
+        def fake_step(ts):
+            calls.append(1)
+            steps = min(len(calls) * cfg.runtime.chunk_steps, horizon)
+            return ts, {"env_steps": float(steps), "updates": float(steps),
+                        "portfolio_mean": 10.0, "portfolio_std": 0.0}
+
+        orch = Orchestrator(cfg, step_override=fake_step)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        # avg 10.0, std 0.0 — the spec's expected aggregation (:65-79).
+        assert orch.get_avg() == QueryReply(ReplyState.RESULT, 10.0)
+        assert orch.get_std() == QueryReply(ReplyState.RESULT, 0.0)
+
+
+class TestInitialise:
+    def test_retrain_keeps_params(self, tmp_path):
+        orch = run_end_to_end(fast_cfg(tmp_path), PRICES)
+        params_after = jax.device_get(orch.train_state.params)
+        orch.initialise()
+        assert orch.lifecycle.phase is Phase.READY
+        assert int(orch.train_state.env_state.t[0]) == 0  # cursor reset
+        for a, b in zip(jax.tree.leaves(params_after),
+                        jax.tree.leaves(jax.device_get(orch.train_state.params))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
