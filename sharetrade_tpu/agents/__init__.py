@@ -42,6 +42,12 @@ def build_agent(cfg: FrameworkConfig, env_params: trading.EnvParams,
     if algo not in _FACTORIES:
         raise ValueError(f"unknown learner.algo {algo!r}; "
                          f"choose from {sorted(_FACTORIES)}")
+    if _HEADS[algo] == "q" and cfg.model.kind != "mlp":
+        # Value-based learners drive a stateless Q-head; recurrent/attention
+        # policies go through the actor-critic algorithms (a2c/ppo/pg).
+        raise ValueError(
+            f"learner.algo={algo!r} requires model.kind='mlp' "
+            f"(got {cfg.model.kind!r}); use a2c/ppo for {cfg.model.kind} policies")
     if model is None:
         obs_dim = cfg.env.window + 2
         model = build_model(cfg.model, obs_dim, head=_HEADS[algo])

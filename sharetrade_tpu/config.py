@@ -100,6 +100,8 @@ class RuntimeConfig:
 
     chunk_steps: int = 200             # device steps per host visit (progress cadence;
                                        # reference logs every 200 fold steps)
+    episodes: int = 1                  # replays of the price history (reference: 1;
+                                       # Initialise re-arms for more, TrainerChildActor.scala:57-59)
     checkpoint_every_updates: int = 500  # reference cadence (stubbed there, real here)
     checkpoint_dir: str = "checkpoints"
     keep_checkpoints: int = 3

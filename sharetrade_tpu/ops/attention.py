@@ -50,15 +50,19 @@ def reference_attention(q, k, v, *, causal: bool = True, sm_scale: float | None 
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  sm_scale: float, seq_len: int, kv_len: int):
-    """One (batch*head, q-block) program: online-softmax over K blocks."""
+                  sm_scale: float, kv_len: int, kv_pad: int):
+    """One (batch*head, q-block) program: online-softmax over K blocks.
+
+    ``kv_len`` is the true key count (padding columns beyond it are masked);
+    ``kv_pad`` is the padded extent the loop tiles over.
+    """
     q_block = q_ref.shape[1]
     head_dim = q_ref.shape[2]
     qi = pl.program_id(1)
 
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d)
 
-    num_k_blocks = pl.cdiv(kv_len, block_k)
+    num_k_blocks = pl.cdiv(kv_pad, block_k)
     if causal:
         # Blocks entirely above the causal frontier contribute nothing.
         last_row = (qi + 1) * q_block - 1
@@ -75,7 +79,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
 
         col_ids = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (q_block, block_k), 1)
-        mask = col_ids < seq_len  # padding columns are not real keys
+        mask = col_ids < kv_len  # padding columns are not real keys
         if causal:
             mask = mask & (col_ids <= row_ids)
         s = jnp.where(mask, s, _NEG_INF)
@@ -111,6 +115,11 @@ def _pad_to(x, axis, multiple):
 def _flash_forward(q, k, v, causal: bool, sm_scale: float, interpret: bool):
     batch, heads, seq_len, head_dim = q.shape
     kv_len = k.shape[2]
+    if causal and kv_len != seq_len:
+        # Causal alignment between unequal q/kv lengths is ambiguous
+        # (prefix vs suffix); refuse rather than guess.
+        raise ValueError(
+            f"causal attention requires q_len == kv_len, got {seq_len} vs {kv_len}")
 
     qp = _pad_to(_pad_to(q, 2, BLOCK_Q), 3, LANE)
     kp = _pad_to(_pad_to(k, 2, BLOCK_K), 3, LANE)
@@ -124,7 +133,7 @@ def _flash_forward(q, k, v, causal: bool, sm_scale: float, interpret: bool):
 
     kernel = functools.partial(
         _flash_kernel, block_k=BLOCK_K, causal=causal,
-        sm_scale=sm_scale, seq_len=seq_len, kv_len=kv_pad)
+        sm_scale=sm_scale, kv_len=kv_len, kv_pad=kv_pad)
 
     out = pl.pallas_call(
         kernel,

@@ -13,6 +13,12 @@ from sharetrade_tpu.parallel.collectives import (  # noqa: F401
     ring_shift,
 )
 from sharetrade_tpu.parallel.mesh import AXIS_ORDER, build_mesh, init_distributed  # noqa: F401
+from sharetrade_tpu.parallel.moe import (  # noqa: F401
+    init_moe_params,
+    moe_apply,
+    moe_apply_sharded,
+)
+from sharetrade_tpu.parallel.pipeline import pipeline_apply, stack_stage_params  # noqa: F401
 from sharetrade_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_attention_sharded,

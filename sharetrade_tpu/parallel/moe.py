@@ -1,0 +1,104 @@
+"""Expert parallelism: a mixture-of-experts layer sharded over the ``ep`` axis.
+
+Absent from the reference (SURVEY.md §2.2 lists EP as none) — supplied here
+as the mechanism: E expert MLPs live E/ep-per-device on the ``ep`` axis; a
+replicated top-1 gate routes each token; every device evaluates its resident
+experts on the full token batch under the routing mask and a ``psum``
+combines the (disjoint) contributions. Communication is one all-reduce of the
+token activations — the dense-mask scheme, chosen over capacity-bucketed
+all_to_all dispatch because it is shape-static, load-balance-oblivious, and
+exact (no token dropping); an all_to_all dispatch path is the natural later
+optimization once expert counts grow.
+
+An auxiliary load-balancing loss (mean-importance · mean-load, the standard
+switch-style regularizer) is returned alongside the output.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def init_moe_params(key: jax.Array, num_experts: int, in_dim: int,
+                    hidden_dim: int, *, dtype=jnp.float32) -> dict:
+    k_gate, k_in, k_out = jax.random.split(key, 3)
+    s_in = jnp.sqrt(2.0 / in_dim).astype(dtype)
+    s_hid = jnp.sqrt(2.0 / hidden_dim).astype(dtype)
+    return {
+        "gate": jax.random.normal(k_gate, (in_dim, num_experts), dtype) * 0.01,
+        "w_in": jax.random.normal(
+            k_in, (num_experts, in_dim, hidden_dim), dtype) * s_in,
+        "w_out": jax.random.normal(
+            k_out, (num_experts, hidden_dim, in_dim), dtype) * s_hid,
+    }
+
+
+def moe_apply(params: dict, tokens: jax.Array):
+    """Single-device reference: top-1 MoE over (N, in_dim) tokens.
+
+    Returns (output (N, in_dim), aux_loss scalar)."""
+    logits = tokens @ params["gate"]                        # (N, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    choice = jnp.argmax(logits, axis=-1)                    # (N,)
+    num_experts = params["gate"].shape[-1]
+    onehot = jax.nn.one_hot(choice, num_experts, dtype=tokens.dtype)
+    weight = jnp.sum(probs * onehot, axis=-1)               # gate value of pick
+
+    # Dense-mask evaluation: h[e] = relu(x @ w_in[e]) @ w_out[e], masked.
+    h = jnp.einsum("ni,eih->enh", tokens, params["w_in"],
+                   preferred_element_type=jnp.float32).astype(tokens.dtype)
+    h = jax.nn.relu(h)
+    y = jnp.einsum("enh,ehi->eni", h, params["w_out"],
+                   preferred_element_type=jnp.float32).astype(tokens.dtype)
+    out = jnp.einsum("eni,ne->ni", y, onehot) * weight[:, None]
+
+    # Switch-style load-balance loss: E * sum_e importance_e * load_e.
+    importance = jnp.mean(probs, axis=0)
+    load = jnp.mean(onehot, axis=0)
+    aux = num_experts * jnp.sum(importance * load)
+    return out, aux
+
+
+def moe_apply_sharded(params: dict, tokens: jax.Array, mesh: Mesh,
+                      *, axis: str = "ep"):
+    """Expert-parallel evaluation: experts sharded over ``axis``, tokens and
+    gate replicated, contributions psum-combined. Numerically identical to
+    :func:`moe_apply`."""
+    num_experts = params["gate"].shape[-1]
+    ep = mesh.shape[axis]
+    if num_experts % ep != 0:
+        raise ValueError(f"num_experts={num_experts} not divisible by "
+                         f"{axis}={ep}")
+
+    def local_fn(gate, w_in, w_out, toks):
+        logits = toks @ gate                                # replicated (N, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        choice = jnp.argmax(logits, axis=-1)
+        onehot = jax.nn.one_hot(choice, num_experts, dtype=toks.dtype)
+        weight = jnp.sum(probs * onehot, axis=-1)
+
+        # This device's expert slice: global ids [lo, lo + E/ep).
+        local_e = num_experts // ep
+        lo = jax.lax.axis_index(axis) * local_e
+        local_mask = jax.lax.dynamic_slice_in_dim(onehot, lo, local_e, axis=1)
+
+        h = jnp.einsum("ni,eih->enh", toks, w_in,
+                       preferred_element_type=jnp.float32).astype(toks.dtype)
+        h = jax.nn.relu(h)
+        y = jnp.einsum("enh,ehi->eni", h, w_out,
+                       preferred_element_type=jnp.float32).astype(toks.dtype)
+        partial = jnp.einsum("eni,ne->ni", y, local_mask) * weight[:, None]
+        out = jax.lax.psum(partial, axis)                   # disjoint -> exact
+
+        importance = jnp.mean(probs, axis=0)
+        load = jnp.mean(onehot, axis=0)
+        aux = num_experts * jnp.sum(importance * load)
+        return out, aux
+
+    return jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P()),
+        out_specs=(P(), P()),
+    )(params["gate"], params["w_in"], params["w_out"], tokens)

@@ -1,0 +1,82 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the ``pp`` axis.
+
+No analogue exists in the reference (its model is a 2-layer MLP in one
+process; SURVEY.md §2.2 lists PP as absent) — this supplies the mechanism so
+deep stacks scale across chips: consecutive layer groups ("stages") live on
+consecutive devices of the ``pp`` mesh axis, activations flow stage→stage via
+``ppermute`` (one ICI hop per schedule tick), and M microbatches keep every
+stage busy after an S-tick fill. Per-device parameter memory drops by the
+pipeline factor; the bubble fraction is (S-1)/(M+S-1).
+
+The schedule is data-oblivious (a static Python loop of M+S-1 ticks inside
+one jit), so XLA sees straight-line code with S-fold smaller matmuls — no
+dynamic control flow (XLA-semantics rule: no data-dependent Python control
+flow under jit).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, mesh: Mesh,
+                   *, axis: str = "pp"):
+    """Run ``microbatches`` through ``num_stages`` pipelined stages.
+
+    - ``stage_fn(params, x) -> x``: one stage's forward (same signature for
+      every stage; heterogeneous stacks encode choice inside params).
+    - ``stage_params``: pytree whose leaves have leading dim ``num_stages``
+      (stage i's slice lives on pp-device i).
+    - ``microbatches``: array of shape (M, ...) — M microbatches, replicated
+      across ``axis`` (each stage reads only the ticks it owns).
+
+    Returns the (M, ...) outputs, identical on every ``axis`` device.
+    """
+    num_stages = mesh.shape[axis]
+    num_micro = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+
+    def local_fn(params_local, mb_local):
+        # params_local: this stage's params (leading dim stripped by the
+        # sharding: (1, ...) -> squeeze); mb_local: full (M, ...) batch.
+        params_here = jax.tree.map(lambda x: x[0], params_local)
+        stage = jax.lax.axis_index(axis)
+        fwd = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+        state = jnp.zeros(mb_shape, microbatches.dtype)
+        out = jnp.zeros((num_micro,) + mb_shape, microbatches.dtype)
+
+        for t in range(num_micro + num_stages - 1):
+            # Stage 0 ingests microbatch t on ticks 0..M-1.
+            feed_idx = min(t, num_micro - 1)
+            state = jnp.where(stage == 0,
+                              jnp.where(t < num_micro,
+                                        mb_local[feed_idx], state),
+                              state)
+            state = stage_fn(params_here, state)
+            # Last stage emits microbatch t-(S-1) on ticks S-1..M+S-2.
+            emit = t - (num_stages - 1)
+            if emit >= 0:
+                out = jnp.where(
+                    (stage == num_stages - 1),
+                    out.at[emit].set(state), out)
+            if t + 1 < num_micro + num_stages - 1:
+                state = jax.lax.ppermute(state, axis, fwd)
+
+        # Only the last stage holds real outputs; replicate them ring-wide.
+        out = jnp.where(stage == num_stages - 1, out, jnp.zeros_like(out))
+        return jax.lax.psum(out, axis)
+
+    stage_spec = jax.tree.map(lambda _: P(axis), stage_params)
+    return jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(stage_spec, P()), out_specs=P(),
+    )(stage_params, microbatches)
+
+
+def stack_stage_params(per_stage_params: list) -> object:
+    """Stack a list of per-stage param pytrees into the leading-dim layout
+    ``pipeline_apply`` expects (leaf shapes (S, ...))."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *per_stage_params)

@@ -95,8 +95,22 @@ def train_state_shardings(ts: TrainState, mesh: Mesh, *,
                 return sharding
         return replicate
 
+    # The agent-batch size identifies which leaves shard over dp: exactly
+    # those whose leading dim is the batch (env cursors, carries).
+    batch_size = int(ts.env_state.t.shape[0])
+
     def batched_leaf(leaf):
-        return batch if getattr(leaf, "ndim", 0) >= 1 else replicate
+        shape = getattr(leaf, "shape", ())
+        return batch if (len(shape) >= 1 and shape[0] == batch_size) else replicate
+
+    def extras_leaf(path, leaf):
+        # Algorithm extras mix params-shaped trees (DQN target net — shard
+        # like the matching param), batch-leading arrays (shard over dp),
+        # and everything else (replay rows, counters — replicate).
+        match = opt_leaf(path, leaf)
+        if match is not replicate:
+            return match
+        return batched_leaf(leaf)
 
     return TrainState(
         params=p_shard,
@@ -106,7 +120,8 @@ def train_state_shardings(ts: TrainState, mesh: Mesh, *,
         rng=replicate,
         env_steps=replicate,
         updates=replicate,
-        extras=jax.tree.map(batched_leaf, ts.extras) if ts.extras is not None else None,
+        extras=(jax.tree_util.tree_map_with_path(extras_leaf, ts.extras)
+                if ts.extras is not None else None),
     )
 
 

@@ -99,6 +99,7 @@ class Orchestrator:
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self.restarts = 0
+        self.episode = 0
         self.last_error: BaseException | None = None
 
     # ------------------------------------------------------------------
@@ -163,10 +164,7 @@ class Orchestrator:
     def initialise(self) -> None:
         if self.agent is None or self._ts is None:
             return
-        fresh = self.agent.init(jax.random.PRNGKey(self.cfg.seed))
-        self._ts = self._place(fresh.replace(
-            params=self._ts.params, opt_state=self._ts.opt_state,
-            updates=self._ts.updates))
+        self._reset_episode()
         self.lifecycle.to(Phase.READY)
 
     # ------------------------------------------------------------------
@@ -206,12 +204,22 @@ class Orchestrator:
                 last_ckpt_updates = updates
 
                 if int(metrics.get("env_steps", 0)) >= horizon:
+                    self.episode += 1
+                    if self.episode < rt.episodes:
+                        # Re-arm for another pass over the history, keeping
+                        # learned parameters (the Initialise→Train cycle,
+                        # TrainerChildActor.scala:57-59).
+                        self.events.emit("episode_completed",
+                                         episode=self.episode)
+                        self._reset_episode()
+                        continue
                     self.checkpoints.save(updates, self._ts)
                     self.lifecycle.to(Phase.TRAINED)
                     self.lifecycle.to(Phase.COMPLETED)
                     self.tracer.stop()
                     self.events.emit("training_completed",
                                      env_steps=int(metrics["env_steps"]),
+                                     episodes=self.episode,
                                      **timer.summary())
                     log.info("training completed at %d env steps", horizon)
                     return
@@ -248,6 +256,17 @@ class Orchestrator:
                 if self._stop.wait(delay):
                     return
                 self._restore_or_reinit()
+
+    def _reset_episode(self) -> None:
+        """Fresh env cursors/carry/RNG for the next episode; parameters,
+        optimizer state, and the update counter carry over."""
+        fresh = self.agent.init(
+            jax.random.PRNGKey(self.cfg.seed + self.episode))
+        self._ts = self._place(fresh.replace(
+            params=self._ts.params, opt_state=self._ts.opt_state,
+            updates=self._ts.updates,
+            # DQN keeps its replay buffer and target net across episodes.
+            extras=self._ts.extras))
 
     def _ensure_live_state(self) -> None:
         """A failure inside the donated-input step can leave self._ts holding

@@ -17,6 +17,7 @@ check correctness, not throughput).
 
 import os
 import sys
+import tempfile
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -29,6 +30,16 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 jax.config.update("jax_default_matmul_precision", "highest")
+
+# Persistent compile cache: TPU compiles dominate suite wall time (~20-40s per
+# program shape); repeat runs hit the cache and drop from ~15 min to ~2 min.
+# User-scoped path so a shared /tmp doesn't leave the second user locked out.
+_cache_dir = os.environ.get(
+    "SHARETRADE_COMPILE_CACHE",
+    os.path.join(tempfile.gettempdir(), f"jax_compile_cache_{os.getuid()}"))
+os.makedirs(_cache_dir, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 @pytest.fixture

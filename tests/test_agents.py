@@ -207,6 +207,13 @@ def test_every_algorithm_trains_a_chunk(algo):
     assert int(ts3.env_steps) >= int(ts2.env_steps)
 
 
+def test_value_based_algos_reject_recurrent_models():
+    cfg = tiny_config("dqn")
+    cfg.model.kind = "lstm"
+    with pytest.raises(ValueError, match="requires model.kind='mlp'"):
+        build_agent(cfg, tiny_env())
+
+
 @pytest.mark.parametrize("kind", ["lstm", "transformer"])
 def test_recurrent_and_attention_policies_with_ppo(kind):
     cfg = tiny_config("ppo")

@@ -70,6 +70,27 @@ def test_gradients_match_reference():
                                    atol=2e-4, rtol=2e-4)
 
 
+def test_cross_attention_kv_longer_than_q():
+    # Non-causal cross-attention with kv_len != q_len: real keys beyond
+    # q_len must participate, padding beyond kv_len must not.
+    key = jax.random.PRNGKey(5)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 2, 4, 32))
+    k = jax.random.normal(kk, (1, 2, 16, 32))
+    v = jax.random.normal(kv, (1, 2, 16, 32))
+    got = flash_attention(q, k, v, causal=False, use_pallas=True)
+    want = reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_causal_rejects_mismatched_lengths():
+    q = jnp.zeros((1, 1, 4, 32))
+    k = jnp.zeros((1, 1, 8, 32))
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, k, causal=True, use_pallas=True)
+
+
 def test_bfloat16_path():
     q, k, v = _rand_qkv(jax.random.PRNGKey(4), seq=128, d=64, dtype=jnp.bfloat16)
     got = flash_attention(q, k, v, causal=True, use_pallas=True)

@@ -76,6 +76,24 @@ class TestDataParallelStep:
                                    float(shard_metrics["portfolio_mean"]),
                                    rtol=1e-5)
 
+    def test_dqn_extras_shard_correctly(self, cpu_mesh):
+        """DQN on a mesh: target net replicates like params, replay buffer
+        does NOT get batch-sharded (its leading dim is capacity, not batch)."""
+        cfg = tiny_cfg("dqn")
+        cfg.learner.replay_capacity = 128
+        cfg.learner.replay_batch = 8
+        env_params = trading.env_from_prices(
+            jnp.linspace(10.0, 20.0, 64), window=WINDOW)
+        agent = build_agent(cfg, env_params)
+        place, pstep = make_parallel_step(agent, cpu_mesh)
+        ts = place(agent.init(jax.random.PRNGKey(0)))
+        # Target params (203-like dims) must not be dp-sharded.
+        tp_shard = ts.extras.target_params["layer1"]["w"].sharding
+        assert tp_shard.spec == P()
+        assert ts.extras.replay.obs.sharding.spec == P()
+        ts2, metrics = pstep(ts)
+        assert int(ts2.env_steps) > 0
+
     def test_env_state_actually_sharded(self, cpu_mesh):
         cfg = tiny_cfg()
         env_params = trading.env_from_prices(

@@ -203,6 +203,20 @@ class TestStubbedStepSeam:
         assert orch.get_std() == QueryReply(ReplyState.RESULT, 0.0)
 
 
+class TestMultiEpisode:
+    def test_episodes_replay_history(self, tmp_path):
+        """episodes=3 replays the price history three times with parameters
+        carried across episodes (the Initialise→Train cycle automated)."""
+        cfg = fast_cfg(tmp_path)
+        cfg.runtime.episodes = 3
+        orch = run_end_to_end(cfg, PRICES)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.episode == 3
+        horizon = len(PRICES) - WINDOW
+        # qlearn updates once per env step: 3 episodes of updates accumulated.
+        assert int(orch.train_state.updates) == 3 * horizon
+
+
 class TestInitialise:
     def test_retrain_keeps_params(self, tmp_path):
         orch = run_end_to_end(fast_cfg(tmp_path), PRICES)
