@@ -1,0 +1,111 @@
+"""Measure every BASELINE.json config on the attached chip.
+
+Prints one JSON line per config (same schema as bench.py) and a summary
+table. bench.py stays the driver's single-line headline; this fills the
+BASELINE.md measurement table across the config ladder.
+
+Usage: python benchmarks/run_all.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax
+
+from sharetrade_tpu.agents import build_agent
+from sharetrade_tpu.config import FrameworkConfig
+from sharetrade_tpu.data.synthetic import synthetic_price_series
+from sharetrade_tpu.env import trading
+
+REFERENCE_CEILING = 58_450 / 1_005.0  # see bench.py derivation
+
+
+def bench_config(name: str, cfg: FrameworkConfig, *, chunks: int) -> dict:
+    series = synthetic_price_series(length=6046)
+    env_params = trading.env_from_prices(
+        series.prices, window=cfg.env.window,
+        initial_budget=cfg.env.initial_budget)
+    agent = build_agent(cfg, env_params)
+    step = jax.jit(agent.step, donate_argnums=0)
+
+    ts = agent.init(jax.random.PRNGKey(0))
+    ts, _ = step(ts)                       # compile + warm chunk
+    jax.block_until_ready(ts.params)
+
+    t0 = time.perf_counter()
+    for _ in range(chunks):
+        ts, _ = step(ts)
+    jax.block_until_ready(ts.params)
+    elapsed = time.perf_counter() - t0
+
+    agent_steps = chunks * agent.steps_per_chunk * agent.num_agents
+    rate = agent_steps / elapsed
+    return {
+        "metric": f"{name}_agent_steps_per_sec_per_chip",
+        "value": round(rate, 2),
+        "unit": "agent-steps/s",
+        "vs_baseline": round(rate / REFERENCE_CEILING, 2),
+    }
+
+
+def make_configs() -> dict[str, FrameworkConfig]:
+    def base(**kw):
+        cfg = FrameworkConfig()
+        cfg.parallel.num_workers = 10
+        cfg.runtime.chunk_steps = 500
+        cfg.learner.unroll_len = 500
+        for k, v in kw.items():
+            parts, obj = k.split("__"), cfg
+            for p in parts[:-1]:
+                obj = getattr(obj, p)
+            setattr(obj, parts[-1], v)
+        return cfg
+
+    return {
+        # BASELINE.json config ladder (SURVEY.md §7.3 step 7)
+        "qlearn_mlp": base(learner__algo="qlearn"),
+        "pg_mlp": base(learner__algo="pg"),
+        "dqn_replay": base(learner__algo="dqn"),
+        "a2c_mlp": base(learner__algo="a2c"),
+        "ppo_lstm": base(learner__algo="ppo", model__kind="lstm",
+                         learner__unroll_len=128, runtime__chunk_steps=128),
+        "ppo_transformer": base(learner__algo="ppo", model__kind="transformer",
+                                learner__unroll_len=32, runtime__chunk_steps=32,
+                                model__num_layers=2, model__num_heads=4,
+                                model__head_dim=64),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="fewer timed chunks (smoke mode)")
+    parser.add_argument("--only", default=None, help="single config name")
+    args = parser.parse_args()
+
+    results = []
+    for name, cfg in make_configs().items():
+        if args.only and name != args.only:
+            continue
+        chunks = 2 if args.quick else max(
+            2, 2000 // cfg.runtime.chunk_steps)
+        result = bench_config(name, cfg, chunks=chunks)
+        results.append(result)
+        print(json.dumps(result), flush=True)
+
+    width = max(len(r["metric"]) for r in results)
+    print(f"\n{'config':<{width}}  agent-steps/s  vs reference ceiling",
+          file=sys.stderr)
+    for r in results:
+        print(f"{r['metric']:<{width}}  {r['value']:>13,.0f}  "
+              f"{r['vs_baseline']:>8,.0f}x", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

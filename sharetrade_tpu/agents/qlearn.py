@@ -83,9 +83,15 @@ def make_qlearn_agent(model: Model, env_params: trading.EnvParams,
         next_obs = jax.vmap(trading.observe, in_axes=(None, 0))(env_params, env_state)
 
         def td_loss(params):
-            q_s, _ = apply_batch(params, obs, ts.carry)          # (B, A)
-            q_next, _ = apply_batch(params, next_obs, carry_new)
-            q_next = jax.lax.stop_gradient(q_next)
+            # One stacked forward for Q(s) and Q(s'): tiny matmuls are
+            # launch-overhead-bound on TPU, so halving the op count beats
+            # two back-to-back (B, obs) contractions.
+            q_both, _ = apply_batch(
+                params, jnp.concatenate([obs, next_obs], axis=0),
+                jax.tree.map(lambda a, b: jnp.concatenate([a, b], axis=0),
+                             ts.carry, carry_new))
+            q_s = q_both[:num_agents]                             # (B, A)
+            q_next = jax.lax.stop_gradient(q_both[num_agents:])
             target = rewards + cfg.gamma * jnp.max(q_next, axis=-1)
             idx = jnp.where(
                 cfg.update_taken_action,

@@ -21,7 +21,9 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import shutil
+import threading
 import time
 from typing import Any
 
@@ -40,6 +42,10 @@ class CheckpointManager:
         self.directory = directory
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
+        self._worker: threading.Thread | None = None
+        self._queue: queue.Queue | None = None
+        self._inflight = 0                   # queued + mid-write async saves
+        self._cv = threading.Condition()
 
     # ---- save ----
 
@@ -63,6 +69,43 @@ class CheckpointManager:
         log.info("saved checkpoint step=%d (%d bytes)", step, len(payload))
         self._prune()
         return final
+
+    def save_async(self, step: int, train_state: Any,
+                   metadata: dict[str, Any] | None = None) -> None:
+        """Minimal-stall save: all device→host DMAs are primed at once
+        (``copy_to_host_async``), the caller blocks only until they land —
+        mandatory, because donated-input steps will free these buffers on
+        the next chunk — then serialization + disk IO run on a worker
+        thread. Call :meth:`wait_pending` before reading the directory."""
+        for leaf in jax.tree.leaves(train_state):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        host_state = jax.device_get(train_state)  # fast: DMAs already in flight
+        if self._worker is None:
+            self._queue = queue.Queue()
+            self._worker = threading.Thread(
+                target=self._drain, name="ckpt-writer", daemon=True)
+            self._worker.start()
+        with self._cv:
+            self._inflight += 1  # counted BEFORE enqueue: no set/clear race
+        self._queue.put((step, host_state, metadata))
+
+    def _drain(self) -> None:
+        while True:
+            step, state, metadata = self._queue.get()
+            try:
+                self.save(step, state, metadata)
+            except Exception:  # never kill the writer thread
+                log.exception("async checkpoint save failed (step=%d)", step)
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+
+    def wait_pending(self, timeout: float | None = None) -> bool:
+        """Block until every queued/mid-write async save hit disk."""
+        with self._cv:
+            return self._cv.wait_for(lambda: self._inflight == 0, timeout)
 
     # ---- restore ----
 

@@ -57,7 +57,7 @@ def cmd_train(args) -> int:
                 cfg.parallel.num_workers = adjusted
         orch = Orchestrator(cfg, mesh=mesh)
         t0 = time.perf_counter()
-        orch.send_training_data(prices)
+        orch.send_training_data(prices, resume=args.resume)
         orch.start_training(background=True)
 
         # Driver poll loop (ShareTradeHelper.scala:32-48), with a sane cadence.
@@ -80,7 +80,7 @@ def cmd_train(args) -> int:
         # The reference's final log line (ShareTradeHelper.scala:46), plus rate.
         log.info("The average of the portfolios: %.4f, the standard deviation: %.4f",
                  avg.value, std.value)
-        print(json.dumps({
+        result = {
             "avg_portfolio": avg.value,
             "std_portfolio": std.value,
             "env_steps": snap.get("env_steps"),
@@ -88,7 +88,10 @@ def cmd_train(args) -> int:
             "agent_steps_per_sec": total_agent_steps / max(elapsed, 1e-9),
             "elapsed_s": elapsed,
             "restarts": orch.restarts,
-        }))
+        }
+        if args.eval:
+            result.update(orch.evaluate())
+        print(json.dumps(result))
         return 0
     finally:
         if orch is not None:
@@ -129,6 +132,10 @@ def main(argv=None) -> int:
         if name == "train":
             p.add_argument("--mesh", action="store_true",
                            help="shard over all visible devices")
+            p.add_argument("--resume", action="store_true",
+                           help="restore the latest checkpoint and continue")
+            p.add_argument("--eval", action="store_true",
+                           help="greedy-policy evaluation after training")
         p.set_defaults(fn=fn)
 
     args = parser.parse_args(argv)

@@ -34,6 +34,20 @@ log = get_logger("data.journal")
 _HEADER = struct.Struct("<II")  # length, crc32
 
 
+def write_framed(path: str, events: list[dict[str, Any]]) -> None:
+    """Write ``events`` as a complete framed log at ``path`` (fsynced).
+
+    The single definition of the on-disk format for full-file writes — both
+    backends' compaction goes through here so the framing can never diverge
+    between the Python and C++ implementations."""
+    with open(path, "wb") as f:
+        for event in events:
+            payload = json.dumps(event, separators=(",", ":")).encode()
+            f.write(_HEADER.pack(len(payload), zlib.crc32(payload)) + payload)
+        f.flush()
+        os.fsync(f.fileno())
+
+
 class Journal:
     """Durable append-only event log with replay.
 
@@ -103,6 +117,26 @@ class Journal:
                     return offset
                 offset += _HEADER.size + length
         return None
+
+    # ---- compaction ----
+
+    def compact(self, events: list[dict[str, Any]]) -> None:
+        """Atomically replace the log's contents with ``events`` — the
+        event-sourcing compaction the reference delegates to LevelDB
+        (application.conf:7-14 configures per-actor compaction intervals).
+        The caller supplies the collapsed event set (e.g. one snapshot event
+        per symbol) and must ensure it reflects every acked append; a crash
+        mid-compaction leaves the original log intact (write-temp + atomic
+        rename, same protocol as checkpoints). The lock is held for the
+        whole rewrite so a concurrent ``append`` lands after the swap rather
+        than vanishing into the replaced file."""
+        tmp_path = f"{self.path}.compact-{os.getpid()}"
+        with self._lock:
+            write_framed(tmp_path, events)
+            self._fh.close()
+            os.replace(tmp_path, self.path)
+            self._fh = open(self.path, "ab")
+        log.info("journal %s compacted to %d events", self.path, len(events))
 
     def __len__(self) -> int:
         return sum(1 for _ in self.replay())

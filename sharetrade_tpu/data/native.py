@@ -60,6 +60,7 @@ class NativeJournal:
             raise ImportError("native journal library not built (make -C native)")
         self.path = path
         self._lib = lib
+        self._fsync = fsync
         self._lock = threading.Lock()
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._handle = lib.stj_open(path.encode(), 1 if fsync else 0)
@@ -86,6 +87,23 @@ class NativeJournal:
         for line in raw.splitlines():
             if line:
                 yield json.loads(line)
+
+    def compact(self, event_list: list[dict[str, Any]]) -> None:
+        """Atomic rewrite with a collapsed event set (see Journal.compact;
+        same lock-held protocol). Framing goes through the shared
+        ``write_framed`` helper (compaction is rare; appends stay on the C++
+        fast path), then the handle reopens preserving the fsync mode."""
+        from sharetrade_tpu.data.journal import write_framed
+        tmp_path = f"{self.path}.compact-{os.getpid()}"
+        with self._lock:
+            write_framed(tmp_path, event_list)
+            if self._handle:
+                self._lib.stj_close(self._handle)
+            os.replace(tmp_path, self.path)
+            self._handle = self._lib.stj_open(
+                self.path.encode(), 1 if self._fsync else 0)
+            if not self._handle:
+                raise OSError(f"stj_open failed reopening {self.path}")
 
     def __len__(self) -> int:
         return sum(1 for _ in self.replay())

@@ -106,7 +106,13 @@ def train_state_shardings(ts: TrainState, mesh: Mesh, *,
     def extras_leaf(path, leaf):
         # Algorithm extras mix params-shaped trees (DQN target net — shard
         # like the matching param), batch-leading arrays (shard over dp),
-        # and everything else (replay rows, counters — replicate).
+        # and everything else (replay rows, counters — replicate). Replay
+        # buffers replicate unconditionally: their leading dim is capacity,
+        # which can coincide with the batch size while the sampling indices
+        # assume the whole buffer.
+        keys = _path_keys(path)
+        if "replay" in keys:
+            return replicate
         match = opt_leaf(path, leaf)
         if match is not replicate:
             return match

@@ -37,6 +37,7 @@ import time
 from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from sharetrade_tpu.agents import build_agent
@@ -106,15 +107,34 @@ class Orchestrator:
     # protocol: SendTrainingData (TrainerRouterActor.scala:77-81)
     # ------------------------------------------------------------------
 
-    def send_training_data(self, prices: np.ndarray | Any) -> None:
+    def send_training_data(self, prices: np.ndarray | Any, *,
+                           resume: bool = False) -> None:
+        """Build the env + agent from a price series. With ``resume=True``
+        the latest checkpoint (params, optimizer, RNG, env cursors) is
+        restored instead of a fresh init — the user-facing continuation of
+        the crash-recovery path (SURVEY.md §7.1 item 7)."""
         self.env_params = trading.env_from_prices(
             prices, window=self.cfg.env.window,
             initial_budget=self.cfg.env.initial_budget,
             initial_shares=self.cfg.env.initial_shares)
         self.agent = build_agent(self.cfg, self.env_params)
         self._build_step()
-        self._ts = self._place(self.agent.init(
-            jax.random.PRNGKey(self.cfg.seed)))
+        template = self.agent.init(jax.random.PRNGKey(self.cfg.seed))
+        if resume:
+            state, step = self.checkpoints.restore(template)
+            self._ts = self._place(state)
+            # Recover which episode the cumulative step count sits in so the
+            # completion arithmetic picks up where the run left off.
+            horizon = trading.num_steps(self.env_params)
+            self.episode = min(int(state.env_steps) // horizon,
+                               self.cfg.runtime.episodes - 1)
+            log.info("resumed from checkpoint step=%d "
+                     "(env cursor %d, %d updates, episode %d)", step,
+                     int(state.env_state.t[0]), int(state.updates),
+                     self.episode)
+            self.events.emit("resumed", step=step)
+        else:
+            self._ts = self._place(template)
         self.lifecycle.to(Phase.READY)
         self.events.emit("training_data_received",
                          episode_steps=trading.num_steps(self.env_params))
@@ -199,11 +219,14 @@ class Orchestrator:
                 if (rt.checkpoint_every_updates > 0
                         and updates // rt.checkpoint_every_updates
                         > last_ckpt_updates // rt.checkpoint_every_updates):
-                    self.checkpoints.save(updates, self._ts)
+                    # Async: device->host DMA overlaps the next chunk.
+                    self.checkpoints.save_async(updates, self._ts)
                     self.events.emit("checkpoint", updates=updates)
                 last_ckpt_updates = updates
 
-                if int(metrics.get("env_steps", 0)) >= horizon:
+                # env_steps is cumulative across episodes (the epsilon ramp
+                # input), so episode N completes at (N+1) x horizon.
+                if int(metrics.get("env_steps", 0)) >= horizon * (self.episode + 1):
                     self.episode += 1
                     if self.episode < rt.episodes:
                         # Re-arm for another pass over the history, keeping
@@ -213,6 +236,7 @@ class Orchestrator:
                                          episode=self.episode)
                         self._reset_episode()
                         continue
+                    self.checkpoints.wait_pending(timeout=60)
                     self.checkpoints.save(updates, self._ts)
                     self.lifecycle.to(Phase.TRAINED)
                     self.lifecycle.to(Phase.COMPLETED)
@@ -259,12 +283,14 @@ class Orchestrator:
 
     def _reset_episode(self) -> None:
         """Fresh env cursors/carry/RNG for the next episode; parameters,
-        optimizer state, and the update counter carry over."""
+        optimizer state, update counter, AND the cumulative env-step count
+        carry over (env_steps drives the epsilon exploration ramp — resetting
+        it would replay ~1000 fully-random steps into a learned policy)."""
         fresh = self.agent.init(
             jax.random.PRNGKey(self.cfg.seed + self.episode))
         self._ts = self._place(fresh.replace(
             params=self._ts.params, opt_state=self._ts.opt_state,
-            updates=self._ts.updates,
+            updates=self._ts.updates, env_steps=self._ts.env_steps,
             # DQN keeps its replay buffer and target net across episodes.
             extras=self._ts.extras))
 
@@ -288,6 +314,7 @@ class Orchestrator:
         scratch — respawn-and-retrain (TrainerRouterActor.scala:116-120,
         141-146)."""
         template = self.agent.init(jax.random.PRNGKey(self.cfg.seed))
+        self.checkpoints.wait_pending(timeout=60)  # pick up in-flight saves
         try:
             state, step = self.checkpoints.restore(template)
             self._ts = self._place(state)
@@ -332,6 +359,38 @@ class Orchestrator:
     def snapshot(self) -> dict[str, float]:
         with self._snapshot_lock:
             return dict(self._snapshot)
+
+    def evaluate(self) -> dict[str, float]:
+        """Greedy-policy evaluation: replay the episode with argmax actions,
+        no exploration, no updates — the measurement the reference never
+        separates from training (its portfolio avg mixes ~10% random actions
+        even at full epsilon, QDecisionPolicyActor.scala:58-62). Runs one
+        scan on the current params; training state is untouched."""
+        if self.agent is None or self._ts is None:
+            raise RuntimeError("no training data / state")
+        from sharetrade_tpu.models import build_model
+        from sharetrade_tpu.agents import _HEADS  # registry head mapping
+        model = build_model(self.cfg.model, self.cfg.env.window + 2,
+                            head=_HEADS[self.cfg.learner.algo])
+        env_params = self.env_params
+        horizon = trading.num_steps(env_params)
+        params = self._ts.params
+
+        def body(carry, _):
+            state, model_carry = carry
+            obs = trading.observe(env_params, state)
+            out, model_carry = model.apply(params, obs, model_carry)
+            action = jnp.argmax(out.logits).astype(jnp.int32)
+            new_state, reward = trading.step(env_params, state, action)
+            return (new_state, model_carry), reward
+
+        (final, _), rewards = jax.jit(
+            lambda c: jax.lax.scan(body, c, None, length=horizon)
+        )((trading.reset(env_params), model.init_carry()))
+        return {
+            "eval_portfolio": float(trading.portfolio_value(final)),
+            "eval_reward_sum": float(jnp.sum(rewards)),
+        }
 
     # ------------------------------------------------------------------
 

@@ -95,6 +95,19 @@ class TestSaveRestore:
         assert mgr.steps() == [5]
         assert mgr.latest_step() == 5
 
+    def test_async_save_restores_identically(self, tmp_path):
+        agent = make_agent()
+        ts = agent.init(jax.random.PRNGKey(0))
+        ts, _ = jax.jit(agent.step)(ts)
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save_async(int(ts.updates), ts)
+        assert mgr.wait_pending(timeout=30)
+        restored, step = mgr.restore(agent.init(jax.random.PRNGKey(9)))
+        assert step == int(ts.updates)
+        for a, b in zip(jax.tree.leaves(jax.device_get(ts)),
+                        jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
     def test_metadata(self, tmp_path):
         agent = make_agent()
         ts = agent.init(jax.random.PRNGKey(0))

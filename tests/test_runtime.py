@@ -217,6 +217,29 @@ class TestMultiEpisode:
         assert int(orch.train_state.updates) == 3 * horizon
 
 
+class TestEvaluateAndResume:
+    def test_greedy_evaluation(self, tmp_path):
+        orch = run_end_to_end(fast_cfg(tmp_path), PRICES)
+        result = orch.evaluate()
+        assert np.isfinite(result["eval_portfolio"])
+        assert result["eval_portfolio"] > 0
+        # Deterministic: same params, same greedy rollout.
+        assert orch.evaluate() == result
+
+    def test_resume_from_checkpoint(self, tmp_path):
+        cfg = fast_cfg(tmp_path)
+        orch = run_end_to_end(cfg, PRICES)
+        updates_before = int(orch.train_state.updates)
+        params_before = jax.device_get(orch.train_state.params)
+        # A new orchestrator resumes the final checkpoint.
+        orch2 = Orchestrator(cfg)
+        orch2.send_training_data(PRICES, resume=True)
+        assert int(orch2.train_state.updates) == updates_before
+        for a, b in zip(jax.tree.leaves(params_before),
+                        jax.tree.leaves(jax.device_get(orch2.train_state.params))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 class TestInitialise:
     def test_retrain_keeps_params(self, tmp_path):
         orch = run_end_to_end(fast_cfg(tmp_path), PRICES)
