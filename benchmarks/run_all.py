@@ -89,8 +89,12 @@ def main() -> None:
     parser.add_argument("--only", default=None, help="single config name")
     args = parser.parse_args()
 
+    configs = make_configs()
+    if args.only and args.only not in configs:
+        parser.error(f"unknown config {args.only!r}; "
+                     f"choose from {sorted(configs)}")
     results = []
-    for name, cfg in make_configs().items():
+    for name, cfg in configs.items():
         if args.only and name != args.only:
             continue
         chunks = 2 if args.quick else max(

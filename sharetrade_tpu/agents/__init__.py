@@ -20,6 +20,7 @@ from sharetrade_tpu.agents.ppo import make_ppo_agent
 from sharetrade_tpu.agents.qlearn import make_qlearn_agent
 from sharetrade_tpu.config import FrameworkConfig
 from sharetrade_tpu.env import trading
+from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models import build_model
 from sharetrade_tpu.models.core import Model
 
@@ -35,9 +36,20 @@ _FACTORIES = {
 _HEADS = {"qlearn": "q", "dqn": "q", "pg": "ac", "a2c": "ac", "ppo": "ac"}
 
 
-def build_agent(cfg: FrameworkConfig, env_params: trading.EnvParams,
+def build_agent(cfg: FrameworkConfig, env: TradingEnv | trading.EnvParams,
                 model: Model | None = None) -> Agent:
-    """Wire model + env + learner from a framework config."""
+    """Wire model + env + learner from a framework config.
+
+    Accepts either the generic :class:`TradingEnv` bundle or a bare
+    single-asset ``EnvParams`` (wrapped automatically — the common
+    test/bench construction path).
+    """
+    if isinstance(env, trading.EnvParams):
+        params = env
+        env = trading.make_trading_env(
+            params.prices, window=params.window,
+            initial_budget=float(params.initial_budget),
+            initial_shares=int(params.initial_shares))
     algo = cfg.learner.algo
     if algo not in _FACTORIES:
         raise ValueError(f"unknown learner.algo {algo!r}; "
@@ -48,10 +60,14 @@ def build_agent(cfg: FrameworkConfig, env_params: trading.EnvParams,
         raise ValueError(
             f"learner.algo={algo!r} requires model.kind='mlp' "
             f"(got {cfg.model.kind!r}); use a2c/ppo for {cfg.model.kind} policies")
+    if env.num_assets > 1 and cfg.model.kind == "transformer":
+        raise ValueError(
+            "the transformer tick policy tokenizes a single-asset window; "
+            "use mlp/lstm for multi-asset portfolios")
     if model is None:
-        obs_dim = cfg.env.window + 2
-        model = build_model(cfg.model, obs_dim, head=_HEADS[algo])
+        model = build_model(cfg.model, env.obs_dim, head=_HEADS[algo],
+                            num_actions=env.num_actions)
     return _FACTORIES[algo](
-        model, env_params, cfg.learner,
+        model, env, cfg.learner,
         num_agents=cfg.parallel.num_workers,
         steps_per_chunk=cfg.runtime.chunk_steps)

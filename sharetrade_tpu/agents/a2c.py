@@ -20,11 +20,11 @@ from sharetrade_tpu.agents.rollout import (
     collect_rollout, discounted_returns, replay_forward,
 )
 from sharetrade_tpu.config import LearnerConfig
-from sharetrade_tpu.env import trading
+from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models.core import Model
 
 
-def make_a2c_agent(model: Model, env_params: trading.EnvParams,
+def make_a2c_agent(model: Model, env: TradingEnv,
                    cfg: LearnerConfig, *, num_agents: int = 10,
                    steps_per_chunk: int | None = None) -> Agent:
     optimizer = build_optimizer(cfg)
@@ -36,13 +36,13 @@ def make_a2c_agent(model: Model, env_params: trading.EnvParams,
         return TrainState(
             params=params, opt_state=optimizer.init(params),
             carry=batched_carry(model, num_agents),
-            env_state=batched_reset(env_params, num_agents),
+            env_state=batched_reset(env, num_agents),
             rng=k_rng, env_steps=jnp.int32(0), updates=jnp.int32(0),
         )
 
     def step(ts: TrainState):
         ts, traj, bootstrap, init_carry = collect_rollout(
-            model, env_params, ts, unroll, num_agents)
+            model, env, ts, unroll, num_agents)
         returns = discounted_returns(traj.reward, traj.active,
                                      bootstrap, cfg.gamma)
         weight = traj.active
@@ -77,7 +77,7 @@ def make_a2c_agent(model: Model, env_params: trading.EnvParams,
             "reward_sum": jnp.sum(traj.reward),
             "env_steps": ts.env_steps,
             "updates": ts.updates,
-            **portfolio_metrics(ts.env_state),
+            **portfolio_metrics(env, ts.env_state),
         }
         return ts, metrics
 

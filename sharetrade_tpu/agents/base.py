@@ -32,7 +32,7 @@ import optax
 from flax import struct
 
 from sharetrade_tpu.config import LearnerConfig
-from sharetrade_tpu.env import trading
+from sharetrade_tpu.env.core import TradingEnv
 
 
 @struct.dataclass
@@ -44,7 +44,7 @@ class TrainState:
     params: Any
     opt_state: Any
     carry: Any               # (B, ...) model recurrent state
-    env_state: trading.EnvState  # batched (B,) episode cursors
+    env_state: Any           # batched (B,) episode cursors (env-specific pytree)
     rng: jax.Array
     env_steps: jax.Array     # i32 global env-step counter (epsilon schedule input)
     updates: jax.Array       # i32 update counter (the reference's `iteration`)
@@ -92,8 +92,8 @@ def epsilon_greedy(key: jax.Array, q_values: jax.Array, step: jax.Array,
     return jnp.where(exploit, greedy, rand)
 
 
-def batched_reset(params: trading.EnvParams, num_agents: int) -> trading.EnvState:
-    single = trading.reset(params)
+def batched_reset(env: TradingEnv, num_agents: int):
+    single = env.reset()
     return jax.tree.map(lambda x: jnp.broadcast_to(x, (num_agents,) + x.shape),
                         single)
 
@@ -104,10 +104,10 @@ def batched_carry(model, num_agents: int):
                         carry)
 
 
-def portfolio_metrics(env_state: trading.EnvState) -> dict[str, jax.Array]:
+def portfolio_metrics(env: TradingEnv, env_state) -> dict[str, jax.Array]:
     """The router's aggregation: mean/std over worker portfolios
     (TrainerRouterActor.scala:137-151) plus richer distribution stats."""
-    values = jax.vmap(trading.portfolio_value)(env_state)
+    values = jax.vmap(env.portfolio_value)(env_state)
     return {
         "portfolio_mean": jnp.mean(values),
         "portfolio_std": jnp.std(values),

@@ -25,7 +25,7 @@ from sharetrade_tpu.agents.base import (
     epsilon_greedy, exploit_probability, portfolio_metrics,
 )
 from sharetrade_tpu.config import LearnerConfig
-from sharetrade_tpu.env import trading
+from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models.core import Model
 
 
@@ -90,11 +90,11 @@ class DQNExtras:
     replay: ReplayBuffer
 
 
-def make_dqn_agent(model: Model, env_params: trading.EnvParams,
+def make_dqn_agent(model: Model, env: TradingEnv,
                    cfg: LearnerConfig, *, num_agents: int = 10,
                    steps_per_chunk: int = 200) -> Agent:
     optimizer = build_optimizer(cfg)
-    horizon = trading.num_steps(env_params)
+    horizon = env.num_steps
     obs_dim = model.obs_dim
 
     def init(key: jax.Array) -> TrainState:
@@ -103,7 +103,7 @@ def make_dqn_agent(model: Model, env_params: trading.EnvParams,
         return TrainState(
             params=params, opt_state=optimizer.init(params),
             carry=batched_carry(model, num_agents),
-            env_state=batched_reset(env_params, num_agents),
+            env_state=batched_reset(env, num_agents),
             rng=k_rng, env_steps=jnp.int32(0), updates=jnp.int32(0),
             extras=DQNExtras(
                 target_params=jax.tree.map(jnp.copy, params),
@@ -119,18 +119,17 @@ def make_dqn_agent(model: Model, env_params: trading.EnvParams,
         act_keys = jax.random.split(k_act, num_agents)
         active = ts.env_state.t < horizon
 
-        obs = jax.vmap(trading.observe, in_axes=(None, 0))(env_params, ts.env_state)
+        obs = jax.vmap(env.observe)(ts.env_state)
         q_sel = q_batch(ts.params, obs)
         actions = jax.vmap(lambda k, q: epsilon_greedy(k, q, ts.env_steps, cfg))(
             act_keys, q_sel)
-        stepped, rewards = jax.vmap(trading.step, in_axes=(None, 0, 0))(
-            env_params, ts.env_state, actions)
+        stepped, rewards = jax.vmap(env.step)(ts.env_state, actions)
         env_state = jax.tree.map(
             lambda new, old: jnp.where(
                 active.reshape((-1,) + (1,) * (new.ndim - 1)), new, old),
             stepped, ts.env_state)
         rewards = jnp.where(active, rewards, 0.0)
-        next_obs = jax.vmap(trading.observe, in_axes=(None, 0))(env_params, env_state)
+        next_obs = jax.vmap(env.observe)(env_state)
 
         replay = ts.extras.replay.push(obs, actions, rewards, next_obs, active)
 
@@ -178,7 +177,7 @@ def make_dqn_agent(model: Model, env_params: trading.EnvParams,
             "exploit_prob": exploit_probability(ts.env_steps, cfg),
             "env_steps": ts.env_steps,
             "updates": ts.updates,
-            **portfolio_metrics(ts.env_state),
+            **portfolio_metrics(env, ts.env_state),
         }
         return ts, metrics
 

@@ -33,15 +33,15 @@ from sharetrade_tpu.agents.base import (
     portfolio_metrics,
 )
 from sharetrade_tpu.config import LearnerConfig
-from sharetrade_tpu.env import trading
+from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models.core import Model
 
 
-def make_qlearn_agent(model: Model, env_params: trading.EnvParams,
+def make_qlearn_agent(model: Model, env: TradingEnv,
                       cfg: LearnerConfig, *, num_agents: int = 10,
                       steps_per_chunk: int = 200) -> Agent:
     optimizer = build_optimizer(cfg)
-    horizon = trading.num_steps(env_params)
+    horizon = env.num_steps
 
     def init(key: jax.Array) -> TrainState:
         k_params, k_rng = jax.random.split(key)
@@ -50,7 +50,7 @@ def make_qlearn_agent(model: Model, env_params: trading.EnvParams,
             params=params,
             opt_state=optimizer.init(params),
             carry=batched_carry(model, num_agents),
-            env_state=batched_reset(env_params, num_agents),
+            env_state=batched_reset(env, num_agents),
             rng=k_rng,
             env_steps=jnp.int32(0),
             updates=jnp.int32(0),
@@ -68,19 +68,18 @@ def make_qlearn_agent(model: Model, env_params: trading.EnvParams,
         # Freeze agents whose episode is over (chunking may overrun the horizon).
         active = ts.env_state.t < horizon  # (B,) bool
 
-        obs = jax.vmap(trading.observe, in_axes=(None, 0))(env_params, ts.env_state)
+        obs = jax.vmap(env.observe)(ts.env_state)
         q_sel, carry_new = apply_batch(ts.params, obs, ts.carry)
         actions = jax.vmap(lambda k, q: epsilon_greedy(k, q, ts.env_steps, cfg))(
             act_keys, q_sel)
 
-        stepped, rewards = jax.vmap(trading.step, in_axes=(None, 0, 0))(
-            env_params, ts.env_state, actions)
+        stepped, rewards = jax.vmap(env.step)(ts.env_state, actions)
         env_state = jax.tree.map(
             lambda new, old: jnp.where(
                 active.reshape((-1,) + (1,) * (new.ndim - 1)), new, old),
             stepped, ts.env_state)
         rewards = jnp.where(active, rewards, 0.0)
-        next_obs = jax.vmap(trading.observe, in_axes=(None, 0))(env_params, env_state)
+        next_obs = jax.vmap(env.observe)(env_state)
 
         def td_loss(params):
             # One stacked forward for Q(s) and Q(s'): tiny matmuls are
@@ -130,7 +129,7 @@ def make_qlearn_agent(model: Model, env_params: trading.EnvParams,
             "exploit_prob": exploit_probability(ts.env_steps, cfg),
             "env_steps": ts.env_steps,
             "updates": ts.updates,
-            **portfolio_metrics(ts.env_state),
+            **portfolio_metrics(env, ts.env_state),
         }
         return ts, metrics
 

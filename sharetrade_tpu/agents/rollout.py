@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from sharetrade_tpu.agents.base import TrainState
-from sharetrade_tpu.env import trading
+from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models.core import Model
 
 
@@ -30,7 +30,7 @@ class StepData(NamedTuple):
     active: jax.Array   # (B,) f32 1.0 while the episode is running
 
 
-def collect_rollout(model: Model, env_params: trading.EnvParams,
+def collect_rollout(model: Model, env: TradingEnv,
                     ts: TrainState, unroll_len: int, num_agents: int):
     """Roll the policy forward ``unroll_len`` steps.
 
@@ -39,7 +39,7 @@ def collect_rollout(model: Model, env_params: trading.EnvParams,
     V(s_T) for return bootstrapping, and ``init_carry`` is the recurrent state
     the unroll started from (needed to replay the forward pass in losses).
     """
-    horizon = trading.num_steps(env_params)
+    horizon = env.num_steps
     init_carry = ts.carry
 
     def one_step(carry, _):
@@ -48,7 +48,7 @@ def collect_rollout(model: Model, env_params: trading.EnvParams,
         act_keys = jax.random.split(k_act, num_agents)
 
         active = (env_state.t < horizon).astype(jnp.float32)
-        obs = jax.vmap(trading.observe, in_axes=(None, 0))(env_params, env_state)
+        obs = jax.vmap(env.observe)(env_state)
         outs, new_model_carry = jax.vmap(
             lambda o, c: model.apply(ts.params, o, c))(obs, model_carry)
         actions = jax.vmap(
@@ -57,8 +57,7 @@ def collect_rollout(model: Model, env_params: trading.EnvParams,
         logp = jax.vmap(
             lambda lg, a: jax.nn.log_softmax(lg)[a])(outs.logits, actions)
 
-        stepped, rewards = jax.vmap(trading.step, in_axes=(None, 0, 0))(
-            env_params, env_state, actions)
+        stepped, rewards = jax.vmap(env.step)(env_state, actions)
         mask = active.astype(bool)
         new_env = jax.tree.map(
             lambda new, old: jnp.where(
@@ -74,7 +73,7 @@ def collect_rollout(model: Model, env_params: trading.EnvParams,
         one_step, (ts.env_state, ts.carry, ts.rng), None, length=unroll_len)
 
     # Bootstrap value for the state the unroll stopped at.
-    final_obs = jax.vmap(trading.observe, in_axes=(None, 0))(env_params, env_state)
+    final_obs = jax.vmap(env.observe)(env_state)
     final_outs, _ = jax.vmap(
         lambda o, c: model.apply(ts.params, o, c))(final_obs, model_carry)
     bootstrap = final_outs.value * (env_state.t < horizon).astype(jnp.float32)

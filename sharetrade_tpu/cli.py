@@ -41,9 +41,19 @@ def cmd_train(args) -> int:
     service = PriceDataService(config=cfg.data)
     orch = None
     try:
-        response = service.request(args.symbol, args.start, args.end)
-        prices = response.series.prices
-        log.info("loaded %d prices for %s", len(prices), args.symbol)
+        symbols = [s.strip() for s in args.symbol.split(",") if s.strip()]
+        if len(symbols) > 1:
+            # Multi-asset portfolio: align the symbols on common dates.
+            from sharetrade_tpu.data.ingest import align_series
+            series = [service.request(s, args.start, args.end).series
+                      for s in symbols]
+            prices = align_series(series)
+            log.info("loaded %s prices for %d assets %s",
+                     prices.shape, len(symbols), symbols)
+        else:
+            response = service.request(symbols[0], args.start, args.end)
+            prices = response.series.prices
+            log.info("loaded %d prices for %s", len(prices), symbols[0])
 
         mesh = build_mesh(cfg.parallel) if args.mesh else None
         if mesh is not None:
@@ -57,7 +67,11 @@ def cmd_train(args) -> int:
                 cfg.parallel.num_workers = adjusted
         orch = Orchestrator(cfg, mesh=mesh)
         t0 = time.perf_counter()
-        orch.send_training_data(prices, resume=args.resume)
+        try:
+            orch.send_training_data(prices, resume=args.resume)
+        except FileNotFoundError as exc:
+            log.error("--resume: %s (train without --resume first)", exc)
+            return 1
         orch.start_training(background=True)
 
         # Driver poll loop (ShareTradeHelper.scala:32-48), with a sane cadence.

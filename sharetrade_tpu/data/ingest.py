@@ -120,3 +120,22 @@ def parse_price_lines(symbol: str, lines: Iterable[str]) -> PriceSeries:
 def load_price_csv(path: str, symbol: str = "MSFT") -> PriceSeries:
     with open(path) as f:
         return parse_price_lines(symbol, f)
+
+
+def align_series(series_list: list[PriceSeries]) -> np.ndarray:
+    """Stack multiple symbols into an (A, T) price matrix over their common
+    trading dates — the multi-asset portfolio env's input. Dates present in
+    only some series are dropped (inner join), preserving order."""
+    if not series_list:
+        raise ValueError("align_series of empty list")
+    common = series_list[0].dates
+    for s in series_list[1:]:
+        common = common[np.isin(common, s.dates)]
+    if common.size == 0:
+        raise ValueError(
+            f"no common dates across {[s.symbol for s in series_list]}")
+    rows = []
+    for s in series_list:
+        idx = np.searchsorted(s.dates, common)
+        rows.append(s.prices[idx])
+    return np.stack(rows)

@@ -22,6 +22,7 @@ Here the same contract is a plain object:
 from __future__ import annotations
 
 import os
+import zlib
 from dataclasses import dataclass
 from datetime import date
 from typing import Callable, Protocol
@@ -56,7 +57,10 @@ def csv_provider(path: str) -> Callable[..., PriceSeries]:
 
 def synthetic_provider(length: int = 6046, seed: int = 1992) -> Callable[..., PriceSeries]:
     def fetch(symbol: str, start=None, end=None) -> PriceSeries:
-        return synthetic_price_series(symbol=symbol, length=length, seed=seed)
+        # Per-symbol seed derivation: distinct symbols get distinct (but
+        # reproducible) walks, so multi-asset portfolios see real dispersion.
+        sym_seed = seed + (zlib.crc32(symbol.encode()) % 65536)
+        return synthetic_price_series(symbol=symbol, length=length, seed=sym_seed)
     return fetch
 
 

@@ -5,6 +5,8 @@ fold body becomes a pure ``step`` usable under ``vmap`` (agent batches) and
 ``lax.scan`` (the time axis) inside one compiled program.
 """
 
+from sharetrade_tpu.env.core import TradingEnv  # noqa: F401
+from sharetrade_tpu.env.portfolio import PortfolioState, make_portfolio_env  # noqa: F401
 from sharetrade_tpu.env.trading import (  # noqa: F401
     BUY,
     HOLD,
@@ -13,6 +15,7 @@ from sharetrade_tpu.env.trading import (  # noqa: F401
     EnvParams,
     EnvState,
     env_from_prices,
+    make_trading_env,
     num_steps,
     observe,
     portfolio_value,

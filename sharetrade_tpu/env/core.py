@@ -1,0 +1,30 @@
+"""Environment interface: what every trading environment exposes to agents.
+
+The reference hard-wires one environment shape (single stock, fold loop in
+TrainerChildActor.scala). Generalizing to an explicit bundle of pure
+functions lets the same learners drive single-asset and multi-asset
+portfolio environments unchanged — the functions close over the (static)
+price data, so under jit they compile to constants exactly like the original
+module-level functions did.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+
+
+@dataclass(frozen=True)
+class TradingEnv:
+    """A trading environment as pure functions + static shape facts."""
+
+    reset: Callable[[], Any]                 # () -> EnvState
+    observe: Callable[[Any], jax.Array]      # state -> (obs_dim,)
+    step: Callable[[Any, jax.Array], tuple[Any, jax.Array]]  # (state, action)
+    portfolio_value: Callable[[Any], jax.Array]
+    num_steps: int                           # episode horizon
+    obs_dim: int
+    num_actions: int
+    num_assets: int = 1

@@ -1,0 +1,107 @@
+"""Multi-asset portfolio trading environment.
+
+The forward-looking generalization of the single-stock env (BASELINE.json
+config 4: "PPO multi-asset portfolio"): A assets trade simultaneously
+against one shared budget. Degenerates exactly to the single-asset
+semantics (env/trading.py, itself modeled on TrainerChildActor.scala:82-146)
+at A=1 — tested in tests/test_portfolio.py.
+
+- Observation: the A price windows concatenated (A × window floats), then
+  budget, then the A share counts — obs_dim = A·window + 1 + A. At A=1 this
+  is the reference's 203-float layout (window ++ budget ++ shares).
+- Actions: ``2A+1`` discrete choices — ``a``∈[0,A): Buy one share of asset
+  a; ``a``∈[A,2A): Sell one share of asset a−A; ``2A``: Hold. At A=1 the
+  order is (Buy, Sell, Hold), the reference's action indexing
+  (QDecisionPolicyActor.scala:17).
+- Feasibility and reward follow the single-asset rules per traded asset:
+  Buy iff budget covers that asset's price, Sell iff shares held;
+  reward = portfolio delta with last-trade-price marking (seeded 0).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from sharetrade_tpu.env.core import TradingEnv
+
+
+@struct.dataclass
+class PortfolioState:
+    t: jax.Array            # i32 step cursor
+    budget: jax.Array       # f32 shared cash
+    shares: jax.Array       # (A,) f32 holdings
+    share_value: jax.Array  # (A,) f32 last trade prices (0 before first mark)
+
+
+def make_portfolio_env(prices, window: int = 201,
+                       initial_budget: float = 2400.0,
+                       initial_shares=None) -> TradingEnv:
+    """Build a multi-asset env from ``prices`` of shape (A, T) (or (T,) for
+    a single asset)."""
+    prices = jnp.asarray(prices, jnp.float32)
+    if prices.ndim == 1:
+        prices = prices[None, :]
+    if prices.ndim != 2:
+        raise ValueError(f"prices must be (A, T), got {prices.shape}")
+    num_assets, total = int(prices.shape[0]), int(prices.shape[1])
+    if total <= window + 1:
+        raise ValueError(
+            f"price count ({total}) must exceed window + 1 ({window + 1})")
+    if initial_shares is None:
+        initial_shares = jnp.zeros((num_assets,), jnp.float32)
+    else:
+        initial_shares = jnp.broadcast_to(
+            jnp.asarray(initial_shares, jnp.float32), (num_assets,))
+    budget0 = jnp.float32(initial_budget)
+
+    num_actions = 2 * num_assets + 1
+    obs_dim = num_assets * window + 1 + num_assets
+
+    def reset() -> PortfolioState:
+        return PortfolioState(
+            t=jnp.int32(0), budget=budget0,
+            shares=initial_shares,
+            share_value=jnp.zeros((num_assets,), jnp.float32))
+
+    def observe(state: PortfolioState) -> jax.Array:
+        windows = jax.lax.dynamic_slice(
+            prices, (0, state.t), (num_assets, window))     # (A, window)
+        return jnp.concatenate(
+            [windows.reshape(-1), state.budget[None], state.shares])
+
+    def portfolio_value(state: PortfolioState) -> jax.Array:
+        return state.budget + jnp.sum(state.shares * state.share_value)
+
+    def step(state: PortfolioState, action: jax.Array):
+        trade_prices = prices[:, state.t + window]           # (A,)
+
+        is_buy = action < num_assets
+        is_sell = (action >= num_assets) & (action < 2 * num_assets)
+        asset = jnp.where(is_buy, action,
+                          jnp.where(is_sell, action - num_assets, 0))
+        onehot = jax.nn.one_hot(asset, num_assets, dtype=jnp.float32)
+        price_a = trade_prices[asset]
+
+        can_buy = is_buy & (state.budget >= price_a)
+        can_sell = is_sell & (state.shares[asset] > 0)
+        delta = jnp.where(can_buy, 1.0, jnp.where(can_sell, -1.0, 0.0))
+
+        new_budget = state.budget - delta * price_a
+        new_shares = state.shares + delta * onehot
+
+        current = portfolio_value(state)
+        new_portfolio = new_budget + jnp.sum(new_shares * trade_prices)
+        reward = new_portfolio - current
+
+        new_state = PortfolioState(
+            t=state.t + 1, budget=new_budget, shares=new_shares,
+            share_value=trade_prices)
+        return new_state, reward
+
+    return TradingEnv(
+        reset=reset, observe=observe, step=step,
+        portfolio_value=portfolio_value,
+        num_steps=total - window, obs_dim=obs_dim,
+        num_actions=num_actions, num_assets=num_assets)

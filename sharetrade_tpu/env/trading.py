@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from sharetrade_tpu.env.core import TradingEnv
+
 BUY, SELL, HOLD = 0, 1, 2  # reference action order: actions = Seq(Buy, Sell, Hold)
 NUM_ACTIONS = 3
 
@@ -111,6 +113,25 @@ def observe(params: EnvParams, state: EnvState) -> jax.Array:
 def portfolio_value(state: EnvState) -> jax.Array:
     """budget + shares × last trade price (TrainerChildActor.scala:68,92)."""
     return state.budget + state.shares * state.share_value
+
+
+def make_trading_env(prices, window: int = 201, initial_budget: float = 2400.0,
+                     initial_shares: int = 0) -> TradingEnv:
+    """Bundle the single-asset functions into the generic TradingEnv
+    interface (env/core.py); the params close over as jit constants."""
+    params = env_from_prices(prices, window=window,
+                             initial_budget=initial_budget,
+                             initial_shares=initial_shares)
+    return TradingEnv(
+        reset=lambda: reset(params),
+        observe=lambda s: observe(params, s),
+        step=lambda s, a: step(params, s, a),
+        portfolio_value=portfolio_value,
+        num_steps=num_steps(params),
+        obs_dim=params.window + 2,
+        num_actions=NUM_ACTIONS,
+        num_assets=1,
+    )
 
 
 def step(params: EnvParams, state: EnvState, action: jax.Array):

@@ -20,24 +20,26 @@ _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
 
 
 def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
-                parity: bool = False) -> Model:
+                parity: bool = False, num_actions: int | None = None) -> Model:
     """Construct the policy network for ``cfg.kind``.
 
     ``head="q"`` selects the Q-value head (valid for MLP only — the reference
     network); ``head="ac"`` selects actor-critic heads. ``parity=True`` (with
     kind=mlp, head=q) reproduces the reference graph bit-for-bit in
     architecture: constant 0.1 biases, ReLU output, stddev-1 init.
+    ``num_actions`` overrides the config (multi-asset envs widen the head).
     """
     dtype = _DTYPES[cfg.dtype]
+    actions = cfg.num_actions if num_actions is None else num_actions
     if cfg.kind == "mlp":
         if head == "q":
-            return q_mlp(obs_dim, cfg.hidden_dim, cfg.num_actions,
+            return q_mlp(obs_dim, cfg.hidden_dim, actions,
                          parity=parity, dtype=dtype)
-        return ac_mlp(obs_dim, cfg.hidden_dim, cfg.num_actions, dtype=dtype)
+        return ac_mlp(obs_dim, cfg.hidden_dim, actions, dtype=dtype)
     if cfg.kind == "lstm":
-        return lstm_policy(obs_dim, cfg.hidden_dim, cfg.num_actions, dtype=dtype)
+        return lstm_policy(obs_dim, cfg.hidden_dim, actions, dtype=dtype)
     if cfg.kind == "transformer":
         return transformer_policy(
-            obs_dim, cfg.num_actions, num_layers=cfg.num_layers,
+            obs_dim, actions, num_layers=cfg.num_layers,
             num_heads=cfg.num_heads, head_dim=cfg.head_dim, dtype=dtype)
     raise ValueError(f"unknown model kind {cfg.kind!r}")

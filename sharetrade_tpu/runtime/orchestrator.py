@@ -45,6 +45,7 @@ from sharetrade_tpu.agents.base import Agent, TrainState
 from sharetrade_tpu.checkpoint import CheckpointManager
 from sharetrade_tpu.config import FrameworkConfig
 from sharetrade_tpu.env import trading
+from sharetrade_tpu.env.portfolio import make_portfolio_env
 from sharetrade_tpu.parallel import build_mesh, make_parallel_step
 from sharetrade_tpu.runtime.lifecycle import Lifecycle, Phase, QueryReply, ReplyState
 from sharetrade_tpu.utils.logging import EventLog, get_logger
@@ -92,7 +93,7 @@ class Orchestrator:
                               else error_policy)
 
         self.agent: Agent | None = None
-        self.env_params: trading.EnvParams | None = None
+        self.env = None  # TradingEnv once data arrives
         self._ts: TrainState | None = None
         self._step_fn = None
         self._snapshot: dict[str, float] = {}
@@ -109,23 +110,39 @@ class Orchestrator:
 
     def send_training_data(self, prices: np.ndarray | Any, *,
                            resume: bool = False) -> None:
-        """Build the env + agent from a price series. With ``resume=True``
-        the latest checkpoint (params, optimizer, RNG, env cursors) is
-        restored instead of a fresh init — the user-facing continuation of
-        the crash-recovery path (SURVEY.md §7.1 item 7)."""
-        self.env_params = trading.env_from_prices(
-            prices, window=self.cfg.env.window,
-            initial_budget=self.cfg.env.initial_budget,
-            initial_shares=self.cfg.env.initial_shares)
-        self.agent = build_agent(self.cfg, self.env_params)
+        """Build the env + agent from a price series — 1-D for the
+        single-asset env, (A, T) for the multi-asset portfolio env. With
+        ``resume=True`` the latest checkpoint (params, optimizer, RNG, env
+        cursors) is restored instead of a fresh init — the user-facing
+        continuation of the crash-recovery path (SURVEY.md §7.1 item 7)."""
+        prices = np.asarray(prices)
+        if prices.ndim == 2 and prices.shape[0] > 1:
+            self.env = make_portfolio_env(
+                prices, window=self.cfg.env.window,
+                initial_budget=self.cfg.env.initial_budget,
+                initial_shares=self.cfg.env.initial_shares)
+        else:
+            self.env = trading.make_trading_env(
+                prices.reshape(-1), window=self.cfg.env.window,
+                initial_budget=self.cfg.env.initial_budget,
+                initial_shares=self.cfg.env.initial_shares)
+        self.agent = build_agent(self.cfg, self.env)
         self._build_step()
         template = self.agent.init(jax.random.PRNGKey(self.cfg.seed))
         if resume:
             state, step = self.checkpoints.restore(template)
+            horizon = self.env.num_steps
+            max_cursor = int(np.max(np.asarray(state.env_state.t)))
+            if max_cursor > horizon:
+                # A shorter series would freeze every agent past the new
+                # horizon and the completion arithmetic could never fire.
+                raise ValueError(
+                    f"checkpoint env cursor ({max_cursor}) exceeds the new "
+                    f"series horizon ({horizon}); resume needs the same or a "
+                    f"longer price series")
             self._ts = self._place(state)
             # Recover which episode the cumulative step count sits in so the
             # completion arithmetic picks up where the run left off.
-            horizon = trading.num_steps(self.env_params)
             self.episode = min(int(state.env_steps) // horizon,
                                self.cfg.runtime.episodes - 1)
             log.info("resumed from checkpoint step=%d "
@@ -137,7 +154,7 @@ class Orchestrator:
             self._ts = self._place(template)
         self.lifecycle.to(Phase.READY)
         self.events.emit("training_data_received",
-                         episode_steps=trading.num_steps(self.env_params))
+                         episode_steps=self.env.num_steps)
         # Honor a stashed StartTraining (reference stash/unstashAll, :75-76).
         if self.lifecycle.start_requested:
             self.start_training(
@@ -193,7 +210,7 @@ class Orchestrator:
 
     def _run_supervised(self) -> None:
         rt = self.cfg.runtime
-        horizon = trading.num_steps(self.env_params)
+        horizon = self.env.num_steps
         chunk_idx = 0
         last_ckpt_updates = 0  # reference guards iteration != 0 (:74)
         timer = StepTimer(rt.chunk_steps, self.cfg.parallel.num_workers)
@@ -370,25 +387,26 @@ class Orchestrator:
             raise RuntimeError("no training data / state")
         from sharetrade_tpu.models import build_model
         from sharetrade_tpu.agents import _HEADS  # registry head mapping
-        model = build_model(self.cfg.model, self.cfg.env.window + 2,
-                            head=_HEADS[self.cfg.learner.algo])
-        env_params = self.env_params
-        horizon = trading.num_steps(env_params)
+        model = build_model(self.cfg.model, self.env.obs_dim,
+                            head=_HEADS[self.cfg.learner.algo],
+                            num_actions=self.env.num_actions)
+        env = self.env
+        horizon = env.num_steps
         params = self._ts.params
 
         def body(carry, _):
             state, model_carry = carry
-            obs = trading.observe(env_params, state)
+            obs = env.observe(state)
             out, model_carry = model.apply(params, obs, model_carry)
             action = jnp.argmax(out.logits).astype(jnp.int32)
-            new_state, reward = trading.step(env_params, state, action)
+            new_state, reward = env.step(state, action)
             return (new_state, model_carry), reward
 
         (final, _), rewards = jax.jit(
             lambda c: jax.lax.scan(body, c, None, length=horizon)
-        )((trading.reset(env_params), model.init_carry()))
+        )((env.reset(), model.init_carry()))
         return {
-            "eval_portfolio": float(trading.portfolio_value(final)),
+            "eval_portfolio": float(env.portfolio_value(final)),
             "eval_reward_sum": float(jnp.sum(rewards)),
         }
 

@@ -21,7 +21,7 @@ WINDOW = 8
 
 def tiny_env(n=64, budget=500.0):
     prices = jnp.linspace(10.0, 20.0, n)
-    return trading.env_from_prices(prices, window=WINDOW, initial_budget=budget)
+    return trading.make_trading_env(prices, window=WINDOW, initial_budget=budget)
 
 
 def tiny_config(algo, **learner_kw):
@@ -71,27 +71,27 @@ class TestEpsilonSchedule:
 
 class TestQLearnTD:
     def _run_one_step(self, update_taken_action):
-        env_params = tiny_env()
+        env = tiny_env()
         cfg = LearnerConfig(update_taken_action=update_taken_action)
         model = q_mlp(obs_dim=WINDOW + 2, hidden_dim=4, parity=True)
-        agent = make_qlearn_agent(model, env_params, cfg,
+        agent = make_qlearn_agent(model, env, cfg,
                                   num_agents=1, steps_per_chunk=1)
         ts = agent.init(jax.random.PRNGKey(42))
         ts2, metrics = jax.jit(agent.step)(ts)
-        return ts, ts2, metrics, model, env_params, cfg
+        return ts, ts2, metrics, model, env, cfg
 
     def test_one_step_matches_independent_computation(self):
-        ts, ts2, metrics, model, env_params, cfg = self._run_one_step(True)
+        ts, ts2, metrics, model, env, cfg = self._run_one_step(True)
 
         # Replicate the step with straight-line code (no scan, no masking).
         rng, k_act = jax.random.split(ts.rng)
         act_key = jax.random.split(k_act, 1)[0]
-        obs = trading.observe(env_params, jax.tree.map(lambda x: x[0], ts.env_state))
+        obs = env.observe(jax.tree.map(lambda x: x[0], ts.env_state))
         q_s, _ = model.apply(ts.params, obs, ())
         action = epsilon_greedy(act_key, q_s.logits, ts.env_steps, cfg)
-        env1, reward = trading.step(
-            env_params, jax.tree.map(lambda x: x[0], ts.env_state), action)
-        next_obs = trading.observe(env_params, env1)
+        env1, reward = env.step(
+            jax.tree.map(lambda x: x[0], ts.env_state), action)
+        next_obs = env.observe(env1)
 
         def loss(params):
             q, _ = model.apply(params, obs, ())
@@ -115,12 +115,12 @@ class TestQLearnTD:
         # (QDecisionPolicyActor.scala:69-71); textbook updates the taken
         # action. With enough steps the two must produce different params.
         def run(taken):
-            env_params = tiny_env()
+            env = tiny_env()
             cfg = LearnerConfig(update_taken_action=taken)
             # parity=False: the parity head's output ReLU can kill every
             # gradient at tiny widths, making the two modes trivially equal.
             model = q_mlp(obs_dim=WINDOW + 2, hidden_dim=4, parity=False)
-            agent = make_qlearn_agent(model, env_params, cfg,
+            agent = make_qlearn_agent(model, env, cfg,
                                       num_agents=2, steps_per_chunk=20)
             ts0 = agent.init(jax.random.PRNGKey(7))
             ts, _ = jax.jit(agent.step)(ts0)
@@ -137,10 +137,10 @@ class TestQLearnTD:
 
     def test_horizon_freeze(self):
         # Chunks past episode end must not step envs or update params.
-        env_params = tiny_env(n=WINDOW + 3)  # 3-step episode
+        env = tiny_env(n=WINDOW + 3)  # 3-step episode
         cfg = LearnerConfig()
         model = q_mlp(obs_dim=WINDOW + 2, hidden_dim=4)
-        agent = make_qlearn_agent(model, env_params, cfg,
+        agent = make_qlearn_agent(model, env, cfg,
                                   num_agents=2, steps_per_chunk=10)
         ts = agent.init(jax.random.PRNGKey(0))
         ts, _ = jax.jit(agent.step)(ts)
@@ -188,8 +188,7 @@ class TestReplayBuffer:
 @pytest.mark.parametrize("algo", ["qlearn", "pg", "dqn", "a2c", "ppo"])
 def test_every_algorithm_trains_a_chunk(algo):
     cfg = tiny_config(algo)
-    env_params = tiny_env()
-    agent = build_agent(cfg, env_params)
+    agent = build_agent(cfg, tiny_env())
     ts = agent.init(jax.random.PRNGKey(0))
     step = jax.jit(agent.step)
     ts2, metrics = step(ts)
@@ -218,8 +217,7 @@ def test_value_based_algos_reject_recurrent_models():
 def test_recurrent_and_attention_policies_with_ppo(kind):
     cfg = tiny_config("ppo")
     cfg.model.kind = kind
-    env_params = tiny_env()
-    agent = build_agent(cfg, env_params)
+    agent = build_agent(cfg, tiny_env())
     ts = agent.init(jax.random.PRNGKey(0))
     ts2, metrics = jax.jit(agent.step)(ts)
     assert np.isfinite(float(metrics["loss"]))

@@ -1,0 +1,135 @@
+"""Multi-asset portfolio environment (BASELINE.json config 4 capability)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sharetrade_tpu.agents import build_agent
+from sharetrade_tpu.config import FrameworkConfig
+from sharetrade_tpu.data.ingest import align_series, from_rows
+from sharetrade_tpu.env import make_portfolio_env, make_trading_env
+
+WINDOW = 4
+
+
+def two_asset_env(budget=100.0):
+    prices = jnp.stack([jnp.arange(1.0, 11.0),        # asset 0: 1..10
+                        jnp.arange(10.0, 110.0, 10.0)])  # asset 1: 10..100
+    return make_portfolio_env(prices, window=WINDOW, initial_budget=budget)
+
+
+class TestSingleAssetEquivalence:
+    def test_matches_trading_env_exactly(self):
+        """A=1 portfolio env reproduces the single-asset env step-for-step
+        (obs layout, feasibility, rewards, final portfolio)."""
+        prices = jnp.linspace(5.0, 15.0, 30)
+        single = make_trading_env(prices, window=WINDOW, initial_budget=40.0)
+        multi = make_portfolio_env(prices, window=WINDOW, initial_budget=40.0)
+        assert multi.num_actions == 3 and multi.obs_dim == single.obs_dim
+        assert multi.num_steps == single.num_steps
+
+        s1, s2 = single.reset(), multi.reset()
+        key = jax.random.PRNGKey(0)
+        actions = jax.random.randint(key, (multi.num_steps,), 0, 3)
+        for a in np.asarray(actions):
+            np.testing.assert_allclose(np.asarray(single.observe(s1)),
+                                       np.asarray(multi.observe(s2)), rtol=1e-6)
+            s1, r1 = single.step(s1, jnp.int32(a))
+            s2, r2 = multi.step(s2, jnp.int32(a))
+            assert float(r1) == pytest.approx(float(r2))
+        assert float(single.portfolio_value(s1)) == pytest.approx(
+            float(multi.portfolio_value(s2)))
+
+
+class TestPortfolioSemantics:
+    def test_obs_layout(self):
+        env = two_asset_env()
+        obs = env.observe(env.reset())
+        assert obs.shape == (2 * WINDOW + 1 + 2,)
+        np.testing.assert_allclose(obs[:WINDOW], [1, 2, 3, 4])       # asset 0
+        np.testing.assert_allclose(obs[WINDOW:2 * WINDOW], [10, 20, 30, 40])
+        np.testing.assert_allclose(obs[2 * WINDOW:], [100.0, 0.0, 0.0])
+
+    def test_buy_each_asset_against_shared_budget(self):
+        env = two_asset_env(budget=60.0)
+        s = env.reset()
+        s, _ = env.step(s, jnp.int32(1))   # buy asset 1 at 50 -> budget 10
+        assert float(s.budget) == 10.0
+        np.testing.assert_allclose(np.asarray(s.shares), [0.0, 1.0])
+        s, _ = env.step(s, jnp.int32(0))   # buy asset 0 at 6 -> budget 4
+        assert float(s.budget) == 4.0
+        np.testing.assert_allclose(np.asarray(s.shares), [1.0, 1.0])
+        s2, _ = env.step(s, jnp.int32(1))  # asset 1 costs 70 > 4: degrades to Hold
+        np.testing.assert_allclose(np.asarray(s2.shares), [1.0, 1.0])
+
+    def test_sell_requires_holding_that_asset(self):
+        env = two_asset_env()
+        s = env.reset()
+        s, _ = env.step(s, jnp.int32(0))   # buy asset 0 at 5
+        s2, _ = env.step(s, jnp.int32(3))  # sell asset 1: none held -> Hold
+        np.testing.assert_allclose(np.asarray(s2.shares),
+                                   np.asarray(s.shares))
+        s3, _ = env.step(s, jnp.int32(2))  # sell asset 0 at 6
+        assert float(s3.budget) == float(s.budget) + 6.0
+        np.testing.assert_allclose(np.asarray(s3.shares), [0.0, 0.0])
+
+    def test_hold_marks_whole_portfolio(self):
+        env = two_asset_env()
+        s = env.reset()
+        s, _ = env.step(s, jnp.int32(0))        # 1 share asset 0 at 5
+        s, _ = env.step(s, jnp.int32(1))        # 1 share asset 1 at 60
+        _, r = env.step(s, jnp.int32(4))        # hold; prices -> 7, 70
+        # Both holdings appreciate: (7-6) + (70-60) = 11.
+        assert float(r) == pytest.approx(11.0)
+
+    def test_reward_telescopes(self):
+        env = two_asset_env(budget=55.0)
+        key = jax.random.PRNGKey(3)
+        actions = jax.random.randint(key, (env.num_steps,), 0, env.num_actions)
+
+        def body(s, a):
+            ns, r = env.step(s, a)
+            return ns, r
+
+        final, rewards = jax.lax.scan(body, env.reset(), actions)
+        np.testing.assert_allclose(
+            float(env.portfolio_value(final)),
+            55.0 + float(jnp.sum(rewards)), rtol=1e-5)
+
+
+class TestPortfolioTraining:
+    @pytest.mark.parametrize("algo", ["qlearn", "ppo"])
+    def test_agents_train_on_two_assets(self, algo):
+        cfg = FrameworkConfig()
+        cfg.learner.algo = algo
+        cfg.env.window = WINDOW
+        cfg.model.hidden_dim = 16
+        cfg.parallel.num_workers = 4
+        cfg.runtime.chunk_steps = 8
+        cfg.learner.unroll_len = 8
+        prices = jnp.stack([jnp.linspace(10.0, 20.0, 64),
+                            jnp.linspace(50.0, 40.0, 64)])
+        env = make_portfolio_env(prices, window=WINDOW)
+        agent = build_agent(cfg, env)
+        ts = agent.init(jax.random.PRNGKey(0))
+        ts2, metrics = jax.jit(agent.step)(ts)
+        assert int(ts2.env_steps) > 0
+        assert np.isfinite(float(metrics["loss"]))
+        assert np.isfinite(float(metrics["portfolio_mean"]))
+
+
+class TestAlignSeries:
+    def test_inner_join_on_dates(self):
+        a = from_rows("A", [("2020-01-01", 1.0), ("2020-01-02", 2.0),
+                            ("2020-01-03", 3.0)])
+        b = from_rows("B", [("2020-01-02", 20.0), ("2020-01-03", 30.0),
+                            ("2020-01-04", 40.0)])
+        mat = align_series([a, b])
+        np.testing.assert_allclose(mat, [[2.0, 3.0], [20.0, 30.0]])
+
+    def test_disjoint_dates_rejected(self):
+        a = from_rows("A", [("2020-01-01", 1.0)])
+        b = from_rows("B", [("2021-01-01", 2.0)])
+        with pytest.raises(ValueError, match="no common dates"):
+            align_series([a, b])
