@@ -6,12 +6,12 @@ blocked online softmax, O(T) VMEM instead of the O(T²) score matrix in HBM —
 following the playbook in /opt/skills/guides/pallas_guide.md (grid/BlockSpec
 tiling, fori_loop over K blocks, broadcasted_iota masks).
 
-Gradients: ``flash_attention`` carries a ``jax.custom_vjp`` whose backward
-pass recomputes attention with the XLA reference implementation. Forward
-(rollout-heavy RL: thousands of policy evaluations per update) gets the
-kernel; the update path pays one rematerialized T² softmax, which at tick-
-window lengths is well inside VMEM-friendly territory. A fused Pallas
-backward is a later optimization, not a semantic change.
+Gradients: ``flash_attention`` carries a ``jax.custom_vjp`` with FUSED Pallas
+backward kernels (the standard flash-attention backward): the forward saves
+only the per-row logsumexp (O(T) residual instead of the T² probability
+matrix), and two kernels recompute score blocks on the fly — one tiled over
+query blocks producing dQ, one tiled over key blocks producing dK/dV — so
+the backward never materializes T² in HBM either.
 
 Shapes: (batch, heads, seq, head_dim) throughout. Sequence and head_dim are
 padded to TPU tile multiples inside the wrapper (lane = 128, guide §Tiling);
@@ -49,8 +49,8 @@ def reference_attention(q, k, v, *, causal: bool = True, sm_scale: float | None 
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  sm_scale: float, kv_len: int, kv_pad: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                  causal: bool, sm_scale: float, kv_len: int, kv_pad: int):
     """One (batch*head, q-block) program: online-softmax over K blocks.
 
     ``kv_len`` is the true key count (padding columns beyond it are masked);
@@ -95,11 +95,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     acc0 = jnp.zeros((q_block, head_dim), jnp.float32)
     m0 = jnp.full((q_block,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((q_block,), jnp.float32)
-    acc, _, l = jax.lax.fori_loop(0, num_k_blocks, body, (acc0, m0, l0))
+    acc, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (acc0, m0, l0))
 
     # Fully-masked (padding) query rows have l == 0; emit zeros, not NaNs.
     l_safe = jnp.where(l > 0, l, 1.0)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # Per-row logsumexp of the (scaled, masked) scores — the O(T) residual
+    # the backward kernels rebuild probabilities from: p = exp(s - lse).
+    # Stored broadcast across an 8-row sublane axis: TPU block shapes need
+    # the last two dims divisible by (8, 128), so a flat (1, block_q) row
+    # is not a legal block (pallas_guide.md §Tiling).
+    lse_row = jnp.where(l > 0, m + jnp.log(l_safe), 0.0)
+    lse_ref[0] = jnp.broadcast_to(lse_row[None, :], (8, q_block))
 
 
 def _pad_to(x, axis, multiple):
@@ -112,7 +119,21 @@ def _pad_to(x, axis, multiple):
     return jnp.pad(x, widths)
 
 
+def _pad_inputs(q, k, v):
+    """Pad q/k/v to tile multiples and collapse (batch, heads)."""
+    batch, heads = q.shape[:2]
+    qp = _pad_to(_pad_to(q, 2, BLOCK_Q), 3, LANE)
+    kp = _pad_to(_pad_to(k, 2, BLOCK_K), 3, LANE)
+    vp = _pad_to(_pad_to(v, 2, BLOCK_K), 3, LANE)
+    d_pad = qp.shape[-1]  # post-padding width (a LANE multiple, any head_dim)
+    qp = qp.reshape(batch * heads, -1, d_pad)
+    kp = kp.reshape(batch * heads, -1, d_pad)
+    vp = vp.reshape(batch * heads, -1, d_pad)
+    return qp, kp, vp, d_pad
+
+
 def _flash_forward(q, k, v, causal: bool, sm_scale: float, interpret: bool):
+    """Returns ``(out, lse)`` — lse is the backward's O(T) residual."""
     batch, heads, seq_len, head_dim = q.shape
     kv_len = k.shape[2]
     if causal and kv_len != seq_len:
@@ -121,13 +142,7 @@ def _flash_forward(q, k, v, causal: bool, sm_scale: float, interpret: bool):
         raise ValueError(
             f"causal attention requires q_len == kv_len, got {seq_len} vs {kv_len}")
 
-    qp = _pad_to(_pad_to(q, 2, BLOCK_Q), 3, LANE)
-    kp = _pad_to(_pad_to(k, 2, BLOCK_K), 3, LANE)
-    vp = _pad_to(_pad_to(v, 2, BLOCK_K), 3, LANE)
-    d_pad = qp.shape[-1]  # post-padding width (a LANE multiple, any head_dim)
-    qp = qp.reshape(batch * heads, -1, d_pad)
-    kp = kp.reshape(batch * heads, -1, d_pad)
-    vp = vp.reshape(batch * heads, -1, d_pad)
+    qp, kp, vp, d_pad = _pad_inputs(q, k, v)
     bh, t_pad, _ = qp.shape
     kv_pad = kp.shape[1]
 
@@ -135,7 +150,7 @@ def _flash_forward(q, k, v, causal: bool, sm_scale: float, interpret: bool):
         _flash_kernel, block_k=BLOCK_K, causal=causal,
         sm_scale=sm_scale, kv_len=kv_len, kv_pad=kv_pad)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, t_pad // BLOCK_Q),
         in_specs=[
@@ -143,32 +158,183 @@ def _flash_forward(q, k, v, causal: bool, sm_scale: float, interpret: bool):
             pl.BlockSpec((1, kv_pad, d_pad), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, kv_pad, d_pad), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, BLOCK_Q, d_pad), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t_pad, d_pad), q.dtype),
+        out_specs=(
+            pl.BlockSpec((1, BLOCK_Q, d_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, BLOCK_Q), lambda b, i: (b, 0, i)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t_pad, d_pad), q.dtype),
+            jax.ShapeDtypeStruct((bh, 8, t_pad), jnp.float32),
+        ),
         interpret=interpret,
     )(qp, kp, vp)
 
-    out = out.reshape(batch, heads, t_pad, d_pad)
-    return out[:, :, :seq_len, :head_dim]
+    out = out.reshape(batch, heads, t_pad, d_pad)[:, :, :seq_len, :head_dim]
+    lse = lse.reshape(batch, heads, 8, t_pad)[:, :, 0, :seq_len]
+    return out, lse
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool,
+                         sm_scale: float, kv_len: int, kv_pad: int):
+    """dQ, tiled over query blocks: dq = Σ_kb (p∘(dpᵀv − δ))·scale @ k."""
+    q_block = q_ref.shape[1]
+    qi = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+    do = do_ref[0].astype(jnp.float32)          # (bq, d)
+    lse = lse_ref[0]                            # (bq,)
+    delta = delta_ref[0]                        # (bq,)
+    row_ids = qi * q_block + jax.lax.broadcasted_iota(
+        jnp.int32, (q_block, block_k), 0)
+
+    num_k_blocks = pl.cdiv(kv_pad, block_k)
+    if causal:
+        last_row = (qi + 1) * q_block - 1
+        num_k_blocks = jnp.minimum(num_k_blocks, pl.cdiv(last_row + 1, block_k))
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        col_ids = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (q_block, block_k), 1)
+        mask = col_ids < kv_len
+        if causal:
+            mask = mask & (col_ids <= row_ids)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((q_block, q_ref.shape[2]), jnp.float32)
+    dq = jax.lax.fori_loop(0, num_k_blocks, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          sm_scale: float, kv_len: int, t_pad: int):
+    """dK/dV, tiled over key blocks: dv = Σ_qb pᵀ·do; dk = Σ_qb dsᵀ·q·scale."""
+    block_k = k_ref.shape[1]
+    kb = pl.program_id(1)
+
+    k_blk = k_ref[0].astype(jnp.float32)        # (bk, d)
+    v_blk = v_ref[0].astype(jnp.float32)
+    col_ids = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    col_valid = col_ids < kv_len
+
+    num_q_blocks = t_pad // block_q
+    # Causal: query blocks strictly before this key block see none of it.
+    qb_start = (kb * block_k) // block_q if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q)]
+        delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q)]
+
+        s = jnp.dot(q_blk * sm_scale, k_blk.T,
+                    preferred_element_type=jnp.float32)
+        mask = col_valid
+        if causal:
+            row_ids = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = mask & (col_ids <= row_ids)
+        p = jnp.where(mask, jnp.exp(s - lse_blk[:, None]), 0.0)
+
+        dv = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk[:, None]) * sm_scale
+        dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    zeros = jnp.zeros((block_k, k_ref.shape[2]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qb_start, num_q_blocks, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, interpret):
+    batch, heads, seq_len, head_dim = q.shape
+    kv_len = k.shape[2]
+
+    qp, kp, vp, d_pad = _pad_inputs(q, k, v)
+    bh, t_pad, _ = qp.shape
+    kv_pad = kp.shape[1]
+    gp = _pad_to(_pad_to(g, 2, BLOCK_Q), 3, LANE).reshape(bh, t_pad, d_pad)
+    # δ = rowsum(dO ∘ O): cheap elementwise — plain XLA, padded with zeros so
+    # padding query rows contribute nothing in the kernels.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = _pad_to(delta, 2, BLOCK_Q).reshape(bh, t_pad)
+    lse_p = _pad_to(lse, 2, BLOCK_Q).reshape(bh, t_pad)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, block_k=BLOCK_K, causal=causal,
+        sm_scale=sm_scale, kv_len=kv_len, kv_pad=kv_pad)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, t_pad // BLOCK_Q),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, kv_pad, d_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, kv_pad, d_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, BLOCK_Q, d_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, d_pad), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_pad, d_pad), q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp, gp, lse_p, delta)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, block_q=BLOCK_Q, causal=causal,
+        sm_scale=sm_scale, kv_len=kv_len, t_pad=t_pad)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, kv_pad // BLOCK_K),
+        in_specs=[
+            pl.BlockSpec((1, t_pad, d_pad), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, BLOCK_K, d_pad), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, BLOCK_K, d_pad), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, t_pad, d_pad), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, t_pad), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, t_pad), lambda b, j: (b, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, BLOCK_K, d_pad), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, BLOCK_K, d_pad), lambda b, j: (b, j, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, kv_pad, d_pad), k.dtype),
+            jax.ShapeDtypeStruct((bh, kv_pad, d_pad), v.dtype),
+        ),
+        interpret=interpret,
+    )(qp, kp, vp, gp, lse_p, delta)
+
+    dq = dq.reshape(batch, heads, t_pad, d_pad)[:, :, :seq_len, :head_dim]
+    dk = dk.reshape(batch, heads, kv_pad, d_pad)[:, :, :kv_len, :head_dim]
+    dv = dv.reshape(batch, heads, kv_pad, d_pad)[:, :, :kv_len, :head_dim]
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash_attention(q, k, v, causal, sm_scale, interpret):
-    return _flash_forward(q, k, v, causal, sm_scale, interpret)
+    out, _ = _flash_forward(q, k, v, causal, sm_scale, interpret)
+    return out
 
 
 def _flash_fwd_rule(q, k, v, causal, sm_scale, interpret):
-    return _flash_forward(q, k, v, causal, sm_scale, interpret), (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, sm_scale, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, sm_scale, interpret, residuals, g):
-    # Rematerialized backward through the XLA reference (see module docstring).
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: reference_attention(
-            q_, k_, v_, causal=causal, sm_scale=sm_scale),
-        q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return _flash_backward(q, k, v, out, lse, g, causal, sm_scale, interpret)
 
 
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
