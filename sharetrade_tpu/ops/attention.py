@@ -183,8 +183,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     q = q_ref[0].astype(jnp.float32) * sm_scale
     do = do_ref[0].astype(jnp.float32)          # (bq, d)
-    lse = lse_ref[0]                            # (bq,)
-    delta = delta_ref[0]                        # (bq,)
+    # lse/delta arrive broadcast over an 8-row sublane axis — the same
+    # (8, 128)-legality workaround the forward uses to store lse (see
+    # _flash_kernel); row 0 carries the real values.
+    lse = lse_ref[0][0]                         # (bq,)
+    delta = delta_ref[0][0]                     # (bq,)
     row_ids = qi * q_block + jax.lax.broadcasted_iota(
         jnp.int32, (q_block, block_k), 0)
 
@@ -233,8 +236,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q)]
-        delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q)]
+        lse_blk = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        delta_blk = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
 
         s = jnp.dot(q_blk * sm_scale, k_blk.T,
                     preferred_element_type=jnp.float32)
@@ -270,6 +273,12 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, interpret):
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = _pad_to(delta, 2, BLOCK_Q).reshape(bh, t_pad)
     lse_p = _pad_to(lse, 2, BLOCK_Q).reshape(bh, t_pad)
+    # Sublane-broadcast to (bh, 8, t_pad): a flat (1, block_q) block over a
+    # (bh, t_pad) array violates Mosaic's (8, 128) block-divisibility rule
+    # whenever bh > 1 — the forward's lse output hit the same wall and stores
+    # the broadcast layout; the backward reads row 0 back out.
+    delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, t_pad))
+    lse_p = jnp.broadcast_to(lse_p[:, None, :], (bh, 8, t_pad))
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, block_k=BLOCK_K, causal=causal,
@@ -282,8 +291,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, interpret):
             pl.BlockSpec((1, kv_pad, d_pad), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, kv_pad, d_pad), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, BLOCK_Q, d_pad), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 8, BLOCK_Q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 8, BLOCK_Q), lambda b, i: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, BLOCK_Q, d_pad), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t_pad, d_pad), q.dtype),
@@ -301,8 +310,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, interpret):
             pl.BlockSpec((1, BLOCK_K, d_pad), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, BLOCK_K, d_pad), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, t_pad, d_pad), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, t_pad), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, t_pad), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, 8, t_pad), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 8, t_pad), lambda b, j: (b, 0, 0)),
         ],
         out_specs=(
             pl.BlockSpec((1, BLOCK_K, d_pad), lambda b, j: (b, j, 0)),

@@ -70,6 +70,24 @@ def test_gradients_match_reference():
                                    atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.parametrize("shape", [(2, 4, 202, 64), (1, 1, 64, 32), (3, 2, 256, 128)])
+def test_grad_compiles_on_backend(shape):
+    """AOT-compile jax.grad of the kernel on the attached backend.
+
+    BlockSpec tiling legality only surfaces in real Mosaic lowering — the
+    interpreter accepts layouts the TPU compiler rejects, which is exactly how
+    round 1 shipped a backward that failed to lower for every bh > 1 shape.
+    On a CPU-only host this degrades to interpret mode (still checks tracing).
+    """
+    b, h, t, d = shape
+    x = jnp.zeros((b, h, t, d), jnp.float32)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, use_pallas=True))
+
+    jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(x, x, x).compile()
+
+
 def test_cross_attention_kv_longer_than_q():
     # Non-causal cross-attention with kv_len != q_len: real keys beyond
     # q_len must participate, padding beyond kv_len must not.
