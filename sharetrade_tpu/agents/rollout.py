@@ -113,16 +113,29 @@ def discounted_returns(rewards: jax.Array, active: jax.Array,
 
 
 def gae_advantages(rewards, values, active, bootstrap, gamma, lam):
-    """Generalized Advantage Estimation over (T, B) arrays."""
+    """Generalized Advantage Estimation over (T, B) arrays.
+
+    Bootstrapping is gated on the NEXT step's liveness: at an episode's last
+    real step the terminal state's value must not leak into delta or flow back
+    through the gamma*lam recursion — the same masking collect_rollout applies
+    to its bootstrap value. (Gating on the step-start flag let the frozen
+    terminal value into both terms, a net +gamma*(1-lam)*V_terminal bias on
+    the final real step's advantage.)
+    """
     next_values = jnp.concatenate([values[1:], bootstrap[None]], axis=0)
+    # Liveness of the successor state. The final slice uses 1: its successor
+    # value is `bootstrap`, which collect_rollout already zero-masks when the
+    # episode has ended.
+    next_active = jnp.concatenate(
+        [active[1:], jnp.ones_like(bootstrap)[None]], axis=0)
 
     def backward(adv_next, inputs):
-        reward, value, next_value, live = inputs
-        delta = reward + gamma * next_value * live - value
-        adv = delta + gamma * lam * adv_next * live
+        reward, value, next_value, live_next = inputs
+        delta = reward + gamma * next_value * live_next - value
+        adv = delta + gamma * lam * adv_next * live_next
         return adv, adv
 
     _, advantages = jax.lax.scan(
         backward, jnp.zeros_like(bootstrap),
-        (rewards, values, next_values, active), reverse=True)
+        (rewards, values, next_values, next_active), reverse=True)
     return advantages

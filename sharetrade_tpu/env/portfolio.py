@@ -46,9 +46,10 @@ def make_portfolio_env(prices, window: int = 201,
     if prices.ndim != 2:
         raise ValueError(f"prices must be (A, T), got {prices.shape}")
     num_assets, total = int(prices.shape[0]), int(prices.shape[1])
-    if total <= window + 1:
+    if total <= window:
+        # Matches trading.env_from_prices: window + 1 prices = 1-step episode.
         raise ValueError(
-            f"price count ({total}) must exceed window + 1 ({window + 1})")
+            f"price count ({total}) must exceed the window ({window})")
     if initial_shares is None:
         initial_shares = jnp.zeros((num_assets,), jnp.float32)
     else:

@@ -73,11 +73,13 @@ def env_from_prices(
     prices = jnp.asarray(prices, dtype=jnp.float32)
     if prices.ndim != 1:
         raise ValueError(f"prices must be 1-D, got shape {prices.shape}")
-    if prices.shape[0] <= window + 1:
+    if prices.shape[0] <= window:
         # Reference guard: "Stock price count should be more than Tensorflow
-        # input nodes" (TrainerChildActor.scala:69-70).
+        # input nodes" (TrainerChildActor.scala:69-70). Exactly window + 1
+        # prices is a valid one-step episode (the trade price prices[window]
+        # is in bounds), matching the reference bound size > h1Dim + 1.
         raise ValueError(
-            f"price count ({prices.shape[0]}) must exceed window + 1 ({window + 1})"
+            f"price count ({prices.shape[0]}) must exceed the window ({window})"
         )
     return EnvParams(
         prices=prices,

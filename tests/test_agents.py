@@ -153,6 +153,40 @@ class TestQLearnTD:
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+class TestGAE:
+    def test_matches_hand_rolled_recursion_with_mid_rollout_termination(self):
+        """Episode ends at step 3 of a 5-step unroll: padded steps carry
+        frozen values, and the terminal value must not bootstrap into the
+        last real step's advantage (next-step liveness gating)."""
+        from sharetrade_tpu.agents.rollout import gae_advantages
+
+        gamma, lam = 0.9, 0.8
+        # (T=5, B=1); steps 0..2 real, 3..4 padding (env frozen at terminal).
+        rewards = jnp.array([1.0, -0.5, 2.0, 0.0, 0.0])[:, None]
+        values = jnp.array([0.3, 0.1, 0.4, 0.7, 0.7])[:, None]
+        active = jnp.array([1.0, 1.0, 1.0, 0.0, 0.0])[:, None]
+        bootstrap = jnp.zeros((1,))  # collect_rollout zero-masks it at the end
+
+        got = np.asarray(gae_advantages(
+            rewards, values, active, bootstrap, gamma, lam)).ravel()
+
+        # Hand recursion with next-step liveness: live_next = active[t+1]
+        # (1.0 for the final slice — its successor value is the bootstrap).
+        live_next = [1.0, 1.0, 0.0, 0.0, 1.0]
+        next_values = [0.1, 0.4, 0.7, 0.7, 0.0]
+        adv = [0.0] * 5
+        adv_next = 0.0
+        for t in reversed(range(5)):
+            delta = (float(rewards[t, 0])
+                     + gamma * next_values[t] * live_next[t]
+                     - float(values[t, 0]))
+            adv[t] = delta + gamma * lam * adv_next * live_next[t]
+            adv_next = adv[t]
+        np.testing.assert_allclose(got, adv, rtol=1e-6)
+        # The last REAL step's advantage is exactly r - V(s): no V_terminal.
+        np.testing.assert_allclose(got[2], 2.0 - 0.4, rtol=1e-6)
+
+
 class TestReplayBuffer:
     def test_push_wraps_and_masks(self):
         rb = ReplayBuffer.create(8, 3)

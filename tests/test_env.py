@@ -36,7 +36,13 @@ class TestConstruction:
         # Reference guard: price count must exceed input nodes
         # (TrainerChildActor.scala:69-70).
         with pytest.raises(ValueError, match="must exceed"):
-            env_from_prices(jnp.ones(WINDOW + 1), window=WINDOW)
+            env_from_prices(jnp.ones(WINDOW), window=WINDOW)
+
+    def test_accepts_one_step_episode(self):
+        # Exactly window + 1 prices is a valid 1-step episode — the reference
+        # bound (sharePrices.size > h1Dim + 1) accepts it.
+        p = env_from_prices(jnp.arange(1.0, WINDOW + 2.0), window=WINDOW)
+        assert num_steps(p) == 1
 
     def test_num_steps(self):
         assert num_steps(make_params(n=10)) == 6  # len - window
