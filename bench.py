@@ -28,6 +28,7 @@ from sharetrade_tpu.agents import build_agent
 from sharetrade_tpu.config import FrameworkConfig
 from sharetrade_tpu.data.synthetic import synthetic_price_series
 from sharetrade_tpu.env import trading
+from sharetrade_tpu.utils.flops import mfu
 
 REFERENCE_CEILING_STEPS_PER_S = 58_450 / 1_005.0  # ≈58.2, derivation above
 
@@ -73,6 +74,10 @@ def main() -> None:
         "value": round(rate, 2),
         "unit": "agent-steps/s",
         "vs_baseline": round(rate / REFERENCE_CEILING_STEPS_PER_S, 2),
+        # Chip-utilization context (utils/flops.py counting rules): the
+        # reference workload shape is 10 tiny agents, so this is expected to
+        # be launch-bound; benchmarks/run_all.py carries saturating configs.
+        "mfu": round(mfu(rate, cfg, env_params.window + 2), 6),
     }))
 
 

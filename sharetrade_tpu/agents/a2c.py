@@ -49,7 +49,8 @@ def make_a2c_agent(model: Model, env: TradingEnv,
         denom = jnp.maximum(jnp.sum(weight), 1.0)
 
         def loss_fn(params):
-            logits, values = replay_forward(model, params, traj, init_carry)
+            logits, values = replay_forward(model, params, traj, init_carry,
+                                            remat=cfg.remat)
             log_probs = jax.nn.log_softmax(logits)
             logp = jnp.take_along_axis(
                 log_probs, traj.action[..., None], axis=-1)[..., 0]

@@ -26,7 +26,7 @@ from sharetrade_tpu.agents.base import (
 )
 from sharetrade_tpu.config import LearnerConfig
 from sharetrade_tpu.env.core import TradingEnv
-from sharetrade_tpu.models.core import Model
+from sharetrade_tpu.models.core import Model, apply_batched
 
 
 @struct.dataclass
@@ -111,7 +111,7 @@ def make_dqn_agent(model: Model, env: TradingEnv,
         )
 
     def q_batch(params, obs_batch):
-        outs, _ = jax.vmap(lambda o: model.apply(params, o, ()))(obs_batch)
+        outs, _ = apply_batched(model, params, obs_batch, ())
         return outs.logits
 
     def one_step(ts: TrainState, _):

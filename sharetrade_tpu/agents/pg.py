@@ -50,7 +50,8 @@ def make_pg_agent(model: Model, env: TradingEnv,
         adv = (returns - baseline) * weight
 
         def loss_fn(params):
-            logits, _ = replay_forward(model, params, traj, init_carry)
+            logits, _ = replay_forward(model, params, traj, init_carry,
+                                       remat=cfg.remat)
             logp = jnp.take_along_axis(
                 jax.nn.log_softmax(logits), traj.action[..., None], axis=-1
             )[..., 0]

@@ -57,7 +57,8 @@ def make_ppo_agent(model: Model, env: TradingEnv,
         )
 
     def minibatch_loss(params, traj_mb, carry_mb, adv_mb, ret_mb):
-        logits, values = replay_forward(model, params, traj_mb, carry_mb)
+        logits, values = replay_forward(model, params, traj_mb, carry_mb,
+                                        remat=cfg.remat)
         log_probs = jax.nn.log_softmax(logits)
         logp = jnp.take_along_axis(
             log_probs, traj_mb.action[..., None], axis=-1)[..., 0]

@@ -34,7 +34,7 @@ from sharetrade_tpu.agents.base import (
 )
 from sharetrade_tpu.config import LearnerConfig
 from sharetrade_tpu.env.core import TradingEnv
-from sharetrade_tpu.models.core import Model
+from sharetrade_tpu.models.core import Model, apply_batched
 
 
 def make_qlearn_agent(model: Model, env: TradingEnv,
@@ -57,8 +57,7 @@ def make_qlearn_agent(model: Model, env: TradingEnv,
         )
 
     def apply_batch(params, obs_batch, carry_batch):
-        outs, carries = jax.vmap(
-            lambda o, c: model.apply(params, o, c))(obs_batch, carry_batch)
+        outs, carries = apply_batched(model, params, obs_batch, carry_batch)
         return outs.logits, carries
 
     def one_step(ts: TrainState, _):

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 from sharetrade_tpu.agents.base import TrainState
 from sharetrade_tpu.env.core import TradingEnv
-from sharetrade_tpu.models.core import Model
+from sharetrade_tpu.models.core import Model, apply_batched
 
 
 class StepData(NamedTuple):
@@ -49,8 +49,7 @@ def collect_rollout(model: Model, env: TradingEnv,
 
         active = (env_state.t < horizon).astype(jnp.float32)
         obs = jax.vmap(env.observe)(env_state)
-        outs, new_model_carry = jax.vmap(
-            lambda o, c: model.apply(ts.params, o, c))(obs, model_carry)
+        outs, new_model_carry = apply_batched(model, ts.params, obs, model_carry)
         actions = jax.vmap(
             lambda k, lg: jax.random.categorical(k, lg))(act_keys, outs.logits)
         actions = actions.astype(jnp.int32)
@@ -74,8 +73,7 @@ def collect_rollout(model: Model, env: TradingEnv,
 
     # Bootstrap value for the state the unroll stopped at.
     final_obs = jax.vmap(env.observe)(env_state)
-    final_outs, _ = jax.vmap(
-        lambda o, c: model.apply(ts.params, o, c))(final_obs, model_carry)
+    final_outs, _ = apply_batched(model, ts.params, final_obs, model_carry)
     bootstrap = final_outs.value * (env_state.t < horizon).astype(jnp.float32)
 
     steps_taken = jnp.sum(traj.active[:, 0] > 0).astype(jnp.int32)
@@ -84,13 +82,26 @@ def collect_rollout(model: Model, env: TradingEnv,
     return new_ts, traj, bootstrap, init_carry
 
 
-def replay_forward(model: Model, params: Any, traj: StepData, init_carry):
+def replay_forward(model: Model, params: Any, traj: StepData, init_carry,
+                   *, remat: bool = False):
     """Recompute (logits, values) along a stored trajectory under ``params``,
-    threading the recurrent carry — the differentiable forward for losses."""
+    threading the recurrent carry — the differentiable forward for losses.
+
+    ``remat=True`` checkpoints each time-step's forward: the backward then
+    recomputes activations from the stored observations instead of keeping
+    every step's intermediates live across the scan — the standard
+    FLOPs-for-HBM trade that makes large agent batches fit (a 1024-agent
+    transformer unroll otherwise wants ~4x the chip's HBM in residuals).
+    """
+
+    def fwd(params, obs_t, model_carry):
+        return apply_batched(model, params, obs_t, model_carry)
+
+    if remat:
+        fwd = jax.checkpoint(fwd)
 
     def one_step(model_carry, obs_t):
-        outs, new_carry = jax.vmap(
-            lambda o, c: model.apply(params, o, c))(obs_t, model_carry)
+        outs, new_carry = fwd(params, obs_t, model_carry)
         return new_carry, (outs.logits, outs.value)
 
     _, (logits, values) = jax.lax.scan(one_step, init_carry, traj.obs)

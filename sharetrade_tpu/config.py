@@ -79,6 +79,10 @@ class LearnerConfig:
     ppo_epochs: int = 4
     ppo_minibatches: int = 4
     unroll_len: int = 128
+    # Rematerialize the loss replay forward (jax.checkpoint): trades ~1 extra
+    # forward per backward for O(T) instead of O(T x activations) residual
+    # memory — required for large agent batches on big models.
+    remat: bool = False
 
 
 @dataclass

@@ -40,6 +40,22 @@ class Model:
     obs_dim: int = 0
     num_actions: int = 3
     name: str = "model"
+    # Optional native batched forward (params, (B, obs_dim), carry_batch) ->
+    # (ModelOut with leading B, carry_batch). Models whose hot path benefits
+    # from an explicit batch dimension (the transformer folds the agent batch
+    # into the flash kernel's batch*heads grid) provide this; everyone else
+    # gets vmap of `apply` via `apply_batched`.
+    apply_batch: Callable[[Any, jax.Array, Any], tuple[ModelOut, Any]] | None = None
+
+
+def apply_batched(model: Model, params: Any, obs_batch: jax.Array,
+                  carry_batch: Any) -> tuple[ModelOut, Any]:
+    """Batched forward over agents — the one call site shape every learner
+    uses (SURVEY.md §7.2: workers become a batch dimension, not actors)."""
+    if model.apply_batch is not None:
+        return model.apply_batch(params, obs_batch, carry_batch)
+    return jax.vmap(
+        lambda o, c: model.apply(params, o, c))(obs_batch, carry_batch)
 
 
 def dense_init(key: jax.Array, in_dim: int, out_dim: int, *,

@@ -64,37 +64,49 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         return params
 
     def tokenize(obs):
-        prices = obs[:window].astype(jnp.float32)
-        budget, shares = obs[window], obs[window + 1]
-        anchor = jnp.maximum(prices[-1], 1e-6)
+        """(B, obs_dim) -> (B, seq, 3) token features."""
+        prices = obs[:, :window].astype(jnp.float32)
+        budget, shares = obs[:, window], obs[:, window + 1]
+        anchor = jnp.maximum(prices[:, -1:], 1e-6)               # (B, 1)
         rel = prices / anchor - 1.0
+        logp = jnp.log(jnp.maximum(prices, 1e-6))
         log_ret = jnp.concatenate(
-            [jnp.zeros((1,)), jnp.log(jnp.maximum(prices[1:], 1e-6))
-             - jnp.log(jnp.maximum(prices[:-1], 1e-6))])
+            [jnp.zeros_like(logp[:, :1]), logp[:, 1:] - logp[:, :-1]], axis=1)
         tick_tokens = jnp.stack(
-            [rel, log_ret, jnp.zeros_like(rel)], axis=-1)        # (window, 3)
-        portfolio_token = jnp.array(
-            [budget / (anchor * 100.0), shares / 100.0, 1.0], jnp.float32)
-        return jnp.concatenate([tick_tokens, portfolio_token[None, :]])  # (seq, 3)
+            [rel, log_ret, jnp.zeros_like(rel)], axis=-1)        # (B, window, 3)
+        portfolio_token = jnp.stack(
+            [budget / (anchor[:, 0] * 100.0), shares / 100.0,
+             jnp.ones_like(budget)], axis=-1)                    # (B, 3)
+        return jnp.concatenate([tick_tokens, portfolio_token[:, None, :]], axis=1)
 
-    def apply(params, obs, carry):
+    def apply_batch(params, obs, carry):
+        """Native batched forward: the whole agent batch rides one flash
+        kernel call per layer with a batch*heads grid — no batch-1 programs
+        (the round-1 pathology: per-agent vmapped kernel invocations)."""
+        bsz = obs.shape[0]
         tokens = tokenize(obs).astype(dtype)
-        x = dense(params["embed"], tokens) + params["pos"]        # (seq, d_model)
+        x = dense(params["embed"], tokens) + params["pos"]       # (B, seq, d)
         for blk in params["blocks"]:
             h = _layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
-            qkv = dense(blk["qkv"], h).reshape(seq_len, 3, num_heads, head_dim)
+            qkv = dense(blk["qkv"], h).reshape(
+                bsz, seq_len, 3, num_heads, head_dim)
             # kernel expects (batch, heads, seq, head_dim)
-            q, k, v = (qkv[:, j].transpose(1, 0, 2)[None] for j in range(3))
+            q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
             attn = flash_attention(q, k, v, causal=True, use_pallas=use_pallas)
-            attn = attn[0].transpose(1, 0, 2).reshape(seq_len, d_model).astype(dtype)
+            attn = attn.transpose(0, 2, 1, 3).reshape(
+                bsz, seq_len, d_model).astype(dtype)
             x = x + dense(blk["proj"], attn)
             h = _layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
             x = x + dense(blk["mlp_out"], jax.nn.gelu(dense(blk["mlp_in"], h)))
-        summary = _layer_norm(x[-1], params["final_ln"]["scale"],
+        summary = _layer_norm(x[:, -1], params["final_ln"]["scale"],
                               params["final_ln"]["bias"])
         logits = dense(params["policy"], summary).astype(jnp.float32)
-        value = dense(params["value"], summary).astype(jnp.float32)[0]
+        value = dense(params["value"], summary).astype(jnp.float32)[:, 0]
         return ModelOut(logits=logits, value=value), carry
 
-    return Model(init=init, apply=apply, obs_dim=obs_dim,
-                 num_actions=num_actions, name="transformer")
+    def apply(params, obs, carry):
+        outs, carry = apply_batch(params, obs[None], carry)
+        return ModelOut(logits=outs.logits[0], value=outs.value[0]), carry
+
+    return Model(init=init, apply=apply, apply_batch=apply_batch,
+                 obs_dim=obs_dim, num_actions=num_actions, name="transformer")

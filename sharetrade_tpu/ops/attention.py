@@ -34,6 +34,27 @@ LANE = 128
 _NEG_INF = -1e30
 
 
+def _dot(a, b):
+    """MXU matmul with f32 accumulation. For bf16 operands the precision is
+    pinned to DEFAULT (native single-pass bf16): a globally-configured
+    "highest" precision (the test suite pins it for f32 parity) has no bf16
+    meaning and crashes Mosaic's matmul lowering."""
+    precision = (jax.lax.Precision.DEFAULT
+                 if a.dtype == jnp.bfloat16 or b.dtype == jnp.bfloat16
+                 else None)
+    return jnp.dot(a, b, preferred_element_type=jnp.float32,
+                   precision=precision)
+
+
+def _block_size(padded: int) -> int:
+    """Adaptive tiling: when the (128-padded) extent is a 256 multiple, use
+    256-wide blocks — short sequences (the 202-token tick window pads to 256)
+    then run one block per program, collapsing the K loop and the q-block
+    grid dimension whose overhead dominates these shapes. Other extents keep
+    the classic 128 tiles (a block must divide the padded extent)."""
+    return 256 if padded % 256 == 0 else 128
+
+
 def reference_attention(q, k, v, *, causal: bool = True, sm_scale: float | None = None):
     """Plain XLA attention — the numeric ground truth for the kernel."""
     if sm_scale is None:
@@ -60,7 +81,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     head_dim = q_ref.shape[2]
     qi = pl.program_id(1)
 
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d)
+    # Inputs stay in their native dtype (bf16 rides the MXU single-pass);
+    # accumulation and softmax run in f32 via preferred_element_type.
+    q = q_ref[0]  # (block_q, d)
 
     num_k_blocks = pl.cdiv(kv_pad, block_k)
     if causal:
@@ -73,9 +96,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
     def body(kb, carry):
         acc, m_prev, l_prev = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # (bq, bk)
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = _dot(q, k_blk.T) * sm_scale  # (bq, bk)
 
         col_ids = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (q_block, block_k), 1)
@@ -88,8 +111,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jnp.dot(
-            p, v_blk, preferred_element_type=jnp.float32)
+        acc = acc * alpha[:, None] + _dot(p.astype(v_blk.dtype), v_blk)
         return acc, m_new, l_new
 
     acc0 = jnp.zeros((q_block, head_dim), jnp.float32)
@@ -145,22 +167,23 @@ def _flash_forward(q, k, v, causal: bool, sm_scale: float, interpret: bool):
     qp, kp, vp, d_pad = _pad_inputs(q, k, v)
     bh, t_pad, _ = qp.shape
     kv_pad = kp.shape[1]
+    block_q, block_k = _block_size(t_pad), _block_size(kv_pad)
 
     kernel = functools.partial(
-        _flash_kernel, block_k=BLOCK_K, causal=causal,
+        _flash_kernel, block_k=block_k, causal=causal,
         sm_scale=sm_scale, kv_len=kv_len, kv_pad=kv_pad)
 
     out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, t_pad // BLOCK_Q),
+        grid=(bh, t_pad // block_q),
         in_specs=[
-            pl.BlockSpec((1, BLOCK_Q, d_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, kv_pad, d_pad), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, kv_pad, d_pad), lambda b, i: (b, 0, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, BLOCK_Q, d_pad), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 8, BLOCK_Q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, t_pad, d_pad), q.dtype),
@@ -181,8 +204,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     q_block = q_ref.shape[1]
     qi = pl.program_id(1)
 
-    q = q_ref[0].astype(jnp.float32) * sm_scale
-    do = do_ref[0].astype(jnp.float32)          # (bq, d)
+    q = q_ref[0]                                # (bq, d) native dtype
+    do = do_ref[0]                              # (bq, d)
     # lse/delta arrive broadcast over an 8-row sublane axis — the same
     # (8, 128)-legality workaround the forward uses to store lse (see
     # _flash_kernel); row 0 carries the real values.
@@ -197,18 +220,18 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         num_k_blocks = jnp.minimum(num_k_blocks, pl.cdiv(last_row + 1, block_k))
 
     def body(kb, dq):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = _dot(q, k_blk.T) * sm_scale
         col_ids = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (q_block, block_k), 1)
         mask = col_ids < kv_len
         if causal:
             mask = mask & (col_ids <= row_ids)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
-        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
-        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+        dp = _dot(do, v_blk.T)
+        ds = (p * (dp - delta[:, None]) * sm_scale).astype(k_blk.dtype)
+        return dq + _dot(ds, k_blk)
 
     dq0 = jnp.zeros((q_block, q_ref.shape[2]), jnp.float32)
     dq = jax.lax.fori_loop(0, num_k_blocks, body, dq0)
@@ -222,8 +245,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     block_k = k_ref.shape[1]
     kb = pl.program_id(1)
 
-    k_blk = k_ref[0].astype(jnp.float32)        # (bk, d)
-    v_blk = v_ref[0].astype(jnp.float32)
+    k_blk = k_ref[0]                            # (bk, d) native dtype
+    v_blk = v_ref[0]
     col_ids = kb * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
     col_valid = col_ids < kv_len
@@ -234,13 +257,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(qb, carry):
         dk, dv = carry
-        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :]
         lse_blk = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
         delta_blk = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
 
-        s = jnp.dot(q_blk * sm_scale, k_blk.T,
-                    preferred_element_type=jnp.float32)
+        s = _dot(q_blk, k_blk.T) * sm_scale
         mask = col_valid
         if causal:
             row_ids = qb * block_q + jax.lax.broadcasted_iota(
@@ -248,10 +270,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             mask = mask & (col_ids <= row_ids)
         p = jnp.where(mask, jnp.exp(s - lse_blk[:, None]), 0.0)
 
-        dv = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
-        dp = jnp.dot(do_blk, v_blk.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk[:, None]) * sm_scale
-        dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+        dv = dv + _dot(p.astype(do_blk.dtype).T, do_blk)
+        dp = _dot(do_blk, v_blk.T)
+        ds = (p * (dp - delta_blk[:, None]) * sm_scale).astype(q_blk.dtype)
+        dk = dk + _dot(ds.T, q_blk)
         return dk, dv
 
     zeros = jnp.zeros((block_k, k_ref.shape[2]), jnp.float32)
@@ -280,42 +302,43 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, interpret):
     delta = jnp.broadcast_to(delta[:, None, :], (bh, 8, t_pad))
     lse_p = jnp.broadcast_to(lse_p[:, None, :], (bh, 8, t_pad))
 
+    block_q, block_k = _block_size(t_pad), _block_size(kv_pad)
     dq_kernel = functools.partial(
-        _flash_bwd_dq_kernel, block_k=BLOCK_K, causal=causal,
+        _flash_bwd_dq_kernel, block_k=block_k, causal=causal,
         sm_scale=sm_scale, kv_len=kv_len, kv_pad=kv_pad)
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(bh, t_pad // BLOCK_Q),
+        grid=(bh, t_pad // block_q),
         in_specs=[
-            pl.BlockSpec((1, BLOCK_Q, d_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, kv_pad, d_pad), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, kv_pad, d_pad), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, BLOCK_Q, d_pad), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 8, BLOCK_Q), lambda b, i: (b, 0, i)),
-            pl.BlockSpec((1, 8, BLOCK_Q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i)),
         ],
-        out_specs=pl.BlockSpec((1, BLOCK_Q, d_pad), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t_pad, d_pad), q.dtype),
         interpret=interpret,
     )(qp, kp, vp, gp, lse_p, delta)
 
     dkv_kernel = functools.partial(
-        _flash_bwd_dkv_kernel, block_q=BLOCK_Q, causal=causal,
+        _flash_bwd_dkv_kernel, block_q=block_q, causal=causal,
         sm_scale=sm_scale, kv_len=kv_len, t_pad=t_pad)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(bh, kv_pad // BLOCK_K),
+        grid=(bh, kv_pad // block_k),
         in_specs=[
             pl.BlockSpec((1, t_pad, d_pad), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, BLOCK_K, d_pad), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, BLOCK_K, d_pad), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, t_pad, d_pad), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, 8, t_pad), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, 8, t_pad), lambda b, j: (b, 0, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, BLOCK_K, d_pad), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, BLOCK_K, d_pad), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, j: (b, j, 0)),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, kv_pad, d_pad), k.dtype),

@@ -1,0 +1,108 @@
+"""Analytic model-FLOPs accounting and MFU for the benchmark harness.
+
+Round 1 reported only agent-steps/s against a derived CPU ceiling, which
+flatters without informing (a 3,440x multiplier on a 41k-param MLP is ~10
+MFLOP/s of useful math). These helpers put model FLOPs/step and MFU — the
+fraction of the chip's peak matmul throughput the workload achieves — next to
+every throughput number so chip utilization is visible in our own tables.
+
+Counting rules (standard MFU conventions, stated explicitly):
+- A dense layer in->out over N rows costs 2*N*in*out FLOPs.
+- Causal attention is counted at its *useful* cost, ~half the full score
+  matrix: 2*seq^2*d per attention matmul pair member (the Pallas kernel skips
+  fully-masked blocks, so this reflects work actually scheduled).
+- A backward pass costs 2x the forward it differentiates.
+- Env-step arithmetic, optimizer updates, layernorms, and softmaxes are
+  ignored (orders of magnitude below the matmuls).
+
+Peak numbers are per-chip dense bf16 matmul peaks. f32 inputs at JAX's
+default matmul precision also run single-pass bf16 on the MXU, so one peak
+serves both dtypes; "highest"-precision runs (parity tests) are not what we
+benchmark.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from sharetrade_tpu.config import FrameworkConfig, LearnerConfig, ModelConfig
+
+# device_kind substrings -> dense bf16 peak FLOP/s per chip.
+_PEAK_BY_KIND = (
+    ("v6 lite", 918e12),   # Trillium
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),   # v5e
+    ("v4", 275e12),
+)
+_DEFAULT_PEAK = 197e12
+
+
+def chip_peak_flops(device=None) -> float:
+    """Dense bf16 peak for the attached chip (fallback: v5e)."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in _PEAK_BY_KIND:
+        if sub in kind:
+            return peak
+    return _DEFAULT_PEAK
+
+
+def forward_flops_per_obs(model: ModelConfig, obs_dim: int) -> float:
+    """Matmul FLOPs for ONE observation's policy forward pass."""
+    acts = model.num_actions
+    if model.kind == "mlp":
+        h = model.hidden_dim
+        return 2.0 * h * (obs_dim + acts + 1)          # +1: value head
+    if model.kind == "lstm":
+        h = model.hidden_dim
+        return 2.0 * 4 * h * (obs_dim + h) + 2.0 * h * (acts + 1)
+    if model.kind == "transformer":
+        seq = obs_dim - 1                               # window + summary token
+        d = model.num_heads * model.head_dim
+        per_layer = (
+            6.0 * seq * d * d        # qkv projection
+            + 2.0 * seq * seq * d    # causal QK^T + PV (useful half of 4*s^2*d)
+            + 2.0 * seq * d * d      # output projection
+            + 16.0 * seq * d * d     # MLP in/out at ratio 4
+        )
+        return model.num_layers * per_layer + 2.0 * seq * 3 * d  # + embed
+    raise ValueError(f"unknown model kind {model.kind!r}")
+
+
+def forward_equivalents_per_agent_step(cfg: LearnerConfig,
+                                       num_agents: int) -> float:
+    """How many single-observation forward passes one agent-step of TRAINING
+    costs under each algorithm (backward = 2x the differentiated forward)."""
+    if cfg.algo == "qlearn":
+        # select fwd + stacked TD fwd over (s, s') + backward of that stack
+        # (stop_gradient zeroes the s' cotangents but the matmul grads still
+        # run full-size).
+        return 1.0 + 2.0 + 2.0 * 2.0
+    if cfg.algo in ("pg", "a2c"):
+        # rollout fwd + replay fwd + backward
+        return 1.0 + 1.0 + 2.0
+    if cfg.algo == "ppo":
+        # rollout fwd + ppo_epochs x (replay fwd + backward); minibatching
+        # repartitions the same totals.
+        return 1.0 + cfg.ppo_epochs * 3.0
+    if cfg.algo == "dqn":
+        # select fwd; per env-step the learner trains on replay_batch
+        # observations (online fwd + target fwd + backward), amortized over
+        # the agent batch.
+        per_replay = (cfg.replay_batch / max(num_agents, 1))
+        return 1.0 + per_replay * (1.0 + 1.0 + 2.0)
+    raise ValueError(f"unknown algo {cfg.algo!r}")
+
+
+def train_flops_per_agent_step(cfg: FrameworkConfig, obs_dim: int) -> float:
+    return (forward_flops_per_obs(cfg.model, obs_dim)
+            * forward_equivalents_per_agent_step(
+                cfg.learner, cfg.parallel.num_workers))
+
+
+def mfu(agent_steps_per_sec: float, cfg: FrameworkConfig, obs_dim: int,
+        device=None) -> float:
+    """Model FLOPs utilization in [0, 1]."""
+    achieved = agent_steps_per_sec * train_flops_per_agent_step(cfg, obs_dim)
+    return achieved / chip_peak_flops(device)
