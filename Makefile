@@ -1,0 +1,30 @@
+# Round-end gate and developer entry points.
+#
+# `make check` is the <5-minute gate to run before every milestone commit:
+# fast test subset (compile-heavy tests are marked `slow`) plus a backend
+# compile smoke that jits every kernel and its gradient on the attached
+# backend (TPU when present) — interpret-mode tests cannot catch Pallas
+# tiling legality, so the smoke compiles for real.
+
+PYTHON ?= python
+
+.PHONY: check test slow native bench clean
+
+check: native
+	$(PYTHON) -m pytest tests/ -q -m "not slow" -x
+	$(PYTHON) tools/smoke_compile.py
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+slow: native
+	$(PYTHON) -m pytest tests/ -q -m slow
+
+native:
+	$(MAKE) -C native
+
+bench:
+	$(PYTHON) bench.py
+
+clean:
+	$(MAKE) -C native clean

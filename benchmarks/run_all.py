@@ -14,8 +14,6 @@ import json
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
-
 import jax
 
 from sharetrade_tpu.agents import build_agent

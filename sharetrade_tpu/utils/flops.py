@@ -48,15 +48,25 @@ def chip_peak_flops(device=None) -> float:
     return _DEFAULT_PEAK
 
 
-def forward_flops_per_obs(model: ModelConfig, obs_dim: int) -> float:
-    """Matmul FLOPs for ONE observation's policy forward pass."""
+def forward_flops_per_obs(model: ModelConfig, obs_dim: int,
+                          algo: str = "qlearn") -> float:
+    """Matmul FLOPs for ONE observation's policy forward pass.
+
+    The MLP family has two distinct architectures (models/mlp.py): value-based
+    algos (qlearn/dqn) use ``q_mlp`` — obs->h->acts, no value head — while
+    pg/a2c/ppo use ``ac_mlp`` — obs->h, h->h torso, policy AND value heads.
+    """
     acts = model.num_actions
     if model.kind == "mlp":
         h = model.hidden_dim
-        return 2.0 * h * (obs_dim + acts + 1)          # +1: value head
+        if algo in ("qlearn", "dqn"):
+            return 2.0 * h * (obs_dim + acts)           # q_mlp: two denses
+        return 2.0 * h * (obs_dim + h + acts + 1)       # ac_mlp: torso2 + heads
     if model.kind == "lstm":
+        # lstm_policy (models/lstm.py): obs->h input dense, fused [x;h]->4h
+        # gate matmul (16*h^2), then policy + value heads.
         h = model.hidden_dim
-        return 2.0 * 4 * h * (obs_dim + h) + 2.0 * h * (acts + 1)
+        return 2.0 * h * obs_dim + 16.0 * h * h + 2.0 * h * (acts + 1)
     if model.kind == "transformer":
         seq = obs_dim - 1                               # window + summary token
         d = model.num_heads * model.head_dim
@@ -96,7 +106,7 @@ def forward_equivalents_per_agent_step(cfg: LearnerConfig,
 
 
 def train_flops_per_agent_step(cfg: FrameworkConfig, obs_dim: int) -> float:
-    return (forward_flops_per_obs(cfg.model, obs_dim)
+    return (forward_flops_per_obs(cfg.model, obs_dim, cfg.learner.algo)
             * forward_equivalents_per_agent_step(
                 cfg.learner, cfg.parallel.num_workers))
 

@@ -219,6 +219,7 @@ class TestReplayBuffer:
         np.testing.assert_allclose(np.asarray(rb.obs[0]), [1.0, 2.0])
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("algo", ["qlearn", "pg", "dqn", "a2c", "ppo"])
 def test_every_algorithm_trains_a_chunk(algo):
     cfg = tiny_config(algo)
@@ -247,6 +248,7 @@ def test_value_based_algos_reject_recurrent_models():
         build_agent(cfg, tiny_env())
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("kind", ["lstm", "transformer"])
 def test_recurrent_and_attention_policies_with_ppo(kind):
     cfg = tiny_config("ppo")

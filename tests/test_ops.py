@@ -22,6 +22,18 @@ def _rand_qkv(key, batch=2, heads=2, seq=64, d=32, dtype=jnp.float32):
             jax.random.normal(kv, shape, dtype))
 
 
+def test_kernel_matches_reference_fast():
+    """One small parity case kept in the fast `make check` gate so a numeric
+    regression in the kernel cannot ship on a green gate (the full seq/causal
+    sweep below is `slow`)."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), seq=64)
+    got = flash_attention(q, k, v, causal=True, use_pallas=True)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("seq", [64, 128, 201, 256])
 @pytest.mark.parametrize("causal", [True, False])
 def test_kernel_matches_reference(seq, causal):
@@ -54,6 +66,7 @@ def test_causality():
     assert not np.allclose(np.asarray(base[:, :, 40:]), np.asarray(pert[:, :, 40:]))
 
 
+@pytest.mark.slow
 def test_gradients_match_reference():
     q, k, v = _rand_qkv(jax.random.PRNGKey(3), seq=64, d=32)
 
@@ -70,6 +83,7 @@ def test_gradients_match_reference():
                                    atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("shape", [(2, 4, 202, 64), (1, 1, 64, 32), (3, 2, 256, 128)])
 def test_grad_compiles_on_backend(shape):
     """AOT-compile jax.grad of the kernel on the attached backend.

@@ -98,6 +98,7 @@ class TestPortfolioSemantics:
             55.0 + float(jnp.sum(rewards)), rtol=1e-5)
 
 
+@pytest.mark.slow
 class TestPortfolioTraining:
     @pytest.mark.parametrize("algo", ["qlearn", "ppo"])
     def test_agents_train_on_two_assets(self, algo):

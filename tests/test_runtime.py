@@ -88,6 +88,7 @@ class TestQueriesPerPhase:
         assert repr(avg).startswith("Result(")
 
 
+@pytest.mark.slow
 class TestMidRunQueries:
     def test_query_during_training_not_blocking(self, tmp_path):
         """GetAvg mid-run answers from the latest snapshot without stopping
@@ -119,6 +120,7 @@ class TestMidRunQueries:
         assert orch.get_avg().ok
 
 
+@pytest.mark.slow
 class TestSupervision:
     def test_fault_injection_heals_and_completes(self, tmp_path):
         """Kill the trainer mid-run; it must restart with backoff, restore
@@ -203,6 +205,7 @@ class TestStubbedStepSeam:
         assert orch.get_std() == QueryReply(ReplyState.RESULT, 0.0)
 
 
+@pytest.mark.slow
 class TestMultiEpisode:
     def test_episodes_replay_history(self, tmp_path):
         """episodes=3 replays the price history three times with parameters
@@ -217,6 +220,7 @@ class TestMultiEpisode:
         assert int(orch.train_state.updates) == 3 * horizon
 
 
+@pytest.mark.slow
 class TestEvaluateAndResume:
     def test_greedy_evaluation(self, tmp_path):
         orch = run_end_to_end(fast_cfg(tmp_path), PRICES)
@@ -240,6 +244,7 @@ class TestEvaluateAndResume:
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 class TestInitialise:
     def test_retrain_keeps_params(self, tmp_path):
         orch = run_end_to_end(fast_cfg(tmp_path), PRICES)
