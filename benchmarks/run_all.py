@@ -30,10 +30,31 @@ def bench_config(name: str, cfg: FrameworkConfig, *, chunks: int) -> dict:
     env_params = trading.env_from_prices(
         series.prices, window=cfg.env.window,
         initial_budget=cfg.env.initial_budget)
-    agent = build_agent(cfg, env_params)
-    step = jax.jit(agent.step, donate_argnums=0)
+    mesh = None
+    if cfg.parallel.mesh_shape:
+        # Mesh-sharded rows (dp x tp) ride ParallelConfig.mesh_shape; they
+        # need the full device complement and are skipped otherwise (the
+        # bench host has one chip; the multi-chip path is validated by the
+        # CPU-mesh tests and the driver's dryrun).
+        from sharetrade_tpu.parallel import build_mesh
+        import numpy as _np
+        needed = int(_np.prod(list(cfg.parallel.mesh_shape.values())))
+        if needed > jax.device_count():
+            return {"metric": f"{name}_agent_steps_per_sec_per_chip",
+                    "skipped": f"needs {needed} devices, have "
+                               f"{jax.device_count()}"}
+        mesh = build_mesh(cfg.parallel)
+    agent = build_agent(cfg, env_params, mesh=mesh)
+    if mesh is not None:
+        from sharetrade_tpu.parallel import make_parallel_step, mlp_tp_rules
+        rules = mlp_tp_rules() if "tp" in mesh.axis_names else None
+        place, step = make_parallel_step(agent, mesh, param_rules=rules)
+        init = lambda key: place(agent.init(key))  # noqa: E731
+    else:
+        step = jax.jit(agent.step, donate_argnums=0)
+        init = agent.init
 
-    ts = agent.init(jax.random.PRNGKey(0))
+    ts = init(jax.random.PRNGKey(0))
     ts, _ = step(ts)                       # compile + warm chunk
     jax.block_until_ready(ts.params)
 
@@ -98,6 +119,13 @@ def make_configs() -> dict[str, FrameworkConfig]:
             learner__remat=True,
             model__num_layers=2, model__num_heads=2, model__head_dim=128,
             model__dtype="bfloat16"),
+        # Mesh-sharded row (ParallelConfig.mesh_shape): dp-sharded agents,
+        # Megatron column/row tp split of the MLP. Skips unless the host
+        # exposes 8 devices (v5e-8); capability is CPU-mesh-tested either way.
+        "ppo_mlp_dp4_tp2": base(
+            learner__algo="ppo", parallel__num_workers=64,
+            parallel__mesh_shape={"dp": 4, "tp": 2},
+            learner__unroll_len=128, runtime__chunk_steps=128),
     }
 
 
@@ -126,6 +154,10 @@ def main() -> None:
     print(f"\n{'config':<{width}}  agent-steps/s  vs ref ceiling       MFU",
           file=sys.stderr)
     for r in results:
+        if "skipped" in r:
+            print(f"{r['metric']:<{width}}  skipped: {r['skipped']}",
+                  file=sys.stderr)
+            continue
         print(f"{r['metric']:<{width}}  {r['value']:>13,.0f}  "
               f"{r['vs_baseline']:>12,.0f}x  {r['mfu']:>8.2%}", file=sys.stderr)
 

@@ -37,12 +37,14 @@ _HEADS = {"qlearn": "q", "dqn": "q", "pg": "ac", "a2c": "ac", "ppo": "ac"}
 
 
 def build_agent(cfg: FrameworkConfig, env: TradingEnv | trading.EnvParams,
-                model: Model | None = None) -> Agent:
+                model: Model | None = None, mesh=None) -> Agent:
     """Wire model + env + learner from a framework config.
 
     Accepts either the generic :class:`TradingEnv` bundle or a bare
     single-asset ``EnvParams`` (wrapped automatically — the common
-    test/bench construction path).
+    test/bench construction path). ``mesh`` flows to ``build_model`` for the
+    partitioned transformer paths (ring attention over sp, pipelined blocks
+    over pp).
     """
     if isinstance(env, trading.EnvParams):
         params = env
@@ -66,8 +68,11 @@ def build_agent(cfg: FrameworkConfig, env: TradingEnv | trading.EnvParams,
             "use mlp/lstm for multi-asset portfolios")
     if model is None:
         model = build_model(cfg.model, env.obs_dim, head=_HEADS[algo],
-                            num_actions=env.num_actions)
+                            num_actions=env.num_actions, mesh=mesh)
+    kwargs = {}
+    if algo == "dqn" and cfg.learner.journal_replay:
+        kwargs["collect_transitions"] = True
     return _FACTORIES[algo](
         model, env, cfg.learner,
         num_agents=cfg.parallel.num_workers,
-        steps_per_chunk=cfg.runtime.chunk_steps)
+        steps_per_chunk=cfg.runtime.chunk_steps, **kwargs)

@@ -83,4 +83,4 @@ def make_a2c_agent(model: Model, env: TradingEnv,
         return ts, metrics
 
     return Agent(name="a2c", init=init, step=step,
-                 num_agents=num_agents, steps_per_chunk=unroll)
+                 num_agents=num_agents, steps_per_chunk=unroll, model=model)

@@ -53,13 +53,19 @@ class TrainState:
 
 @dataclass(frozen=True)
 class Agent:
-    """A learner: pure init/step plus static shape facts for the runtime."""
+    """A learner: pure init/step plus static shape facts for the runtime.
+
+    ``model`` carries the policy network the learner was built around so the
+    runtime evaluates exactly what was trained (rebuilding from config would
+    silently evaluate a different architecture when a custom model was
+    injected)."""
 
     name: str
     init: Callable[[jax.Array], TrainState]
     step: Callable[[TrainState], tuple[TrainState, dict[str, jax.Array]]]
     num_agents: int
     steps_per_chunk: int
+    model: Any = None
 
 
 def build_optimizer(cfg: LearnerConfig) -> optax.GradientTransformation:

@@ -92,7 +92,11 @@ class DQNExtras:
 
 def make_dqn_agent(model: Model, env: TradingEnv,
                    cfg: LearnerConfig, *, num_agents: int = 10,
-                   steps_per_chunk: int = 200) -> Agent:
+                   steps_per_chunk: int = 200,
+                   collect_transitions: bool = False) -> Agent:
+    """``collect_transitions`` makes each chunk additionally return its raw
+    transition batch under ``metrics["transitions"]`` so the host can journal
+    them (the runtime's ``learner.journal_replay`` switch)."""
     optimizer = build_optimizer(cfg)
     horizon = env.num_steps
     obs_dim = model.obs_dim
@@ -165,11 +169,15 @@ def make_dqn_agent(model: Model, env: TradingEnv,
             updates=n_updates,
             extras=DQNExtras(target_params=target_params, replay=replay),
         )
-        return ts, (jnp.where(ready, loss, 0.0), jnp.sum(rewards))
+        out = (jnp.where(ready, loss, 0.0), jnp.sum(rewards))
+        if collect_transitions:
+            out = out + ((obs, actions, rewards, next_obs, active),)
+        return ts, out
 
     def step(ts: TrainState):
-        ts, (losses, rewards) = jax.lax.scan(
+        ts, outs = jax.lax.scan(
             one_step, ts, None, length=steps_per_chunk)
+        losses, rewards = outs[0], outs[1]
         metrics = {
             "loss": jnp.mean(losses),
             "reward_sum": jnp.sum(rewards),
@@ -179,35 +187,70 @@ def make_dqn_agent(model: Model, env: TradingEnv,
             "updates": ts.updates,
             **portfolio_metrics(env, ts.env_state),
         }
+        if collect_transitions:
+            t_obs, t_act, t_rew, t_next, t_valid = outs[2]
+            metrics["transitions"] = {
+                "obs": t_obs, "action": t_act, "reward": t_rew,
+                "next_obs": t_next, "valid": t_valid}
         return ts, metrics
 
     return Agent(name="dqn", init=init, step=step,
-                 num_agents=num_agents, steps_per_chunk=steps_per_chunk)
+                 num_agents=num_agents, steps_per_chunk=steps_per_chunk,
+                 model=model)
 
 
-def journal_transitions(journal, obs, actions, rewards, next_obs) -> None:
+def journal_transitions(journal, obs, actions, rewards, next_obs,
+                        env_steps: int | None = None) -> None:
     """Append a batch of transitions to an event journal (host side) — the
     durable replay trail (reference capability: Akka-persistence journal,
-    SharePriceGetter.scala:37; generalized to experience data here)."""
-    journal.append({
+    SharePriceGetter.scala:37; generalized to experience data here).
+    ``env_steps`` (cumulative count at chunk end) lets a resuming process
+    recover the journaling high-water mark so replayed chunks after a
+    restore are never double-journaled."""
+    event = {
         "type": "transitions",
         "obs": np.asarray(obs).tolist(),
         "action": np.asarray(actions).tolist(),
         "reward": np.asarray(rewards).tolist(),
         "next_obs": np.asarray(next_obs).tolist(),
-    })
+    }
+    if env_steps is not None:
+        event["env_steps"] = int(env_steps)
+    journal.append(event)
 
 
 def fill_replay_from_journal(replay: ReplayBuffer, journal) -> ReplayBuffer:
     """Replay journaled transitions into the device buffer (offline/warm-start
-    path — the event-sourcing recovery pattern applied to experience)."""
-    for event in journal.replay():
-        if event.get("type") != "transitions":
-            continue
+    path — the event-sourcing recovery pattern applied to experience).
+
+    Only the journal tail that can actually survive in the circular buffer is
+    pushed: replaying from record zero would cost time linear in the whole
+    training history, and pushing batches wider than the buffer would scatter
+    with duplicate indices (implementation-defined winner). Events are pushed
+    oldest-first in capacity-bounded slices so "newest wins" circular
+    semantics hold deterministically."""
+    return fill_replay_from_events(
+        replay, [e for e in journal.replay() if e.get("type") == "transitions"])
+
+
+def fill_replay_from_events(replay: ReplayBuffer,
+                            events: list[dict]) -> ReplayBuffer:
+    capacity = replay.obs.shape[0]
+    # Walk back from the tail until the kept events cover the capacity.
+    kept, rows = [], 0
+    for event in reversed(events):
+        kept.append(event)
+        rows += len(event["action"])
+        if rows >= capacity:
+            break
+    for event in reversed(kept):
         obs = jnp.asarray(event["obs"], jnp.float32)
-        valid = jnp.ones((obs.shape[0],), bool)
-        replay = replay.push(
-            obs, jnp.asarray(event["action"], jnp.int32),
-            jnp.asarray(event["reward"], jnp.float32),
-            jnp.asarray(event["next_obs"], jnp.float32), valid)
+        action = jnp.asarray(event["action"], jnp.int32)
+        reward = jnp.asarray(event["reward"], jnp.float32)
+        next_obs = jnp.asarray(event["next_obs"], jnp.float32)
+        for lo in range(0, obs.shape[0], capacity):
+            sl = slice(lo, lo + capacity)
+            valid = jnp.ones((obs[sl].shape[0],), bool)
+            replay = replay.push(obs[sl], action[sl], reward[sl],
+                                 next_obs[sl], valid)
     return replay

@@ -73,4 +73,4 @@ def make_pg_agent(model: Model, env: TradingEnv,
         return ts, metrics
 
     return Agent(name="pg", init=init, step=step,
-                 num_agents=num_agents, steps_per_chunk=unroll)
+                 num_agents=num_agents, steps_per_chunk=unroll, model=model)

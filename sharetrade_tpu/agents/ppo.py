@@ -132,4 +132,4 @@ def make_ppo_agent(model: Model, env: TradingEnv,
         return ts, metrics
 
     return Agent(name="ppo", init=init, step=step,
-                 num_agents=num_agents, steps_per_chunk=unroll)
+                 num_agents=num_agents, steps_per_chunk=unroll, model=model)

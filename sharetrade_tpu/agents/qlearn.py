@@ -133,4 +133,5 @@ def make_qlearn_agent(model: Model, env: TradingEnv,
         return ts, metrics
 
     return Agent(name="qlearn", init=init, step=step,
-                 num_agents=num_agents, steps_per_chunk=steps_per_chunk)
+                 num_agents=num_agents, steps_per_chunk=steps_per_chunk,
+                 model=model)

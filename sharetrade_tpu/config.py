@@ -51,6 +51,18 @@ class ModelConfig:
     head_dim: int = 64
     seq_block: int = 128               # pallas attention block size
     dtype: str = "float32"             # compute dtype ("bfloat16" on TPU for speed)
+    # Attention partitioning: "flash" = local Pallas kernel per device;
+    # "ring" = sequence-parallel ring attention over the mesh's sp axis
+    # (requires a mesh with sp>1 — the long-context scale-out path).
+    attention: str = "flash"
+    # Pipeline the transformer blocks over the mesh's pp axis (one block per
+    # stage; requires num_layers == pp size and a mesh with pp>1).
+    pipeline_blocks: bool = False
+    # Mixture-of-experts FFN: >0 replaces each transformer block's dense MLP
+    # with a top-1-routed expert bank (sharded over the mesh's ep axis when
+    # one exists, single-device otherwise). The gate trains through the task
+    # loss via its routing weight.
+    moe_experts: int = 0
 
 
 @dataclass
@@ -71,6 +83,10 @@ class LearnerConfig:
     replay_capacity: int = 65536
     replay_batch: int = 256
     target_update_every: int = 500
+    # Journal every chunk's transitions to a durable event log and rebuild
+    # the replay buffer from it on resume (the reference's event-sourced
+    # persistence generalized to experience data, SURVEY.md §7.4).
+    journal_replay: bool = False
     # PPO/A2C:
     entropy_coef: float = 0.01
     value_coef: float = 0.5

@@ -20,7 +20,8 @@ _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
 
 
 def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
-                parity: bool = False, num_actions: int | None = None) -> Model:
+                parity: bool = False, num_actions: int | None = None,
+                mesh=None) -> Model:
     """Construct the policy network for ``cfg.kind``.
 
     ``head="q"`` selects the Q-value head (valid for MLP only — the reference
@@ -28,6 +29,9 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
     kind=mlp, head=q) reproduces the reference graph bit-for-bit in
     architecture: constant 0.1 biases, ReLU output, stddev-1 init.
     ``num_actions`` overrides the config (multi-asset envs widen the head).
+    ``mesh`` enables the partitioned transformer paths: ``cfg.attention=
+    "ring"`` rings attention over its sp axis; ``cfg.pipeline_blocks``
+    pipelines the blocks over its pp axis.
     """
     dtype = _DTYPES[cfg.dtype]
     actions = cfg.num_actions if num_actions is None else num_actions
@@ -39,7 +43,44 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
     if cfg.kind == "lstm":
         return lstm_policy(obs_dim, cfg.hidden_dim, actions, dtype=dtype)
     if cfg.kind == "transformer":
+        attention_fn = None
+        pp_mesh = None
+        batch_axis = (  # agent batch rides dp when the mesh has it
+            "dp" if mesh is not None and "dp" in mesh.axis_names else None)
+        if cfg.attention == "ring":
+            if mesh is None or "sp" not in mesh.axis_names:
+                raise ValueError(
+                    "model.attention='ring' needs a mesh with an 'sp' axis "
+                    "(set parallel.mesh_shape, e.g. {\"dp\": 2, \"sp\": 4})")
+            from sharetrade_tpu.parallel.ring_attention import (
+                ring_attention_sharded)
+            attention_fn = ring_attention_sharded(
+                mesh, seq_axis="sp", batch_axis=batch_axis)
+        elif cfg.attention != "flash":
+            raise ValueError(f"unknown model.attention {cfg.attention!r}")
+        if cfg.pipeline_blocks:
+            if mesh is None or "pp" not in mesh.axis_names:
+                raise ValueError(
+                    "model.pipeline_blocks needs a mesh with a 'pp' axis "
+                    "(set parallel.mesh_shape, e.g. {\"dp\": 2, \"pp\": 4})")
+            if cfg.attention == "ring":
+                raise ValueError(
+                    "model.attention='ring' + model.pipeline_blocks is "
+                    "unsupported (nested shard_maps); pick one partitioning")
+            pp_mesh = mesh
+        # Experts shard over ep when the mesh has that axis; otherwise the
+        # expert bank runs single-device (still trainable — the mechanism's
+        # reachability doesn't depend on the mesh).
+        ep_mesh = (mesh if cfg.moe_experts and mesh is not None
+                   and "ep" in mesh.axis_names else None)
+        # A non-TPU mesh (the virtual-CPU test/dryrun client) can't lower the
+        # Pallas kernel; the XLA reference path is numerically identical.
+        use_pallas = (False if mesh is not None
+                      and mesh.devices.flat[0].platform != "tpu" else None)
         return transformer_policy(
             obs_dim, actions, num_layers=cfg.num_layers,
-            num_heads=cfg.num_heads, head_dim=cfg.head_dim, dtype=dtype)
+            num_heads=cfg.num_heads, head_dim=cfg.head_dim, dtype=dtype,
+            use_pallas=use_pallas, attention_fn=attention_fn,
+            pp_mesh=pp_mesh, pp_batch_axis=batch_axis,
+            moe_experts=cfg.moe_experts, ep_mesh=ep_mesh)
     raise ValueError(f"unknown model kind {cfg.kind!r}")

@@ -31,10 +31,34 @@ def _layer_norm(x, scale, bias, eps=1e-6):
 def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                        num_layers: int = 2, num_heads: int = 4,
                        head_dim: int = 64, mlp_ratio: int = 4,
-                       dtype=jnp.float32, use_pallas: bool | None = None) -> Model:
+                       dtype=jnp.float32, use_pallas: bool | None = None,
+                       attention_fn=None, pp_mesh=None, pp_axis: str = "pp",
+                       pp_batch_axis: str | None = None,
+                       moe_experts: int = 0, ep_mesh=None,
+                       ep_axis: str = "ep") -> Model:
+    """``attention_fn(q, k, v) -> out`` overrides the local flash kernel —
+    the sequence-parallel hook (e.g. ``ring_attention_sharded`` binds a mesh
+    so attention rings over the sp axis, parallel/ring_attention.py).
+
+    ``pp_mesh`` pipelines the transformer blocks over that mesh's
+    ``pp_axis`` (GPipe microbatch schedule, parallel/pipeline.py): one block
+    per stage, so ``num_layers`` must equal the pp size. Blocks are then
+    stored stacked (leading dim = num_layers) so stage i's slice shards onto
+    pp-device i. ``pp_batch_axis`` names the mesh axis the agent batch is
+    sharded over (usually "dp") so microbatches keep that sharding."""
     window = obs_dim - 2           # price ticks; final token holds the portfolio
     seq_len = window + 1
     d_model = num_heads * head_dim
+    if attention_fn is None:
+        attention_fn = lambda q, k, v: flash_attention(  # noqa: E731
+            q, k, v, causal=True, use_pallas=use_pallas)
+    if pp_mesh is not None and pp_mesh.shape[pp_axis] != num_layers:
+        raise ValueError(
+            f"pipeline_blocks needs num_layers == pp size "
+            f"({num_layers} != {pp_mesh.shape[pp_axis]})")
+    if moe_experts and pp_mesh is not None:
+        raise ValueError("pipeline_blocks + moe_experts is unsupported "
+                         "(nested shard_maps); pick one partitioning")
 
     def init(key):
         keys = jax.random.split(key, 4 + 6 * num_layers)
@@ -49,7 +73,7 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         }
         for i in range(num_layers):
             k = keys[4 + 6 * i: 4 + 6 * (i + 1)]
-            params["blocks"].append({
+            block = {
                 "ln1": {"scale": jnp.ones((d_model,), dtype),
                         "bias": jnp.zeros((d_model,), dtype)},
                 "qkv": dense_init(k[0], d_model, 3 * d_model, dtype=dtype),
@@ -57,11 +81,49 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                                    scale=0.02 / max(num_layers, 1), dtype=dtype),
                 "ln2": {"scale": jnp.ones((d_model,), dtype),
                         "bias": jnp.zeros((d_model,), dtype)},
-                "mlp_in": dense_init(k[2], d_model, mlp_ratio * d_model, dtype=dtype),
-                "mlp_out": dense_init(k[3], mlp_ratio * d_model, d_model,
-                                      scale=0.02 / max(num_layers, 1), dtype=dtype),
-            })
+            }
+            if moe_experts:
+                from sharetrade_tpu.parallel.moe import init_moe_params
+                block["moe"] = init_moe_params(
+                    k[2], moe_experts, d_model, mlp_ratio * d_model,
+                    dtype=dtype)
+            else:
+                block["mlp_in"] = dense_init(
+                    k[2], d_model, mlp_ratio * d_model, dtype=dtype)
+                block["mlp_out"] = dense_init(
+                    k[3], mlp_ratio * d_model, d_model,
+                    scale=0.02 / max(num_layers, 1), dtype=dtype)
+            params["blocks"].append(block)
+        if pp_mesh is not None:
+            # Stacked layout (leading dim = stages) so stage i's slice lands
+            # on pp-device i through the pipeline shard_map.
+            params["blocks"] = jax.tree.map(
+                lambda *leaves: jnp.stack(leaves), *params["blocks"])
         return params
+
+    def block_apply(blk, x):
+        """One pre-LN transformer block over (B, T, d) tokens."""
+        bsz, t = x.shape[0], x.shape[1]
+        h = _layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
+        qkv = dense(blk["qkv"], h).reshape(bsz, t, 3, num_heads, head_dim)
+        # attention expects (batch, heads, seq, head_dim)
+        q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
+        attn = attention_fn(q, k, v)
+        attn = attn.transpose(0, 2, 1, 3).reshape(
+            bsz, t, d_model).astype(dtype)
+        x = x + dense(blk["proj"], attn)
+        h = _layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
+        if moe_experts:
+            from sharetrade_tpu.parallel.moe import moe_apply, moe_apply_sharded
+            flat = h.reshape(-1, d_model)
+            if ep_mesh is not None:
+                y, _aux = moe_apply_sharded(
+                    blk["moe"], flat, ep_mesh, axis=ep_axis,
+                    batch_axis=pp_batch_axis)
+            else:
+                y, _aux = moe_apply(blk["moe"], flat)
+            return x + y.reshape(h.shape)
+        return x + dense(blk["mlp_out"], jax.nn.gelu(dense(blk["mlp_in"], h)))
 
     def tokenize(obs):
         """(B, obs_dim) -> (B, seq, 3) token features."""
@@ -86,18 +148,24 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         bsz = obs.shape[0]
         tokens = tokenize(obs).astype(dtype)
         x = dense(params["embed"], tokens) + params["pos"]       # (B, seq, d)
-        for blk in params["blocks"]:
-            h = _layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
-            qkv = dense(blk["qkv"], h).reshape(
-                bsz, seq_len, 3, num_heads, head_dim)
-            # kernel expects (batch, heads, seq, head_dim)
-            q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
-            attn = flash_attention(q, k, v, causal=True, use_pallas=use_pallas)
-            attn = attn.transpose(0, 2, 1, 3).reshape(
-                bsz, seq_len, d_model).astype(dtype)
-            x = x + dense(blk["proj"], attn)
-            h = _layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
-            x = x + dense(blk["mlp_out"], jax.nn.gelu(dense(blk["mlp_in"], h)))
+        if pp_mesh is None:
+            for blk in params["blocks"]:
+                x = block_apply(blk, x)
+        else:
+            from sharetrade_tpu.parallel.pipeline import pipeline_apply
+            from jax.sharding import PartitionSpec as P
+            # GPipe microbatches over the agent batch: M = stages when the
+            # batch divides evenly (bubble (S-1)/(M+S-1)), else one batch.
+            stages = num_layers
+            m = stages if bsz % stages == 0 else 1
+            mb = x.reshape((m, bsz // m) + x.shape[1:])
+            b_axis = pp_batch_axis
+            if b_axis is not None and (bsz // m) % pp_mesh.shape[b_axis]:
+                b_axis = None   # odd batch (e.g. eval's batch-1): replicate
+            mb = pipeline_apply(
+                block_apply, params["blocks"], mb, pp_mesh, axis=pp_axis,
+                mb_spec=P(None, b_axis))
+            x = mb.reshape((bsz,) + mb.shape[2:])
         summary = _layer_norm(x[:, -1], params["final_ln"]["scale"],
                               params["final_ln"]["bias"])
         logits = dense(params["policy"], summary).astype(jnp.float32)

@@ -51,12 +51,39 @@ def build_mesh(cfg: ParallelConfig | None = None, devices=None) -> Mesh:
     return mesh
 
 
-def init_distributed() -> bool:
+def init_distributed(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> bool:
     """Multi-host bring-up (the reference's never-built Akka Cluster tier,
-    README.md:13). Under a multi-host TPU slice the coordinator address and
-    process indices come from the TPU runtime; elsewhere this is a no-op.
-    Returns True when running multi-process."""
+    README.md:13, build.sbt:13 akka-remote on the classpath but dormant).
+
+    Three tiers, in precedence order:
+
+    1. Explicit args — manual bring-up on any cluster:
+       ``init_distributed("host0:8476", num_processes=2, process_id=i)``
+       on every host, then ``build_mesh`` sees the GLOBAL device set and
+       shardings spanning hosts ride DCN (jax inserts the cross-host
+       collectives; lay dp over hosts, tp/sp within a host so the heavy
+       collectives stay on ICI).
+    2. Env-gated — ``JAX_COORDINATOR_ADDRESS`` (set by TPU pod runtimes and
+       GKE) or ``MEGASCALE_COORDINATOR_ADDRESS``: ``jax.distributed
+       .initialize()`` discovers everything from the environment.
+    3. No-op — single-process: returns whether jax already reports multiple
+       processes.
+
+    Returns True when running multi-process. Idempotent: a second call after
+    successful bring-up is a no-op (jax raises on double-initialize).
+    """
     import os
+    if jax.distributed.is_initialized():
+        return jax.process_count() > 1
+    if coordinator_address is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+        log.info("distributed: process %d of %d (explicit coordinator %s)",
+                 jax.process_index(), jax.process_count(), coordinator_address)
+        return jax.process_count() > 1
     if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
             "MEGASCALE_COORDINATOR_ADDRESS"):
         jax.distributed.initialize()

@@ -62,15 +62,19 @@ def moe_apply(params: dict, tokens: jax.Array):
 
 
 def moe_apply_sharded(params: dict, tokens: jax.Array, mesh: Mesh,
-                      *, axis: str = "ep"):
+                      *, axis: str = "ep", batch_axis: str | None = None):
     """Expert-parallel evaluation: experts sharded over ``axis``, tokens and
     gate replicated, contributions psum-combined. Numerically identical to
-    :func:`moe_apply`."""
+    :func:`moe_apply`. ``batch_axis`` names a mesh axis the token batch is
+    already sharded over (e.g. "dp") so the shard_map keeps that layout
+    instead of all-gathering the tokens."""
     num_experts = params["gate"].shape[-1]
     ep = mesh.shape[axis]
     if num_experts % ep != 0:
         raise ValueError(f"num_experts={num_experts} not divisible by "
                          f"{axis}={ep}")
+    if batch_axis is not None and tokens.shape[0] % mesh.shape[batch_axis]:
+        batch_axis = None   # odd token count: fall back to replication
 
     def local_fn(gate, w_in, w_out, toks):
         logits = toks @ gate                                # replicated (N, E)
@@ -95,10 +99,12 @@ def moe_apply_sharded(params: dict, tokens: jax.Array, mesh: Mesh,
         importance = jnp.mean(probs, axis=0)
         load = jnp.mean(onehot, axis=0)
         aux = num_experts * jnp.sum(importance * load)
+        if batch_axis is not None:
+            aux = jax.lax.pmean(aux, batch_axis)
         return out, aux
 
     return jax.shard_map(
         local_fn, mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), P()),
-        out_specs=(P(), P()),
+        in_specs=(P(), P(axis), P(axis), P(batch_axis)),
+        out_specs=(P(batch_axis), P()),
     )(params["gate"], params["w_in"], params["w_out"], tokens)

@@ -22,31 +22,36 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def pipeline_apply(stage_fn, stage_params, microbatches, mesh: Mesh,
-                   *, axis: str = "pp"):
+                   *, axis: str = "pp", mb_spec: P = P()):
     """Run ``microbatches`` through ``num_stages`` pipelined stages.
 
     - ``stage_fn(params, x) -> x``: one stage's forward (same signature for
       every stage; heterogeneous stacks encode choice inside params).
     - ``stage_params``: pytree whose leaves have leading dim ``num_stages``
       (stage i's slice lives on pp-device i).
-    - ``microbatches``: array of shape (M, ...) — M microbatches, replicated
-      across ``axis`` (each stage reads only the ticks it owns).
+    - ``microbatches``: array of shape (M, ...) — M microbatches.
+    - ``mb_spec``: the microbatches' PartitionSpec over OTHER mesh axes
+      (e.g. ``P(None, "dp")`` when the per-microbatch batch dim is
+      dp-sharded in a dp x pp mesh); must not mention ``axis`` itself —
+      every pipeline stage needs the ticks it owns.
 
-    Returns the (M, ...) outputs, identical on every ``axis`` device.
+    Returns the (M, ...) outputs with the same ``mb_spec`` sharding.
     """
     num_stages = mesh.shape[axis]
     num_micro = microbatches.shape[0]
-    mb_shape = microbatches.shape[1:]
+    if axis in jax.tree.leaves(tuple(mb_spec)):
+        raise ValueError(f"mb_spec {mb_spec} must not shard over {axis!r}")
 
     def local_fn(params_local, mb_local):
         # params_local: this stage's params (leading dim stripped by the
-        # sharding: (1, ...) -> squeeze); mb_local: full (M, ...) batch.
+        # sharding: (1, ...) -> squeeze); mb_local: the (M, ...) batch in
+        # this device's LOCAL view (other axes may shard trailing dims).
         params_here = jax.tree.map(lambda x: x[0], params_local)
         stage = jax.lax.axis_index(axis)
         fwd = [(i, (i + 1) % num_stages) for i in range(num_stages)]
 
-        state = jnp.zeros(mb_shape, microbatches.dtype)
-        out = jnp.zeros((num_micro,) + mb_shape, microbatches.dtype)
+        state = jnp.zeros(mb_local.shape[1:], mb_local.dtype)
+        out = jnp.zeros(mb_local.shape, mb_local.dtype)
 
         for t in range(num_micro + num_stages - 1):
             # Stage 0 ingests microbatch t on ticks 0..M-1.
@@ -70,9 +75,13 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh: Mesh,
         return jax.lax.psum(out, axis)
 
     stage_spec = jax.tree.map(lambda _: P(axis), stage_params)
+    # check_vma=False: stage_fn may invoke a pallas_call (the flash kernel),
+    # whose out_shapes don't carry varying-mesh-axes metadata; the schedule
+    # is stage-local by construction so the check adds nothing here.
     return jax.shard_map(
         local_fn, mesh=mesh,
-        in_specs=(stage_spec, P()), out_specs=P(),
+        in_specs=(stage_spec, mb_spec), out_specs=mb_spec,
+        check_vma=False,
     )(stage_params, microbatches)
 
 

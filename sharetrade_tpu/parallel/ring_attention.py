@@ -51,9 +51,12 @@ def _block_contract(q, k, v, q_offset, k_offset, causal, sm_scale, acc, m, l):
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "sp",
-                   causal: bool = True, sm_scale: float | None = None):
+                   causal: bool = True, sm_scale: float | None = None,
+                   batch_axis: str | None = None):
     """Causal MHA with (batch, heads, seq, head_dim) inputs sharded over
-    ``seq_axis``. Returns output with the same sharding."""
+    ``seq_axis``. Returns output with the same sharding. ``batch_axis``
+    names a mesh axis the batch dim is already sharded over (e.g. "dp" in a
+    dp x sp mesh) so the shard_map doesn't force an all-gather of the batch."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     num_shards = mesh.shape[seq_axis]
@@ -88,15 +91,44 @@ def ring_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "sp",
         l_safe = jnp.where(l > 0, l, 1.0)
         return (acc / l_safe[..., None]).astype(q_loc.dtype)
 
-    spec = P(None, None, seq_axis, None)
+    spec = P(batch_axis, None, seq_axis, None)
     shmap = jax.shard_map(
         local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return shmap(q, k, v)
 
 
-def ring_attention_sharded(mesh: Mesh, seq_axis: str = "sp"):
-    """Convenience partial with the mesh bound (for model wiring)."""
-    return functools.partial(ring_attention, mesh=mesh, seq_axis=seq_axis)
+def ring_attention_padded(q, k, v, mesh: Mesh, *, seq_axis: str = "sp",
+                          causal: bool = True, sm_scale: float | None = None,
+                          batch_axis: str | None = None):
+    """Ring attention for sequence lengths not divisible by the ring size.
+
+    Pads queries/keys/values with trailing zero tokens up to the next
+    multiple of the sp size and slices the output back. Safe under the
+    causal mask: padded KEY positions sit strictly after every real query's
+    row, so no real output attends to padding; padded QUERY rows produce
+    garbage that is sliced off."""
+    if not causal:
+        raise ValueError("ring_attention_padded requires causal=True "
+                         "(non-causal padding would attend to zero tokens)")
+    if batch_axis is not None and q.shape[0] % mesh.shape[batch_axis]:
+        batch_axis = None   # odd batch (e.g. eval's batch-1): replicate it
+    num_shards = mesh.shape[seq_axis]
+    seq = q.shape[2]
+    pad = (-seq) % num_shards
+    if pad:
+        widths = ((0, 0), (0, 0), (0, pad), (0, 0))
+        q, k, v = (jnp.pad(x, widths) for x in (q, k, v))
+    out = ring_attention(q, k, v, mesh, seq_axis=seq_axis, causal=causal,
+                         sm_scale=sm_scale, batch_axis=batch_axis)
+    return out[:, :, :seq] if pad else out
+
+
+def ring_attention_sharded(mesh: Mesh, seq_axis: str = "sp",
+                           batch_axis: str | None = None):
+    """Convenience partial with the mesh bound (for model wiring); handles
+    non-divisible sequence lengths via padding."""
+    return functools.partial(ring_attention_padded, mesh=mesh,
+                             seq_axis=seq_axis, batch_axis=batch_axis)
 
 
 def sequence_sharding(mesh: Mesh, seq_axis: str = "sp") -> NamedSharding:

@@ -103,6 +103,14 @@ class Orchestrator:
         self.restarts = 0
         self.episode = 0
         self.last_error: BaseException | None = None
+        self._transitions_journal = None
+        self._journal_high_water = 0  # env_steps already journaled
+        if cfg.learner.algo == "dqn" and cfg.learner.journal_replay:
+            import os
+            from sharetrade_tpu.data.service import _open_journal
+            self._transitions_journal = _open_journal(
+                os.path.join(cfg.data.journal_dir, "transitions.journal"),
+                prefer_native=cfg.data.use_native_journal)
 
     # ------------------------------------------------------------------
     # protocol: SendTrainingData (TrainerRouterActor.scala:77-81)
@@ -126,7 +134,7 @@ class Orchestrator:
                 prices.reshape(-1), window=self.cfg.env.window,
                 initial_budget=self.cfg.env.initial_budget,
                 initial_shares=self.cfg.env.initial_shares)
-        self.agent = build_agent(self.cfg, self.env)
+        self.agent = build_agent(self.cfg, self.env, mesh=self.mesh)
         self._build_step()
         template = self.agent.init(jax.random.PRNGKey(self.cfg.seed))
         if resume:
@@ -140,7 +148,7 @@ class Orchestrator:
                     f"checkpoint env cursor ({max_cursor}) exceeds the new "
                     f"series horizon ({horizon}); resume needs the same or a "
                     f"longer price series")
-            self._ts = self._place(state)
+            self._ts = self._place(self._warm_start_replay(state))
             # Recover which episode the cumulative step count sits in so the
             # completion arithmetic picks up where the run left off.
             self.episode = min(int(state.env_steps) // horizon,
@@ -151,12 +159,27 @@ class Orchestrator:
                      self.episode)
             self.events.emit("resumed", step=step)
         else:
+            if self._transitions_journal is not None:
+                # A fresh run must not inherit another run's experience: the
+                # journal is truncated, not appended to (warm starts would
+                # otherwise seed the buffer with off-distribution data). The
+                # high-water mark resets with it — the new run's env_steps
+                # restart at zero and must journal from the first chunk.
+                self._transitions_journal.compact([])
+                self._journal_high_water = 0
+            # Fresh state counts episodes from zero; a stale episode index
+            # from a previous run would push the completion threshold to
+            # (episode+1) x horizon — unreachable for frozen envs.
+            self.episode = 0
             self._ts = self._place(template)
         self.lifecycle.to(Phase.READY)
         self.events.emit("training_data_received",
                          episode_steps=self.env.num_steps)
         # Honor a stashed StartTraining (reference stash/unstashAll, :75-76).
+        # The stash is consumed: later send_training_data calls (a fresh
+        # retrain on the same orchestrator) must not silently auto-start.
         if self.lifecycle.start_requested:
+            self.lifecycle.start_requested = False
             self.start_training(
                 background=getattr(self, "_stashed_background", True))
 
@@ -223,6 +246,9 @@ class Orchestrator:
                 # Commit the new state BEFORE any hook can raise: the mesh
                 # step donates its input, so the old state is already dead.
                 self._ts = ts
+                self._journal_transitions(
+                    metrics.pop("transitions", None),
+                    int(np.asarray(metrics["env_steps"])))
                 metrics = {k: float(np.asarray(v)) for k, v in metrics.items()}
                 if self._fault_hook is not None:
                     self._fault_hook(chunk_idx, metrics)
@@ -334,11 +360,72 @@ class Orchestrator:
         self.checkpoints.wait_pending(timeout=60)  # pick up in-flight saves
         try:
             state, step = self.checkpoints.restore(template)
-            self._ts = self._place(state)
+            self._ts = self._place(self._warm_start_replay(state))
             self.events.emit("restored", step=step)
         except FileNotFoundError:
-            self._ts = self._place(template)
+            self._ts = self._place(self._warm_start_replay(template))
             self.events.emit("reinitialized")
+
+    # ------------------------------------------------------------------
+    # journal-backed replay (learner.journal_replay; SURVEY.md §7.4)
+    # ------------------------------------------------------------------
+
+    def _journal_transitions(self, transitions, env_steps: int) -> None:
+        """Host-side append of one chunk's transition batch to the durable
+        event log. Arrays arrive as (T, B, ...) from the scanned chunk;
+        frozen (episode-complete) agent rows are filtered by the validity
+        mask before writing. Chunks replayed after a restore (RNG restored,
+        identical data) are skipped via the env-step high-water mark so a
+        heal never double-journals."""
+        if transitions is None or self._transitions_journal is None:
+            return
+        if env_steps <= self._journal_high_water:
+            return
+        self._journal_high_water = env_steps
+        from sharetrade_tpu.agents.dqn import journal_transitions
+        valid = np.asarray(transitions["valid"]).reshape(-1)
+        if not valid.any():
+            return
+        flat = {k: np.asarray(v).reshape((-1,) + np.asarray(v).shape[2:])
+                for k, v in transitions.items() if k != "valid"}
+        journal_transitions(
+            self._transitions_journal, flat["obs"][valid],
+            flat["action"][valid], flat["reward"][valid],
+            flat["next_obs"][valid], env_steps=env_steps)
+
+    def _warm_start_replay(self, state: TrainState) -> TrainState:
+        """Rebuild the DQN replay buffer from the transitions journal. The
+        journal sees every chunk as it happens while checkpoints lag by the
+        save cadence, so after a crash the journal is the fresher (and
+        durable) source of truth — the event-sourcing recovery pattern the
+        reference applies to price data (SharePriceGetter.scala:55-62),
+        applied to experience."""
+        if self._transitions_journal is None:
+            return state
+        from sharetrade_tpu.agents.dqn import (
+            ReplayBuffer, fill_replay_from_events)
+        events = [e for e in self._transitions_journal.replay()
+                  if e.get("type") == "transitions"]
+        # Recover the journaling high-water mark so chunks replayed between
+        # the restored checkpoint and the crash point aren't re-journaled.
+        self._journal_high_water = max(
+            [self._journal_high_water]
+            + [e.get("env_steps", 0) for e in events])
+        fresh = ReplayBuffer.create(self.cfg.learner.replay_capacity,
+                                    self.env.obs_dim)
+        # Fill only up to the restored state's env-step count: the chunks
+        # between checkpoint and crash re-run with restored RNG and push
+        # identical transitions themselves — filling them here too would
+        # double-count them in the live buffer.
+        cutoff = int(state.env_steps)
+        warm = fill_replay_from_events(
+            fresh, [e for e in events if e.get("env_steps", 0) <= cutoff])
+        if int(warm.size) == 0:
+            return state            # nothing journaled yet: keep as restored
+        log.info("warm-started replay buffer with %d journaled transitions",
+                 int(warm.size))
+        self.events.emit("replay_warm_started", size=int(warm.size))
+        return state.replace(extras=state.extras.replace(replay=warm))
 
     # ------------------------------------------------------------------
     # queries (IsEverythingDone / GetAvg / GetStd; ShareTradeHelper.scala:35-39)
@@ -385,11 +472,16 @@ class Orchestrator:
         scan on the current params; training state is untouched."""
         if self.agent is None or self._ts is None:
             raise RuntimeError("no training data / state")
-        from sharetrade_tpu.models import build_model
-        from sharetrade_tpu.agents import _HEADS  # registry head mapping
-        model = build_model(self.cfg.model, self.env.obs_dim,
-                            head=_HEADS[self.cfg.learner.algo],
-                            num_actions=self.env.num_actions)
+        # Evaluate the exact network that was trained (the agent carries its
+        # model) — rebuilding from config here would silently evaluate a
+        # different architecture whenever a custom model was injected.
+        model = self.agent.model
+        if model is None:
+            from sharetrade_tpu.models import build_model
+            from sharetrade_tpu.agents import _HEADS  # registry head mapping
+            model = build_model(self.cfg.model, self.env.obs_dim,
+                                head=_HEADS[self.cfg.learner.algo],
+                                num_actions=self.env.num_actions)
         env = self.env
         horizon = env.num_steps
         params = self._ts.params
@@ -423,6 +515,9 @@ class Orchestrator:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
+        if self._transitions_journal is not None:
+            self._transitions_journal.close()
+            self._transitions_journal = None
 
     @property
     def train_state(self) -> TrainState | None:

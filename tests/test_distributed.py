@@ -1,0 +1,70 @@
+"""Multi-host (DCN) bring-up gating — the reference's dormant remoting tier
+(build.sbt:13 akka-remote on the classpath, README.md:13 "Akka Clustering
+will come later") made explicit and testable.
+
+A REAL 2-process smoke is environmentally blocked here: this host's
+interpreter startup binds jax to the single tunneled TPU chip
+(JAX_PLATFORMS=cpu is overridden), so two distributed processes would both
+claim the same chip. These tests therefore pin the *gating contract* of
+``init_distributed`` — which tier fires, with which arguments, and its
+idempotence — against a recorded ``jax.distributed.initialize``; the
+documented bring-up recipe lives in its docstring (parallel/mesh.py).
+"""
+
+import pytest
+
+from sharetrade_tpu.parallel import init_distributed
+from sharetrade_tpu.parallel import mesh as mesh_mod
+
+
+class _Recorder:
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, **kwargs):
+        self.calls.append(kwargs)
+
+
+@pytest.fixture
+def recorded_initialize(monkeypatch):
+    rec = _Recorder()
+    monkeypatch.setattr(mesh_mod.jax.distributed, "initialize", rec)
+    # Ensure the idempotence guard sees "not yet initialized".
+    monkeypatch.setattr(
+        mesh_mod.jax.distributed, "is_initialized", lambda: False)
+    for var in ("JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    return rec
+
+
+class TestInitDistributedGating:
+    def test_single_process_noop(self, recorded_initialize):
+        assert init_distributed() is False
+        assert recorded_initialize.calls == []
+
+    def test_env_var_triggers_initialize(self, recorded_initialize,
+                                         monkeypatch):
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:8476")
+        assert init_distributed() is True
+        assert recorded_initialize.calls == [{}]  # env-discovered
+
+    def test_megascale_env_var_triggers_initialize(self, recorded_initialize,
+                                                   monkeypatch):
+        monkeypatch.setenv("MEGASCALE_COORDINATOR_ADDRESS", "10.0.0.1:8476")
+        assert init_distributed() is True
+        assert recorded_initialize.calls == [{}]
+
+    def test_explicit_args_take_precedence(self, recorded_initialize,
+                                           monkeypatch):
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "ignored:1")
+        init_distributed("host0:8476", num_processes=2, process_id=1)
+        assert recorded_initialize.calls == [{
+            "coordinator_address": "host0:8476",
+            "num_processes": 2, "process_id": 1}]
+
+    def test_idempotent_after_bringup(self, recorded_initialize, monkeypatch):
+        # Simulate an already-initialized runtime: no second initialize.
+        monkeypatch.setattr(
+            mesh_mod.jax.distributed, "is_initialized", lambda: True)
+        init_distributed("host0:8476", num_processes=2, process_id=0)
+        assert recorded_initialize.calls == []

@@ -245,6 +245,83 @@ class TestEvaluateAndResume:
 
 
 @pytest.mark.slow
+class TestJournalBackedReplay:
+    """learner.journal_replay: every chunk's transitions are appended to a
+    durable event log and the DQN buffer is rebuilt from it on resume — the
+    reference's event-sourced persistence (SharePriceGetter.scala:37,55-62)
+    generalized to experience data (SURVEY.md §7.4)."""
+
+    def _cfg(self, tmp_path):
+        cfg = fast_cfg(tmp_path, algo="dqn")
+        cfg.learner.journal_replay = True
+        cfg.learner.replay_capacity = 1024
+        cfg.learner.replay_batch = 8
+        cfg.data.journal_dir = str(tmp_path / "journal")
+        return cfg
+
+    def test_resume_rebuilds_buffer_from_journal(self, tmp_path):
+        cfg = self._cfg(tmp_path)
+        orch = run_end_to_end(cfg, PRICES)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        horizon = len(PRICES) - WINDOW
+        size_after = int(orch.train_state.extras.replay.size)
+        assert size_after == horizon * cfg.parallel.num_workers
+        orch.stop()
+        # A fresh orchestrator resuming from checkpoint warm-starts the
+        # buffer from the journal (train → crash → resume with warm buffer).
+        orch2 = Orchestrator(cfg)
+        orch2.send_training_data(PRICES, resume=True)
+        assert int(orch2.train_state.extras.replay.size) == size_after
+        orch2.stop()
+
+    def test_fresh_retrain_truncates_and_rejournals(self, tmp_path):
+        """A fresh (non-resume) send_training_data truncates the journal AND
+        resets the journaling high-water mark — the new run's env_steps
+        restart at zero and must journal from its first chunk."""
+        cfg = self._cfg(tmp_path)
+        orch = run_end_to_end(cfg, PRICES)
+        horizon = len(PRICES) - WINDOW
+        orch.send_training_data(PRICES)     # fresh run on the same orch
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        from sharetrade_tpu.data.journal import Journal
+        journaled = sum(
+            len(e["action"])
+            for e in Journal(f"{cfg.data.journal_dir}/transitions.journal").replay()
+            if e.get("type") == "transitions")
+        assert journaled == horizon * cfg.parallel.num_workers
+        orch.stop()
+
+    def test_heal_after_fault_with_journaled_buffer(self, tmp_path):
+        cfg = self._cfg(tmp_path)
+        fail_at = {1}
+
+        def chaos(chunk_idx, metrics):
+            if chunk_idx in fail_at:
+                fail_at.discard(chunk_idx)
+                raise RuntimeError("injected PoisonPill")
+
+        orch = Orchestrator(cfg, fault_hook=chaos)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.restarts == 1
+        # Exactly-once: the heal (restore -> warm-start -> re-run) must not
+        # double-count the chunks between checkpoint and crash, in the live
+        # buffer or in the journal.
+        horizon = len(PRICES) - WINDOW
+        assert (int(orch.train_state.extras.replay.size)
+                == horizon * cfg.parallel.num_workers)
+        from sharetrade_tpu.data.journal import Journal
+        journaled = sum(
+            len(e["action"])
+            for e in Journal(f"{cfg.data.journal_dir}/transitions.journal").replay()
+            if e.get("type") == "transitions")
+        assert journaled == horizon * cfg.parallel.num_workers
+        orch.stop()
+
+
+@pytest.mark.slow
 class TestInitialise:
     def test_retrain_keeps_params(self, tmp_path):
         orch = run_end_to_end(fast_cfg(tmp_path), PRICES)
