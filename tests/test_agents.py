@@ -187,6 +187,86 @@ class TestGAE:
         np.testing.assert_allclose(got[2], 2.0 - 0.4, rtol=1e-6)
 
 
+class TestReplayForwardFold:
+    """The stateless fold path (one big batched forward) must match the
+    per-step scan path exactly — a reshape-order bug here would silently
+    permute time/batch rows in every PPO/A2C/PG loss."""
+
+    def _traj_and_model(self, hidden=16, t=6, b=4, obs_dim=10):
+        from sharetrade_tpu.agents.rollout import StepData
+        from sharetrade_tpu.models.mlp import ac_mlp
+        model = ac_mlp(obs_dim, hidden)
+        params = model.init(jax.random.PRNGKey(0))
+        obs = jax.random.uniform(jax.random.PRNGKey(1), (t, b, obs_dim))
+        z = jnp.zeros((t, b))
+        traj = StepData(obs=obs, action=z.astype(jnp.int32), logp=z,
+                        value=z, reward=z, active=z + 1.0)
+        return model, params, traj
+
+    def _scan_reference(self, model, params, traj):
+        from sharetrade_tpu.models.core import apply_batched
+
+        def one_step(carry, obs_t):
+            outs, _ = apply_batched(model, params, obs_t, ())
+            return carry, (outs.logits, outs.value)
+
+        _, (logits, values) = jax.lax.scan(one_step, None, traj.obs)
+        return logits, values
+
+    @pytest.mark.parametrize("max_rows", [10_000, 8, 1])
+    def test_fold_matches_scan(self, max_rows, monkeypatch):
+        """max_rows sweeps single-fold, grouped (fold=2), and per-step."""
+        from sharetrade_tpu.agents import rollout
+        monkeypatch.setattr(rollout, "_MAX_FOLD_ROWS", max_rows)
+        model, params, traj = self._traj_and_model()
+        want_l, want_v = self._scan_reference(model, params, traj)
+        for remat in (False, True):
+            got_l, got_v = rollout.replay_forward(
+                model, params, traj, (), remat=remat)
+            np.testing.assert_allclose(np.asarray(got_l), np.asarray(want_l),
+                                       rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(got_v), np.asarray(want_v),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_fold_gradients_match_scan(self, monkeypatch):
+        from sharetrade_tpu.agents import rollout
+        monkeypatch.setattr(rollout, "_MAX_FOLD_ROWS", 8)  # 2 groups
+        model, params, traj = self._traj_and_model()
+
+        def loss_fold(p):
+            lg, v = rollout.replay_forward(model, p, traj, (), remat=True)
+            return jnp.sum(lg ** 2) + jnp.sum(v ** 2)
+
+        def loss_scan(p):
+            lg, v = self._scan_reference(model, p, traj)
+            return jnp.sum(lg ** 2) + jnp.sum(v ** 2)
+
+        g_fold = jax.grad(loss_fold)(params)
+        g_scan = jax.grad(loss_scan)(params)
+        for a, b in zip(jax.tree.leaves(g_fold), jax.tree.leaves(g_scan)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_lstm_keeps_carry_scan(self):
+        """Recurrent models must stay on the carry-threading path."""
+        from sharetrade_tpu.agents import rollout
+        from sharetrade_tpu.agents.rollout import StepData
+        from sharetrade_tpu.models.lstm import lstm_policy
+        t, b, obs_dim = 3, 2, 10
+        model = lstm_policy(obs_dim, 8)
+        params = model.init(jax.random.PRNGKey(0))
+        carry = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (b,) + x.shape), model.init_carry())
+        one = jax.random.uniform(jax.random.PRNGKey(1), (b, obs_dim))
+        obs = jnp.broadcast_to(one, (t, b, obs_dim))   # identical every step
+        z = jnp.zeros((t, b))
+        traj = StepData(obs=obs, action=z.astype(jnp.int32), logp=z,
+                        value=z, reward=z, active=z + 1.0)
+        logits, values = rollout.replay_forward(model, params, traj, carry)
+        # Same obs at every step must give DIFFERENT outputs (carry evolves).
+        assert not np.allclose(np.asarray(logits[0]), np.asarray(logits[1]))
+
+
 class TestReplayBuffer:
     def test_push_wraps_and_masks(self):
         rb = ReplayBuffer.create(8, 3)
