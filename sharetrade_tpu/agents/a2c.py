@@ -49,8 +49,8 @@ def make_a2c_agent(model: Model, env: TradingEnv,
         denom = jnp.maximum(jnp.sum(weight), 1.0)
 
         def loss_fn(params):
-            logits, values = replay_forward(model, params, traj, init_carry,
-                                            remat=cfg.remat)
+            logits, values, aux = replay_forward(
+                model, params, traj, init_carry, remat=cfg.remat)
             log_probs = jax.nn.log_softmax(logits)
             logp = jnp.take_along_axis(
                 log_probs, traj.action[..., None], axis=-1)[..., 0]
@@ -61,7 +61,7 @@ def make_a2c_agent(model: Model, env: TradingEnv,
                 jnp.sum(jnp.exp(log_probs) * log_probs, axis=-1) * weight
             ) / denom
             total = (policy_loss + cfg.value_coef * value_loss
-                     - cfg.entropy_coef * entropy)
+                     - cfg.entropy_coef * entropy + cfg.aux_loss_coef * aux)
             return total, (policy_loss, value_loss, entropy)
 
         (loss, (policy_loss, value_loss, entropy)), grads = jax.value_and_grad(

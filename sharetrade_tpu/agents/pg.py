@@ -50,12 +50,13 @@ def make_pg_agent(model: Model, env: TradingEnv,
         adv = (returns - baseline) * weight
 
         def loss_fn(params):
-            logits, _ = replay_forward(model, params, traj, init_carry,
-                                       remat=cfg.remat)
+            logits, _, aux = replay_forward(model, params, traj, init_carry,
+                                            remat=cfg.remat)
             logp = jnp.take_along_axis(
                 jax.nn.log_softmax(logits), traj.action[..., None], axis=-1
             )[..., 0]
-            return -jnp.sum(logp * jax.lax.stop_gradient(adv)) / denom
+            pg_loss = -jnp.sum(logp * jax.lax.stop_gradient(adv)) / denom
+            return pg_loss + cfg.aux_loss_coef * aux
 
         loss, grads = jax.value_and_grad(loss_fn)(ts.params)
         updates, opt_state = optimizer.update(grads, ts.opt_state, ts.params)

@@ -57,8 +57,8 @@ def make_ppo_agent(model: Model, env: TradingEnv,
         )
 
     def minibatch_loss(params, traj_mb, carry_mb, adv_mb, ret_mb):
-        logits, values = replay_forward(model, params, traj_mb, carry_mb,
-                                        remat=cfg.remat)
+        logits, values, aux = replay_forward(model, params, traj_mb, carry_mb,
+                                             remat=cfg.remat)
         log_probs = jax.nn.log_softmax(logits)
         logp = jnp.take_along_axis(
             log_probs, traj_mb.action[..., None], axis=-1)[..., 0]
@@ -78,7 +78,7 @@ def make_ppo_agent(model: Model, env: TradingEnv,
         entropy = -jnp.sum(
             jnp.sum(jnp.exp(log_probs) * log_probs, axis=-1) * weight) / denom
         total = (policy_loss + cfg.value_coef * value_loss
-                 - cfg.entropy_coef * entropy)
+                 - cfg.entropy_coef * entropy + cfg.aux_loss_coef * aux)
         return total, (policy_loss, value_loss, entropy)
 
     def step(ts: TrainState):

@@ -90,8 +90,11 @@ _MAX_FOLD_ROWS = 2048
 
 def replay_forward(model: Model, params: Any, traj: StepData, init_carry,
                    *, remat: bool = False):
-    """Recompute (logits, values) along a stored trajectory under ``params``,
-    threading the recurrent carry — the differentiable forward for losses.
+    """Recompute ``(logits, values, aux)`` along a stored trajectory under
+    ``params``, threading the recurrent carry — the differentiable forward
+    for losses. ``aux`` is the mean of the model's auxiliary loss over the
+    replay (ModelOut.aux — the MoE balance term; 0 for dense models), which
+    losses weight by ``LearnerConfig.aux_loss_coef``.
 
     Stateless models (MLP, transformer — empty carry) have no step-to-step
     data dependence, so the (T, B) trajectory folds into one big batch
@@ -124,18 +127,19 @@ def replay_forward(model: Model, params: Any, traj: StepData, init_carry,
                 (b * fold,) + obs_g.shape[2:])
             outs, _ = apply_batched(model, params, flat, init_carry)
             return (outs.logits.reshape(b, fold, -1).swapaxes(0, 1),
-                    outs.value.reshape(b, fold).swapaxes(0, 1))
+                    outs.value.reshape(b, fold).swapaxes(0, 1),
+                    jnp.mean(jnp.asarray(outs.aux)))
 
         if remat:
             fwd = jax.checkpoint(fwd)
         if groups == 1:
-            logits, values = fwd(params, traj.obs)
-            return logits, values
+            return fwd(params, traj.obs)
         grouped = traj.obs.reshape((groups, fold) + traj.obs.shape[1:])
-        _, (logits, values) = jax.lax.scan(
+        _, (logits, values, aux) = jax.lax.scan(
             lambda _, obs_g: (None, fwd(params, obs_g)), None, grouped)
         return (logits.reshape((t,) + logits.shape[2:]),
-                values.reshape((t,) + values.shape[2:]))
+                values.reshape((t,) + values.shape[2:]),
+                jnp.mean(aux))
 
     def fwd(params, obs_t, model_carry):
         return apply_batched(model, params, obs_t, model_carry)
@@ -145,10 +149,11 @@ def replay_forward(model: Model, params: Any, traj: StepData, init_carry,
 
     def one_step(model_carry, obs_t):
         outs, new_carry = fwd(params, obs_t, model_carry)
-        return new_carry, (outs.logits, outs.value)
+        return new_carry, (outs.logits, outs.value,
+                           jnp.mean(jnp.asarray(outs.aux)))
 
-    _, (logits, values) = jax.lax.scan(one_step, init_carry, traj.obs)
-    return logits, values  # (T, B, A), (T, B)
+    _, (logits, values, aux) = jax.lax.scan(one_step, init_carry, traj.obs)
+    return logits, values, jnp.mean(aux)  # (T, B, A), (T, B), scalar
 
 
 def discounted_returns(rewards: jax.Array, active: jax.Array,

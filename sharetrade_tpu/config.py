@@ -53,16 +53,25 @@ class ModelConfig:
     dtype: str = "float32"             # compute dtype ("bfloat16" on TPU for speed)
     # Attention partitioning: "flash" = local Pallas kernel per device;
     # "ring" = sequence-parallel ring attention over the mesh's sp axis
-    # (requires a mesh with sp>1 — the long-context scale-out path).
+    # (ppermute K/V rotation, arbitrary sp size); "ulysses" = all_to_all
+    # head<->sequence re-partition running the full-sequence local kernel
+    # per head group (sp must divide num_heads). Both need a mesh with sp>1
+    # — the long-context scale-out paths.
     attention: str = "flash"
     # Pipeline the transformer blocks over the mesh's pp axis (one block per
     # stage; requires num_layers == pp size and a mesh with pp>1).
     pipeline_blocks: bool = False
     # Mixture-of-experts FFN: >0 replaces each transformer block's dense MLP
-    # with a top-1-routed expert bank (sharded over the mesh's ep axis when
-    # one exists, single-device otherwise). The gate trains through the task
+    # with a routed expert bank (sharded over the mesh's ep axis when one
+    # exists, single-device otherwise). The gate trains through the task
     # loss via its routing weight.
     moe_experts: int = 0
+    # moe_top_k=0 keeps the exact dense-mask top-1 scheme (every expert runs
+    # every token — O(E·N), no drops). >0 switches to capacity-bucketed
+    # top-k dispatch (GShard-style): each expert evaluates only its routed
+    # buffer, picks past ``moe_capacity_factor`` headroom are dropped.
+    moe_top_k: int = 0
+    moe_capacity_factor: float = 1.25
 
 
 @dataclass
@@ -87,6 +96,9 @@ class LearnerConfig:
     # the replay buffer from it on resume (the reference's event-sourced
     # persistence generalized to experience data, SURVEY.md §7.4).
     journal_replay: bool = False
+    # Weight on the model's auxiliary loss (ModelOut.aux — the MoE balance
+    # regularizer); inert (aux = 0) for dense models.
+    aux_loss_coef: float = 0.01
     # PPO/A2C:
     entropy_coef: float = 0.01
     value_coef: float = 0.5

@@ -47,15 +47,27 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
         pp_mesh = None
         batch_axis = (  # agent batch rides dp when the mesh has it
             "dp" if mesh is not None and "dp" in mesh.axis_names else None)
-        if cfg.attention == "ring":
+        # A non-TPU mesh (the virtual-CPU test/dryrun client) can't lower the
+        # Pallas kernel; the XLA reference path is numerically identical.
+        use_pallas = (False if mesh is not None
+                      and mesh.devices.flat[0].platform != "tpu" else None)
+        if cfg.attention in ("ring", "ulysses"):
             if mesh is None or "sp" not in mesh.axis_names:
                 raise ValueError(
-                    "model.attention='ring' needs a mesh with an 'sp' axis "
-                    "(set parallel.mesh_shape, e.g. {\"dp\": 2, \"sp\": 4})")
-            from sharetrade_tpu.parallel.ring_attention import (
-                ring_attention_sharded)
-            attention_fn = ring_attention_sharded(
-                mesh, seq_axis="sp", batch_axis=batch_axis)
+                    f"model.attention={cfg.attention!r} needs a mesh with an "
+                    "'sp' axis (set parallel.mesh_shape, e.g. "
+                    "{\"dp\": 2, \"sp\": 4})")
+            if cfg.attention == "ring":
+                from sharetrade_tpu.parallel.ring_attention import (
+                    ring_attention_sharded)
+                attention_fn = ring_attention_sharded(
+                    mesh, seq_axis="sp", batch_axis=batch_axis)
+            else:
+                from sharetrade_tpu.parallel.ulysses import (
+                    ulysses_attention_sharded)
+                attention_fn = ulysses_attention_sharded(
+                    mesh, seq_axis="sp", batch_axis=batch_axis,
+                    use_pallas=use_pallas)
         elif cfg.attention != "flash":
             raise ValueError(f"unknown model.attention {cfg.attention!r}")
         if cfg.pipeline_blocks:
@@ -63,24 +75,23 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
                 raise ValueError(
                     "model.pipeline_blocks needs a mesh with a 'pp' axis "
                     "(set parallel.mesh_shape, e.g. {\"dp\": 2, \"pp\": 4})")
-            if cfg.attention == "ring":
+            if cfg.attention != "flash":
                 raise ValueError(
-                    "model.attention='ring' + model.pipeline_blocks is "
-                    "unsupported (nested shard_maps); pick one partitioning")
+                    f"model.attention={cfg.attention!r} + "
+                    "model.pipeline_blocks is unsupported (nested "
+                    "shard_maps); pick one partitioning")
             pp_mesh = mesh
         # Experts shard over ep when the mesh has that axis; otherwise the
         # expert bank runs single-device (still trainable — the mechanism's
         # reachability doesn't depend on the mesh).
         ep_mesh = (mesh if cfg.moe_experts and mesh is not None
                    and "ep" in mesh.axis_names else None)
-        # A non-TPU mesh (the virtual-CPU test/dryrun client) can't lower the
-        # Pallas kernel; the XLA reference path is numerically identical.
-        use_pallas = (False if mesh is not None
-                      and mesh.devices.flat[0].platform != "tpu" else None)
         return transformer_policy(
             obs_dim, actions, num_layers=cfg.num_layers,
             num_heads=cfg.num_heads, head_dim=cfg.head_dim, dtype=dtype,
             use_pallas=use_pallas, attention_fn=attention_fn,
             pp_mesh=pp_mesh, pp_batch_axis=batch_axis,
-            moe_experts=cfg.moe_experts, ep_mesh=ep_mesh)
+            moe_experts=cfg.moe_experts, ep_mesh=ep_mesh,
+            moe_top_k=cfg.moe_top_k,
+            moe_capacity_factor=cfg.moe_capacity_factor)
     raise ValueError(f"unknown model kind {cfg.kind!r}")

@@ -28,6 +28,12 @@ import jax.numpy as jnp
 class ModelOut(NamedTuple):
     logits: jax.Array  # (num_actions,) action preferences / Q-values
     value: jax.Array   # scalar critic estimate (0.0 for valueless heads)
+    # Auxiliary regularizer the forward pass wants added to the training
+    # loss — the MoE load-balance term (parallel/moe.py), without which a
+    # capacity-dispatch gate can collapse onto one expert and silently drop
+    # overflowing tokens. 0.0 for models with no such term; losses weight it
+    # by LearnerConfig.aux_loss_coef.
+    aux: jax.Array | float = 0.0
 
 
 @dataclass(frozen=True)

@@ -35,7 +35,8 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                        attention_fn=None, pp_mesh=None, pp_axis: str = "pp",
                        pp_batch_axis: str | None = None,
                        moe_experts: int = 0, ep_mesh=None,
-                       ep_axis: str = "ep") -> Model:
+                       ep_axis: str = "ep", moe_top_k: int = 0,
+                       moe_capacity_factor: float = 1.25) -> Model:
     """``attention_fn(q, k, v) -> out`` overrides the local flash kernel —
     the sequence-parallel hook (e.g. ``ring_attention_sharded`` binds a mesh
     so attention rings over the sp axis, parallel/ring_attention.py).
@@ -102,7 +103,13 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         return params
 
     def block_apply(blk, x):
-        """One pre-LN transformer block over (B, T, d) tokens."""
+        """One pre-LN transformer block over (B, T, d) tokens.
+
+        Returns ``(x, aux)`` — aux is the block's MoE load-balance loss
+        (0.0 for dense-FFN blocks), surfaced so training can regularize the
+        gate: with capacity dispatch (moe_top_k>0) an unbalanced gate
+        overflows expert buffers and silently zeroes dropped tokens.
+        """
         bsz, t = x.shape[0], x.shape[1]
         h = _layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
         qkv = dense(blk["qkv"], h).reshape(bsz, t, 3, num_heads, head_dim)
@@ -114,16 +121,27 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         x = x + dense(blk["proj"], attn)
         h = _layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
         if moe_experts:
-            from sharetrade_tpu.parallel.moe import moe_apply, moe_apply_sharded
+            from sharetrade_tpu.parallel import moe as moe_lib
             flat = h.reshape(-1, d_model)
-            if ep_mesh is not None:
-                y, _aux = moe_apply_sharded(
+            if moe_top_k:      # capacity-bucketed top-k dispatch
+                if ep_mesh is not None:
+                    y, aux = moe_lib.moe_apply_topk_sharded(
+                        blk["moe"], flat, ep_mesh, axis=ep_axis,
+                        top_k=moe_top_k, capacity_factor=moe_capacity_factor,
+                        batch_axis=pp_batch_axis)
+                else:
+                    y, aux = moe_lib.moe_apply_topk(
+                        blk["moe"], flat, top_k=moe_top_k,
+                        capacity_factor=moe_capacity_factor)
+            elif ep_mesh is not None:
+                y, aux = moe_lib.moe_apply_sharded(
                     blk["moe"], flat, ep_mesh, axis=ep_axis,
                     batch_axis=pp_batch_axis)
             else:
-                y, _aux = moe_apply(blk["moe"], flat)
-            return x + y.reshape(h.shape)
-        return x + dense(blk["mlp_out"], jax.nn.gelu(dense(blk["mlp_in"], h)))
+                y, aux = moe_lib.moe_apply(blk["moe"], flat)
+            return x + y.reshape(h.shape), aux
+        out = x + dense(blk["mlp_out"], jax.nn.gelu(dense(blk["mlp_in"], h)))
+        return out, jnp.float32(0.0)
 
     def tokenize(obs):
         """(B, obs_dim) -> (B, seq, 3) token features."""
@@ -148,9 +166,11 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         bsz = obs.shape[0]
         tokens = tokenize(obs).astype(dtype)
         x = dense(params["embed"], tokens) + params["pos"]       # (B, seq, d)
+        aux = jnp.float32(0.0)
         if pp_mesh is None:
             for blk in params["blocks"]:
-                x = block_apply(blk, x)
+                x, blk_aux = block_apply(blk, x)
+                aux = aux + blk_aux
         else:
             from sharetrade_tpu.parallel.pipeline import pipeline_apply
             from jax.sharding import PartitionSpec as P
@@ -162,19 +182,23 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
             b_axis = pp_batch_axis
             if b_axis is not None and (bsz // m) % pp_mesh.shape[b_axis]:
                 b_axis = None   # odd batch (e.g. eval's batch-1): replicate
+            # moe + pipeline_blocks is rejected at construction, so pipelined
+            # stages never carry an aux term to drop.
             mb = pipeline_apply(
-                block_apply, params["blocks"], mb, pp_mesh, axis=pp_axis,
-                mb_spec=P(None, b_axis))
+                lambda blk, t: block_apply(blk, t)[0], params["blocks"], mb,
+                pp_mesh, axis=pp_axis, mb_spec=P(None, b_axis))
             x = mb.reshape((bsz,) + mb.shape[2:])
         summary = _layer_norm(x[:, -1], params["final_ln"]["scale"],
                               params["final_ln"]["bias"])
         logits = dense(params["policy"], summary).astype(jnp.float32)
         value = dense(params["value"], summary).astype(jnp.float32)[:, 0]
-        return ModelOut(logits=logits, value=value), carry
+        return ModelOut(logits=logits, value=value,
+                        aux=aux / max(num_layers, 1)), carry
 
     def apply(params, obs, carry):
         outs, carry = apply_batch(params, obs[None], carry)
-        return ModelOut(logits=outs.logits[0], value=outs.value[0]), carry
+        return ModelOut(logits=outs.logits[0], value=outs.value[0],
+                        aux=outs.aux), carry
 
     return Model(init=init, apply=apply, apply_batch=apply_batch,
                  obs_dim=obs_dim, num_actions=num_actions, name="transformer")

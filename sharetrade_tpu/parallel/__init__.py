@@ -17,12 +17,20 @@ from sharetrade_tpu.parallel.moe import (  # noqa: F401
     init_moe_params,
     moe_apply,
     moe_apply_sharded,
+    moe_apply_topk,
+    moe_apply_topk_a2a,
+    moe_apply_topk_sharded,
 )
 from sharetrade_tpu.parallel.pipeline import pipeline_apply, stack_stage_params  # noqa: F401
 from sharetrade_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_attention_sharded,
     sequence_sharding,
+)
+from sharetrade_tpu.parallel.ulysses import (  # noqa: F401
+    ulysses_attention,
+    ulysses_attention_padded,
+    ulysses_attention_sharded,
 )
 from sharetrade_tpu.parallel.sharding import (  # noqa: F401
     batch_axis_sharding,

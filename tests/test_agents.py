@@ -221,7 +221,7 @@ class TestReplayForwardFold:
         model, params, traj = self._traj_and_model()
         want_l, want_v = self._scan_reference(model, params, traj)
         for remat in (False, True):
-            got_l, got_v = rollout.replay_forward(
+            got_l, got_v, _aux = rollout.replay_forward(
                 model, params, traj, (), remat=remat)
             np.testing.assert_allclose(np.asarray(got_l), np.asarray(want_l),
                                        rtol=1e-5, atol=1e-6)
@@ -234,7 +234,7 @@ class TestReplayForwardFold:
         model, params, traj = self._traj_and_model()
 
         def loss_fold(p):
-            lg, v = rollout.replay_forward(model, p, traj, (), remat=True)
+            lg, v, _ = rollout.replay_forward(model, p, traj, (), remat=True)
             return jnp.sum(lg ** 2) + jnp.sum(v ** 2)
 
         def loss_scan(p):
@@ -262,7 +262,7 @@ class TestReplayForwardFold:
         z = jnp.zeros((t, b))
         traj = StepData(obs=obs, action=z.astype(jnp.int32), logp=z,
                         value=z, reward=z, active=z + 1.0)
-        logits, values = rollout.replay_forward(model, params, traj, carry)
+        logits, values, _ = rollout.replay_forward(model, params, traj, carry)
         # Same obs at every step must give DIFFERENT outputs (carry evolves).
         assert not np.allclose(np.asarray(logits[0]), np.asarray(logits[1]))
 

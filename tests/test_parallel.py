@@ -175,6 +175,70 @@ class TestRingAttention:
                                    rtol=2e-5, atol=2e-5)
 
 
+class TestUlyssesAttention:
+    """all_to_all head<->sequence re-partition (parallel/ulysses.py)."""
+
+    def _mesh(self, cpu_devices, n=8):
+        return Mesh(np.asarray(cpu_devices[:n]).reshape(n), ("sp",))
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, cpu_devices, causal):
+        from sharetrade_tpu.parallel import ulysses_attention
+        mesh = self._mesh(cpu_devices)
+        key = jax.random.PRNGKey(3)
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (2, 8, 64, 16)   # heads 8 == sp, seq 64 divisible
+        q, k, v = (jax.random.normal(kx, shape) for kx in (kq, kk, kv))
+        got = ulysses_attention(q, k, v, mesh, causal=causal,
+                                use_pallas=False)
+        want = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_matches_ring(self, cpu_devices):
+        from sharetrade_tpu.parallel import ulysses_attention
+        mesh = self._mesh(cpu_devices)
+        q = jax.random.normal(jax.random.PRNGKey(1), (1, 8, 128, 16))
+        got = ulysses_attention(q, q, q, mesh, causal=True, use_pallas=False)
+        want = ring_attention(q, q, q, mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_rejects_indivisible_heads(self, cpu_devices):
+        from sharetrade_tpu.parallel import ulysses_attention
+        mesh = self._mesh(cpu_devices)
+        q = jnp.zeros((1, 4, 64, 16))   # 4 heads, sp=8
+        with pytest.raises(ValueError, match="heads divisible"):
+            ulysses_attention(q, q, q, mesh)
+
+    def test_padded_handles_indivisible_seq(self, cpu_devices):
+        from sharetrade_tpu.parallel import ulysses_attention_padded
+        mesh = self._mesh(cpu_devices)
+        key = jax.random.PRNGKey(7)
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (1, 8, 61, 16)   # 61 pads to 64
+        q, k, v = (jax.random.normal(kx, shape) for kx in (kq, kk, kv))
+        got = ulysses_attention_padded(q, k, v, mesh, causal=True,
+                                       use_pallas=False)
+        want = reference_attention(q, k, v, causal=True)
+        assert got.shape == q.shape
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_differentiates(self, cpu_devices):
+        from sharetrade_tpu.parallel import ulysses_attention
+        mesh = self._mesh(cpu_devices, n=2)
+        q = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 32, 8))
+
+        def loss(q):
+            return jnp.sum(ulysses_attention(q, q, q, mesh, causal=True,
+                                             use_pallas=False) ** 2)
+
+        g = jax.grad(loss)(q)
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.linalg.norm(g)) > 0
+
+
 class TestPartitionedTransformer:
     """The sp/pp mechanisms reached through the PUBLIC config surface
     (model.attention='ring', model.pipeline_blocks) — the round-1 gap of
@@ -205,6 +269,19 @@ class TestPartitionedTransformer:
         params = ring_model.init(jax.random.PRNGKey(0))
         obs = self._obs()
         got, _ = ring_model.apply_batch(params, obs, ())
+        want, _ = flash_model.apply_batch(params, obs, ())
+        np.testing.assert_allclose(np.asarray(got.logits),
+                                   np.asarray(want.logits),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_ulysses_attention_matches_flash(self, cpu_devices):
+        uly_model, _ = self._model(cpu_devices, (4, 2), ("dp", "sp"),
+                                   attention="ulysses", num_layers=2)
+        flash_model, _ = self._model(cpu_devices, (4, 2), ("dp", "sp"),
+                                     attention="flash", num_layers=2)
+        params = uly_model.init(jax.random.PRNGKey(0))
+        obs = self._obs()
+        got, _ = uly_model.apply_batch(params, obs, ())
         want, _ = flash_model.apply_batch(params, obs, ())
         np.testing.assert_allclose(np.asarray(got.logits),
                                    np.asarray(want.logits),
@@ -248,6 +325,16 @@ class TestPartitionedTransformer:
         with pytest.raises(ValueError, match="pp"):
             self._model(cpu_devices, (8,), ("dp",), pipeline_blocks=True)
 
+    @pytest.mark.parametrize("attention", ["ring", "ulysses"])
+    def test_config_rejects_sp_attention_plus_pipeline(self, cpu_devices,
+                                                       attention):
+        """Nested shard_maps must fail loudly at construction, not with an
+        obscure trace-time mesh error."""
+        with pytest.raises(ValueError, match="pipeline_blocks is unsupported"):
+            self._model(cpu_devices, (2, 2, 2), ("dp", "sp", "pp"),
+                        attention=attention, pipeline_blocks=True,
+                        num_layers=2)
+
 
 @pytest.mark.slow
 class TestPartitionedTrainingEndToEnd:
@@ -287,6 +374,12 @@ class TestPartitionedTrainingEndToEnd:
         cfg.model.num_layers = 2
         self._run(cfg, cpu_devices)
 
+    def test_ulysses_attention_via_config(self, tmp_path, cpu_devices):
+        cfg = self._cfg(tmp_path, {"dp": 4, "sp": 2})   # sp divides 2 heads
+        cfg.model.attention = "ulysses"
+        cfg.model.num_layers = 2
+        self._run(cfg, cpu_devices)
+
     def test_pipelined_transformer_via_config(self, tmp_path, cpu_devices):
         cfg = self._cfg(tmp_path, {"dp": 2, "pp": 4})
         cfg.model.pipeline_blocks = True
@@ -296,5 +389,13 @@ class TestPartitionedTrainingEndToEnd:
     def test_moe_transformer_via_config(self, tmp_path, cpu_devices):
         cfg = self._cfg(tmp_path, {"dp": 2, "ep": 4})
         cfg.model.moe_experts = 4
+        cfg.model.num_layers = 2
+        self._run(cfg, cpu_devices)
+
+    def test_topk_moe_transformer_via_config(self, tmp_path, cpu_devices):
+        """Capacity-dispatch top-k experts reachable from the same surface."""
+        cfg = self._cfg(tmp_path, {"dp": 2, "ep": 4})
+        cfg.model.moe_experts = 4
+        cfg.model.moe_top_k = 2
         cfg.model.num_layers = 2
         self._run(cfg, cpu_devices)
