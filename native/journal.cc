@@ -92,9 +92,145 @@ long scan_file(const char* path, std::string* out) {
   return offset;
 }
 
+// Packed transition record payload (codec shared with the Python side,
+// sharetrade_tpu/data/transitions.py):
+//
+//   "STR1" | u32 batch | u32 obs_dim | u64 env_steps |
+//   f32 obs[batch*obs_dim] | i32 action[batch] | f32 reward[batch] |
+//   f32 next_obs[batch*obs_dim]        (all little-endian)
+//
+// stj_read_tail_transitions scans the framed log once, keeps only the most
+// recent records whose rows fit a replay buffer of `max_rows`, and packs
+// them into one contiguous buffer — the host-side decode bandwidth the DQN
+// replay warm-start needs (no per-record Python/JSON overhead).
+
+constexpr char kTransMagic[4] = {'S', 'T', 'R', '1'};
+constexpr size_t kTransHeader = 4 + 4 + 4 + 8;
+
+struct TransRec {
+  uint32_t batch;
+  uint32_t obs_dim;
+  uint64_t env_steps;
+  std::vector<uint8_t> body;  // arrays only (payload minus header)
+};
+
+uint64_t get_u64(const uint8_t* src) {
+  uint64_t lo = get_u32(src), hi = get_u32(src + 4);
+  return lo | (hi << 32);
+}
+
+void put_u64(uint8_t* dst, uint64_t v) {
+  put_u32(dst, (uint32_t)(v & 0xFFFFFFFFu));
+  put_u32(dst + 4, (uint32_t)(v >> 32));
+}
+
+// Parse a framed log collecting intact "STR1" records (others skipped).
+void scan_transitions(const char* path, std::vector<TransRec>* recs) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return;
+  if (fseek(f, 0, SEEK_END) != 0) { fclose(f); return; }
+  long file_size = ftell(f);
+  if (file_size < 0 || fseek(f, 0, SEEK_SET) != 0) { fclose(f); return; }
+  long offset = 0;
+  uint8_t header[8];
+  std::vector<uint8_t> payload;
+  for (;;) {
+    if (fread(header, 1, 8, f) != 8) break;
+    uint32_t length = get_u32(header);
+    uint32_t crc = get_u32(header + 4);
+    if ((long)length > file_size - offset - 8) break;
+    payload.resize(length);
+    if (length > 0 && fread(payload.data(), 1, length, f) != length) break;
+    if (crc32_of(payload.data(), length) != crc) break;
+    offset += 8 + (long)length;
+    if (length < kTransHeader ||
+        memcmp(payload.data(), kTransMagic, 4) != 0)
+      continue;  // not a transition record (e.g. a JSON event): skip
+    TransRec rec;
+    rec.batch = get_u32(payload.data() + 4);
+    rec.obs_dim = get_u32(payload.data() + 8);
+    rec.env_steps = get_u64(payload.data() + 12);
+    size_t row_bytes = (size_t)rec.obs_dim * 4 * 2 + 8;  // obs+next+act+rew
+    if ((size_t)length != kTransHeader + row_bytes * rec.batch)
+      continue;  // malformed body: skip defensively
+    rec.body.assign(payload.begin() + kTransHeader, payload.end());
+    recs->push_back(std::move(rec));
+  }
+  fclose(f);
+}
+
 }  // namespace
 
 extern "C" {
+
+// Read the transitions journal's TAIL: the most recent records covering at
+// most `max_rows` rows, skipping records newer than `cutoff_env_steps`
+// (0 = no cutoff; records with env_steps == 0 always pass). Returns a
+// malloc'd packed buffer (caller frees with stj_free):
+//
+//   u32 rows | u32 obs_dim | u64 high_water |
+//   f32 obs[rows*obs_dim] | i32 action[rows] | f32 reward[rows] |
+//   f32 next_obs[rows*obs_dim]
+//
+// high_water is the max env_steps over ALL intact transition records —
+// including ones excluded by the cutoff or the row budget — which is the
+// resume-time double-journaling guard. Returns nullptr when the file has no
+// intact transition records.
+void* stj_read_tail_transitions(const char* path, uint64_t max_rows,
+                                uint64_t cutoff_env_steps,
+                                uint64_t* out_len) {
+  if (out_len) *out_len = 0;
+  std::vector<TransRec> recs;
+  scan_transitions(path, &recs);
+  if (recs.empty()) return nullptr;
+
+  uint64_t high_water = 0;
+  for (const TransRec& r : recs)
+    if (r.env_steps > high_water) high_water = r.env_steps;
+
+  // Drop records past the cutoff, then walk back from the tail until the
+  // kept records cover max_rows (mirrors fill_replay_from_journal: only the
+  // tail that can survive in the circular buffer is worth decoding).
+  // kept may legitimately end up empty (cutoff excludes everything): the
+  // high-water mark must still come back — zero rows, not nullptr — or the
+  // resume-time double-journaling guard is lost.
+  std::vector<const TransRec*> kept;
+  uint64_t rows = 0;
+  uint32_t obs_dim = recs.back().obs_dim;
+  for (auto it = recs.rbegin(); it != recs.rend(); ++it) {
+    if (cutoff_env_steps && it->env_steps > cutoff_env_steps) continue;
+    if (it->obs_dim != obs_dim) continue;  // shape drift: skip defensively
+    kept.push_back(&*it);
+    rows += it->batch;
+    if (max_rows && rows >= max_rows) break;
+  }
+
+  size_t head = 4 + 4 + 8;
+  size_t total = head + ((size_t)obs_dim * 4 * 2 + 8) * rows;
+  uint8_t* buf = static_cast<uint8_t*>(malloc(total));
+  if (!buf) return nullptr;
+  put_u32(buf, (uint32_t)rows);
+  put_u32(buf + 4, obs_dim);
+  put_u64(buf + 8, high_water);
+
+  uint8_t* obs_dst = buf + head;
+  uint8_t* act_dst = obs_dst + (size_t)rows * obs_dim * 4;
+  uint8_t* rew_dst = act_dst + (size_t)rows * 4;
+  uint8_t* next_dst = rew_dst + (size_t)rows * 4;
+  // kept[] is newest-first; emit oldest-first so circular "newest wins"
+  // semantics hold when the caller pushes in order.
+  for (auto it = kept.rbegin(); it != kept.rend(); ++it) {
+    const TransRec* r = *it;
+    size_t ob = (size_t)r->batch * r->obs_dim * 4;
+    const uint8_t* src = r->body.data();
+    memcpy(obs_dst, src, ob);              obs_dst += ob;   src += ob;
+    memcpy(act_dst, src, r->batch * 4);    act_dst += r->batch * 4; src += r->batch * 4;
+    memcpy(rew_dst, src, r->batch * 4);    rew_dst += r->batch * 4; src += r->batch * 4;
+    memcpy(next_dst, src, ob);             next_dst += ob;
+  }
+  if (out_len) *out_len = total;
+  return buf;
+}
 
 // Open (create if absent) a journal for appending. Truncates any torn tail so
 // appends continue from a clean record boundary — the same recovery contract

@@ -7,16 +7,18 @@ updates) stabilizes the bootstrap — the standard upgrade over the reference's
 online Q-learning, which bootstraps from the live network
 (QDecisionPolicyActor.scala:67-68).
 
-The journal bridge (``fill_replay_from_journal`` / runtime transition
-journaling) gives the persistence-backed replay capability of the reference's
-event-sourced layer (SURVEY.md §7.4 "Replay/persistence bandwidth").
+The journal bridge gives the persistence-backed replay capability of the
+reference's event-sourced layer (SURVEY.md §7.4 "Replay/persistence
+bandwidth"): the runtime appends packed binary records
+(data/transitions.py) and ``fill_replay_from_arrays`` /
+``fill_replay_from_journal`` rebuild the device buffer on resume (the
+latter reads legacy JSON events).
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 from flax import struct
 
@@ -118,6 +120,12 @@ def make_dqn_agent(model: Model, env: TradingEnv,
         outs, _ = apply_batched(model, params, obs_batch, ())
         return outs.logits
 
+    def q_batch_with_aux(params, obs_batch):
+        """Forward that also surfaces ModelOut.aux (the MoE balance term;
+        0 for dense models) so the TD loss can regularize a routed gate."""
+        outs, _ = apply_batched(model, params, obs_batch, ())
+        return outs.logits, jnp.mean(jnp.asarray(outs.aux))
+
     def one_step(ts: TrainState, _):
         rng, k_act, k_sample = jax.random.split(ts.rng, 3)
         act_keys = jax.random.split(k_act, num_agents)
@@ -139,12 +147,13 @@ def make_dqn_agent(model: Model, env: TradingEnv,
 
         def td_loss(params):
             b_obs, b_act, b_rew, b_next = replay.sample(k_sample, cfg.replay_batch)
-            q_s = q_batch(params, b_obs)
+            q_s, aux = q_batch_with_aux(params, b_obs)
             q_next = jax.lax.stop_gradient(
                 q_batch(ts.extras.target_params, b_next))
             target = b_rew + cfg.gamma * jnp.max(q_next, axis=-1)
             predicted = jnp.take_along_axis(q_s, b_act[:, None], axis=-1)[:, 0]
-            return jnp.mean(jnp.square(predicted - target))
+            return (jnp.mean(jnp.square(predicted - target))
+                    + cfg.aux_loss_coef * aux)
 
         # Learn only once the buffer can fill a batch.
         ready = replay.size >= cfg.replay_batch
@@ -199,26 +208,6 @@ def make_dqn_agent(model: Model, env: TradingEnv,
                  model=model)
 
 
-def journal_transitions(journal, obs, actions, rewards, next_obs,
-                        env_steps: int | None = None) -> None:
-    """Append a batch of transitions to an event journal (host side) — the
-    durable replay trail (reference capability: Akka-persistence journal,
-    SharePriceGetter.scala:37; generalized to experience data here).
-    ``env_steps`` (cumulative count at chunk end) lets a resuming process
-    recover the journaling high-water mark so replayed chunks after a
-    restore are never double-journaled."""
-    event = {
-        "type": "transitions",
-        "obs": np.asarray(obs).tolist(),
-        "action": np.asarray(actions).tolist(),
-        "reward": np.asarray(rewards).tolist(),
-        "next_obs": np.asarray(next_obs).tolist(),
-    }
-    if env_steps is not None:
-        event["env_steps"] = int(env_steps)
-    journal.append(event)
-
-
 def fill_replay_from_journal(replay: ReplayBuffer, journal) -> ReplayBuffer:
     """Replay journaled transitions into the device buffer (offline/warm-start
     path — the event-sourcing recovery pattern applied to experience).
@@ -231,6 +220,24 @@ def fill_replay_from_journal(replay: ReplayBuffer, journal) -> ReplayBuffer:
     semantics hold deterministically."""
     return fill_replay_from_events(
         replay, [e for e in journal.replay() if e.get("type") == "transitions"])
+
+
+def fill_replay_from_arrays(replay: ReplayBuffer, obs, action, reward,
+                            next_obs) -> ReplayBuffer:
+    """Push pre-decoded transition arrays (oldest-first) into the device
+    buffer in capacity-bounded slices — the fast path fed by the packed
+    binary journal reader (data/transitions.py read_tail_transitions)."""
+    capacity = replay.obs.shape[0]
+    obs = jnp.asarray(obs, jnp.float32)
+    action = jnp.asarray(action, jnp.int32)
+    reward = jnp.asarray(reward, jnp.float32)
+    next_obs = jnp.asarray(next_obs, jnp.float32)
+    for lo in range(0, obs.shape[0], capacity):
+        sl = slice(lo, lo + capacity)
+        valid = jnp.ones((obs[sl].shape[0],), bool)
+        replay = replay.push(obs[sl], action[sl], reward[sl],
+                             next_obs[sl], valid)
+    return replay
 
 
 def fill_replay_from_events(replay: ReplayBuffer,

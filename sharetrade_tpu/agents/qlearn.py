@@ -58,7 +58,10 @@ def make_qlearn_agent(model: Model, env: TradingEnv,
 
     def apply_batch(params, obs_batch, carry_batch):
         outs, carries = apply_batched(model, params, obs_batch, carry_batch)
-        return outs.logits, carries
+        # aux = the model's auxiliary regularizer (MoE balance term; 0 for
+        # dense models) — the loss adds it so a routed-FFN Q-network can't
+        # train with an unregularized, collapse-prone gate.
+        return outs.logits, jnp.mean(jnp.asarray(outs.aux)), carries
 
     def one_step(ts: TrainState, _):
         rng, k_act = jax.random.split(ts.rng)
@@ -68,7 +71,7 @@ def make_qlearn_agent(model: Model, env: TradingEnv,
         active = ts.env_state.t < horizon  # (B,) bool
 
         obs = jax.vmap(env.observe)(ts.env_state)
-        q_sel, carry_new = apply_batch(ts.params, obs, ts.carry)
+        q_sel, _aux_sel, carry_new = apply_batch(ts.params, obs, ts.carry)
         actions = jax.vmap(lambda k, q: epsilon_greedy(k, q, ts.env_steps, cfg))(
             act_keys, q_sel)
 
@@ -84,7 +87,7 @@ def make_qlearn_agent(model: Model, env: TradingEnv,
             # One stacked forward for Q(s) and Q(s'): tiny matmuls are
             # launch-overhead-bound on TPU, so halving the op count beats
             # two back-to-back (B, obs) contractions.
-            q_both, _ = apply_batch(
+            q_both, aux, _ = apply_batch(
                 params, jnp.concatenate([obs, next_obs], axis=0),
                 jax.tree.map(lambda a, b: jnp.concatenate([a, b], axis=0),
                              ts.carry, carry_new))
@@ -98,7 +101,8 @@ def make_qlearn_agent(model: Model, env: TradingEnv,
             )
             predicted = jnp.take_along_axis(q_s, idx[:, None], axis=-1)[:, 0]
             per_agent = jnp.square(predicted - target) * active
-            return jnp.sum(per_agent) / jnp.maximum(jnp.sum(active), 1)
+            td = jnp.sum(per_agent) / jnp.maximum(jnp.sum(active), 1)
+            return td + cfg.aux_loss_coef * aux
 
         loss, grads = jax.value_and_grad(td_loss)(ts.params)
         any_active = jnp.any(active)

@@ -34,18 +34,45 @@ log = get_logger("data.journal")
 _HEADER = struct.Struct("<II")  # length, crc32
 
 
-def write_framed(path: str, events: list[dict[str, Any]]) -> None:
-    """Write ``events`` as a complete framed log at ``path`` (fsynced).
+def write_framed_bytes(path: str, payloads: list[bytes]) -> None:
+    """Write raw payloads as a complete framed log at ``path`` (fsynced).
 
     The single definition of the on-disk format for full-file writes — both
     backends' compaction goes through here so the framing can never diverge
     between the Python and C++ implementations."""
     with open(path, "wb") as f:
-        for event in events:
-            payload = json.dumps(event, separators=(",", ":")).encode()
+        for payload in payloads:
             f.write(_HEADER.pack(len(payload), zlib.crc32(payload)) + payload)
         f.flush()
         os.fsync(f.fileno())
+
+
+def write_framed(path: str, events: list[dict[str, Any]]) -> None:
+    """JSON-event form of :func:`write_framed_bytes`."""
+    write_framed_bytes(
+        path,
+        [json.dumps(e, separators=(",", ":")).encode() for e in events])
+
+
+def iter_framed_records(path: str) -> Iterator[tuple[int, bytes]]:
+    """Yield ``(end_offset, payload)`` for each intact record, stopping at
+    the first torn/corrupt one — the single read-side definition of the
+    framing (mirrors ``write_framed_bytes`` on the write side; the C++
+    backend's ``scan_file`` implements the same walk)."""
+    if not os.path.exists(path):
+        return
+    offset = 0
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(_HEADER.size)
+            if len(header) < _HEADER.size:
+                break
+            length, crc = _HEADER.unpack(header)
+            payload = f.read(length)
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                break
+            offset += _HEADER.size + length
+            yield offset, payload
 
 
 class Journal:
@@ -71,7 +98,11 @@ class Journal:
     # ---- write path ----
 
     def append(self, event: dict[str, Any]) -> None:
-        payload = json.dumps(event, separators=(",", ":")).encode()
+        self.append_bytes(json.dumps(event, separators=(",", ":")).encode())
+
+    def append_bytes(self, payload: bytes) -> None:
+        """Append a raw (possibly binary) payload — the packed-transition
+        codec (data/transitions.py) frames through here."""
         record = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
         with self._lock:
             self._fh.write(record)
@@ -83,40 +114,31 @@ class Journal:
 
     def replay(self) -> Iterator[dict[str, Any]]:
         """Yield all intact events from the start of the log."""
-        if not os.path.exists(self.path):
-            return
         with self._lock:
             self._fh.flush()
-        with open(self.path, "rb") as f:
-            while True:
-                header = f.read(_HEADER.size)
-                if len(header) < _HEADER.size:
-                    break
-                length, crc = _HEADER.unpack(header)
-                payload = f.read(length)
-                if len(payload) < length or zlib.crc32(payload) != crc:
-                    log.warning("journal %s: stopping replay at corrupt record", self.path)
-                    break
-                yield json.loads(payload)
+        for _offset, payload in iter_framed_records(self.path):
+            if payload[:4] == b"STR1":
+                # Packed binary transition record (data/transitions.py):
+                # not a JSON event — decoded by read_tail_transitions.
+                continue
+            yield json.loads(payload)
 
     def _scan_valid_prefix(self) -> int | None:
         """Byte offset of the last intact record boundary, or None if the file
-        doesn't exist / is fully intact."""
+        doesn't exist / is fully intact (nothing to truncate). A trailing
+        partial header counts as torn — appending after one would bury every
+        later record behind an unreadable frame (the C++ ``stj_open`` already
+        truncates that case)."""
         if not os.path.exists(self.path):
             return None
-        offset = 0
-        with open(self.path, "rb") as f:
-            while True:
-                header = f.read(_HEADER.size)
-                if len(header) < _HEADER.size:
-                    break
-                length, crc = _HEADER.unpack(header)
-                payload = f.read(length)
-                if len(payload) < length or zlib.crc32(payload) != crc:
-                    log.warning("journal %s: torn tail at offset %d, truncating", self.path, offset)
-                    return offset
-                offset += _HEADER.size + length
-        return None
+        end = 0
+        for end, _payload in iter_framed_records(self.path):
+            pass
+        if end == os.path.getsize(self.path):
+            return None
+        log.warning("journal %s: torn tail at offset %d, truncating",
+                    self.path, end)
+        return end
 
     # ---- compaction ----
 
@@ -130,13 +152,19 @@ class Journal:
         rename, same protocol as checkpoints). The lock is held for the
         whole rewrite so a concurrent ``append`` lands after the swap rather
         than vanishing into the replaced file."""
+        self.compact_payloads(
+            [json.dumps(e, separators=(",", ":")).encode() for e in events])
+
+    def compact_payloads(self, payloads: list[bytes]) -> None:
+        """Raw-payload form of :meth:`compact` (same atomic protocol) — the
+        transitions journal compacts binary records through here."""
         tmp_path = f"{self.path}.compact-{os.getpid()}"
         with self._lock:
-            write_framed(tmp_path, events)
+            write_framed_bytes(tmp_path, payloads)
             self._fh.close()
             os.replace(tmp_path, self.path)
             self._fh = open(self.path, "ab")
-        log.info("journal %s compacted to %d events", self.path, len(events))
+        log.info("journal %s compacted to %d records", self.path, len(payloads))
 
     def __len__(self) -> int:
         return sum(1 for _ in self.replay())

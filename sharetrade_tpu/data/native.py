@@ -42,6 +42,13 @@ def _load() -> ctypes.CDLL | None:
             lib.stj_read_all.restype = ctypes.c_void_p
             lib.stj_read_all.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)]
             lib.stj_free.argtypes = [ctypes.c_void_p]
+            if hasattr(lib, "stj_read_tail_transitions"):
+                # Packed-transition tail reader (older .so builds lack it;
+                # data/transitions.py falls back to the numpy path then).
+                lib.stj_read_tail_transitions.restype = ctypes.c_void_p
+                lib.stj_read_tail_transitions.argtypes = [
+                    ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+                    ctypes.POINTER(ctypes.c_uint64)]
             _lib = lib
             return lib
     return None
@@ -68,7 +75,11 @@ class NativeJournal:
             raise OSError(f"stj_open failed for {path}")
 
     def append(self, event: dict[str, Any]) -> None:
-        payload = json.dumps(event, separators=(",", ":")).encode()
+        self.append_bytes(json.dumps(event, separators=(",", ":")).encode())
+
+    def append_bytes(self, payload: bytes) -> None:
+        """Append a raw (possibly binary) payload — the packed-transition
+        codec (data/transitions.py) frames through here."""
         with self._lock:
             rc = self._lib.stj_append(self._handle, payload, len(payload))
         if rc != 0:
@@ -83,20 +94,38 @@ class NativeJournal:
             raw = ctypes.string_at(buf, n.value)
         finally:
             self._lib.stj_free(buf)
-        # stj_read_all returns newline-delimited JSON payloads of intact records
+        # stj_read_all returns newline-delimited JSON payloads of intact
+        # records. Packed binary transition records (data/transitions.py) may
+        # share the log; their bytes split on any 0x0A they contain, so a
+        # "line" can be a record fragment — and a fragment like b"7" or
+        # b"null" parses as valid JSON. Journal events are always dicts, so
+        # only dicts pass (read_tail_transitions decodes the binary records).
         for line in raw.splitlines():
-            if line:
-                yield json.loads(line)
+            if not line or line[:4] == b"STR1":
+                continue
+            try:
+                event = json.loads(line)
+            except (ValueError, UnicodeDecodeError):
+                continue  # fragment of a binary record split on \n bytes
+            if isinstance(event, dict):
+                yield event
 
     def compact(self, event_list: list[dict[str, Any]]) -> None:
         """Atomic rewrite with a collapsed event set (see Journal.compact;
-        same lock-held protocol). Framing goes through the shared
-        ``write_framed`` helper (compaction is rare; appends stay on the C++
-        fast path), then the handle reopens preserving the fsync mode."""
-        from sharetrade_tpu.data.journal import write_framed
+        same lock-held protocol)."""
+        self.compact_payloads([
+            json.dumps(e, separators=(",", ":")).encode()
+            for e in event_list])
+
+    def compact_payloads(self, payloads: list[bytes]) -> None:
+        """Raw-payload form of :meth:`compact`. Framing goes through the
+        shared ``write_framed_bytes`` helper (compaction is rare; appends
+        stay on the C++ fast path), then the handle reopens preserving the
+        fsync mode."""
+        from sharetrade_tpu.data.journal import write_framed_bytes
         tmp_path = f"{self.path}.compact-{os.getpid()}"
         with self._lock:
-            write_framed(tmp_path, event_list)
+            write_framed_bytes(tmp_path, payloads)
             if self._handle:
                 self._lib.stj_close(self._handle)
             os.replace(tmp_path, self.path)

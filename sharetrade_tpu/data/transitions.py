@@ -1,0 +1,196 @@
+"""Packed binary transition records for the replay journal.
+
+The runtime's journal-backed DQN replay (``learner.journal_replay``)
+originally wrote each chunk's transitions as JSON — ~20 bytes of text per
+float and a Python parse per value on recovery. This codec stores them as
+packed little-endian arrays inside the same CRC-framed journal records
+(data/journal.py framing), cutting record size ~5x and making recovery a
+single buffer copy instead of a JSON walk — the "replay/persistence
+bandwidth" concern SURVEY.md §7.4 assigns to the native layer (the
+reference's journal is native LevelDB, build.sbt:18-19).
+
+Payload layout (shared byte-for-byte with ``native/journal.cc``):
+
+    "STR1" | u32 batch | u32 obs_dim | u64 env_steps |
+    f32 obs[batch*obs_dim] | i32 action[batch] | f32 reward[batch] |
+    f32 next_obs[batch*obs_dim]
+
+Reading the recovery tail goes through ``stj_read_tail_transitions`` (C++:
+one pass over the framed log, filter, pack) when the native library is
+built, with a numpy fallback of identical semantics.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from sharetrade_tpu.data.journal import iter_framed_records
+
+MAGIC = b"STR1"
+_HEAD = struct.Struct("<4sIIQ")           # magic, batch, obs_dim, env_steps
+
+
+def encode_transitions(obs, action, reward, next_obs,
+                       env_steps: int = 0) -> bytes:
+    """Pack one batch of transitions into a journal payload."""
+    obs = np.ascontiguousarray(obs, np.float32)
+    next_obs = np.ascontiguousarray(next_obs, np.float32)
+    action = np.ascontiguousarray(action, np.int32)
+    reward = np.ascontiguousarray(reward, np.float32)
+    batch, obs_dim = obs.shape
+    if next_obs.shape != (batch, obs_dim) or action.shape != (batch,) \
+            or reward.shape != (batch,):
+        raise ValueError(
+            f"inconsistent transition shapes: obs {obs.shape}, "
+            f"next_obs {next_obs.shape}, action {action.shape}, "
+            f"reward {reward.shape}")
+    return b"".join([
+        _HEAD.pack(MAGIC, batch, obs_dim, env_steps),
+        obs.tobytes(), action.tobytes(), reward.tobytes(),
+        next_obs.tobytes(),
+    ])
+
+
+def decode_transitions(payload: bytes):
+    """Inverse of :func:`encode_transitions`.
+
+    Returns ``(obs, action, reward, next_obs, env_steps)`` or ``None`` when
+    the payload is not a (well-formed) transition record."""
+    if len(payload) < _HEAD.size or payload[:4] != MAGIC:
+        return None
+    magic, batch, obs_dim, env_steps = _HEAD.unpack_from(payload)
+    row_bytes = obs_dim * 8 + 8
+    if len(payload) != _HEAD.size + row_bytes * batch:
+        return None
+    ob = batch * obs_dim * 4
+    o = _HEAD.size
+    obs = np.frombuffer(payload, np.float32, batch * obs_dim, o).reshape(
+        batch, obs_dim)
+    action = np.frombuffer(payload, np.int32, batch, o + ob)
+    reward = np.frombuffer(payload, np.float32, batch, o + ob + batch * 4)
+    next_obs = np.frombuffer(payload, np.float32, batch * obs_dim,
+                             o + ob + batch * 8).reshape(batch, obs_dim)
+    return obs, action, reward, next_obs, env_steps
+
+
+def append_transitions(journal, obs, action, reward, next_obs,
+                       env_steps: int = 0) -> None:
+    """Append one packed transition record through either journal backend."""
+    journal.append_bytes(
+        encode_transitions(obs, action, reward, next_obs, env_steps))
+
+
+def read_tail_transitions(path: str, max_rows: int, *,
+                          cutoff_env_steps: int = 0):
+    """Read the journal's recovery tail: the most recent records covering at
+    most ``max_rows`` rows, skipping records with env_steps beyond
+    ``cutoff_env_steps`` (0 = no cutoff), oldest-first so circular-buffer
+    "newest wins" pushes are deterministic.
+
+    Returns ``(obs, action, reward, next_obs, high_water)`` — high_water is
+    the max env_steps over ALL intact transition records (the resume-time
+    double-journaling guard) — or ``None`` when no transition records exist.
+    When the cutoff excludes every record the arrays come back with zero
+    rows but high_water is still recovered (losing it would re-journal the
+    excluded chunks with duplicate stamps and double-fill the next recovery).
+    """
+    native = _native_read_tail(path, max_rows, cutoff_env_steps)
+    if native is not NotImplemented:
+        return native
+    return _python_read_tail(path, max_rows, cutoff_env_steps)
+
+
+def _native_read_tail(path, max_rows, cutoff):
+    import ctypes
+
+    from sharetrade_tpu.data.native import _load
+    lib = _load()
+    if lib is None or not hasattr(lib, "stj_read_tail_transitions"):
+        return NotImplemented
+    n = ctypes.c_uint64(0)
+    buf = lib.stj_read_tail_transitions(
+        path.encode(), max_rows, cutoff, ctypes.byref(n))
+    if not buf:
+        return None
+    try:
+        raw = ctypes.string_at(buf, n.value)
+    finally:
+        lib.stj_free(buf)
+    rows, obs_dim = struct.unpack_from("<II", raw)
+    (high_water,) = struct.unpack_from("<Q", raw, 8)
+    o = 16
+    ob = rows * obs_dim * 4
+    obs = np.frombuffer(raw, np.float32, rows * obs_dim, o).reshape(
+        rows, obs_dim)
+    action = np.frombuffer(raw, np.int32, rows, o + ob)
+    reward = np.frombuffer(raw, np.float32, rows, o + ob + rows * 4)
+    next_obs = np.frombuffer(raw, np.float32, rows * obs_dim,
+                             o + ob + rows * 8).reshape(rows, obs_dim)
+    return obs, action, reward, next_obs, high_water
+
+
+def _python_read_tail(path, max_rows, cutoff):
+    """Same semantics as the C++ reader, pure numpy."""
+    recs = []
+    high_water = 0
+    for _offset, payload in iter_framed_records(path):
+        decoded = decode_transitions(payload)
+        if decoded is None:
+            continue
+        high_water = max(high_water, decoded[4])
+        recs.append(decoded)
+    if not recs:
+        return None
+    kept, rows, obs_dim = [], 0, recs[-1][0].shape[1]
+    for rec in reversed(recs):
+        if cutoff and rec[4] > cutoff:
+            continue
+        if rec[0].shape[1] != obs_dim:
+            continue
+        kept.append(rec)
+        rows += rec[0].shape[0]
+        if max_rows and rows >= max_rows:
+            break
+    if not kept:
+        # Every record excluded by the cutoff: the high-water mark (the
+        # double-journaling guard) must still come back — zero rows, not None.
+        return (np.zeros((0, obs_dim), np.float32),
+                np.zeros((0,), np.int32), np.zeros((0,), np.float32),
+                np.zeros((0, obs_dim), np.float32), high_water)
+    kept.reverse()                        # oldest-first
+    obs = np.concatenate([r[0] for r in kept])
+    action = np.concatenate([r[1] for r in kept])
+    reward = np.concatenate([r[2] for r in kept])
+    next_obs = np.concatenate([r[3] for r in kept])
+    return obs, action, reward, next_obs, high_water
+
+
+def compact_transitions(journal, keep_rows: int) -> bool:
+    """Drop journal records older than the tail covering ``keep_rows``
+    transition rows (the replay buffer can't hold more anyway — the same
+    bound read_tail_transitions applies on recovery).
+
+    Record boundaries and per-record env_steps stamps are preserved
+    verbatim, so the resume-time cutoff filtering stays exact after a
+    compaction; non-transition payloads inside the kept tail are kept too.
+    Returns True when anything was dropped. (The reference delegates this to
+    LevelDB's per-actor compaction intervals, application.conf:7-14.)
+    """
+    payloads = [p for _off, p in iter_framed_records(journal.path)]
+    rows = 0
+    boundary = len(payloads)
+    for i in range(len(payloads) - 1, -1, -1):
+        decoded = decode_transitions(payloads[i])
+        boundary = i
+        if decoded is not None:
+            rows += decoded[0].shape[0]
+            if rows >= keep_rows:
+                break
+    if boundary == 0:
+        return False
+    journal.compact_payloads(payloads[boundary:])
+    return True
+
+

@@ -50,7 +50,12 @@ def param_shardings(params: Any, mesh: Mesh, rules: dict[str, P] | None = None):
 
 def mlp_tp_rules(model_axis: str = "tp") -> dict[str, P]:
     """Column-parallel first layer, row-parallel second — one all-reduce at
-    the output, the classic Megatron split mapped onto ICI."""
+    the output, the classic Megatron split mapped onto ICI.
+
+    The suffix set covers both MLP families (layer/torso heads) and the
+    transformer block projections (qkv column, proj row, mlp_in column,
+    mlp_out row), so one rule table serves every model kind; unmatched
+    leaves (embeddings, layernorms, heads) replicate."""
     return {
         "layer1/w": P(None, model_axis),
         "layer2/w": P(model_axis, None),

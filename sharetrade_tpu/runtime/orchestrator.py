@@ -105,6 +105,7 @@ class Orchestrator:
         self.last_error: BaseException | None = None
         self._transitions_journal = None
         self._journal_high_water = 0  # env_steps already journaled
+        self._journal_rows_since_compact = 0
         if cfg.learner.algo == "dqn" and cfg.learner.journal_replay:
             import os
             from sharetrade_tpu.data.service import _open_journal
@@ -188,8 +189,18 @@ class Orchestrator:
             self._place = lambda ts: ts
             self._step_fn = self._step_override
         elif self.mesh is not None:
+            # A tp axis in the mesh shards parameters via the Megatron
+            # suffix rules (column/row splits for the MLP and transformer
+            # block projections); without rules a tp axis would silently
+            # replicate params, making the public surface's tensor
+            # parallelism a no-op.
+            from sharetrade_tpu.parallel import mlp_tp_rules
+            model_axis = self.cfg.parallel.model_axis
+            rules = (mlp_tp_rules(model_axis)
+                     if model_axis in self.mesh.axis_names else None)
             self._place, self._step_fn = make_parallel_step(
-                self.agent, self.mesh, data_axis=self.cfg.parallel.data_axis)
+                self.agent, self.mesh, data_axis=self.cfg.parallel.data_axis,
+                param_rules=rules)
         else:
             self._place = lambda ts: ts
             self._step_fn = jax.jit(self.agent.step)
@@ -382,16 +393,29 @@ class Orchestrator:
         if env_steps <= self._journal_high_water:
             return
         self._journal_high_water = env_steps
-        from sharetrade_tpu.agents.dqn import journal_transitions
+        from sharetrade_tpu.data.transitions import append_transitions
         valid = np.asarray(transitions["valid"]).reshape(-1)
         if not valid.any():
             return
         flat = {k: np.asarray(v).reshape((-1,) + np.asarray(v).shape[2:])
                 for k, v in transitions.items() if k != "valid"}
-        journal_transitions(
+        # Packed binary records (data/transitions.py): ~5x smaller than the
+        # JSON encoding and decoded on recovery by one C++/numpy pass.
+        append_transitions(
             self._transitions_journal, flat["obs"][valid],
             flat["action"][valid], flat["reward"][valid],
             flat["next_obs"][valid], env_steps=env_steps)
+        # Bound the journal: once a buffer's worth of NEW rows accumulated,
+        # drop records older than the recoverable tail (2x capacity keeps a
+        # full buffer recoverable at any resume cutoff inside the last
+        # capacity rows). Record boundaries/stamps survive compaction, so
+        # cutoff filtering stays exact.
+        capacity = self.cfg.learner.replay_capacity
+        self._journal_rows_since_compact += int(valid.sum())
+        if self._journal_rows_since_compact >= capacity:
+            from sharetrade_tpu.data.transitions import compact_transitions
+            compact_transitions(self._transitions_journal, 2 * capacity)
+            self._journal_rows_since_compact = 0
 
     def _warm_start_replay(self, state: TrainState) -> TrainState:
         """Rebuild the DQN replay buffer from the transitions journal. The
@@ -403,23 +427,35 @@ class Orchestrator:
         if self._transitions_journal is None:
             return state
         from sharetrade_tpu.agents.dqn import (
-            ReplayBuffer, fill_replay_from_events)
+            ReplayBuffer, fill_replay_from_arrays, fill_replay_from_events)
+        from sharetrade_tpu.data.transitions import read_tail_transitions
+        capacity = self.cfg.learner.replay_capacity
+        cutoff = int(state.env_steps)
+        # Legacy JSON "transitions" events (older logs); binary records in
+        # the same file are skipped by replay() and decoded below.
         events = [e for e in self._transitions_journal.replay()
                   if e.get("type") == "transitions"]
+        # Packed binary tail (the fast path): one C++/numpy pass returns the
+        # capacity-bounded arrays plus the journal's env-step high water.
+        # Fill only up to the restored state's env-step count: the chunks
+        # between checkpoint and crash re-run with restored RNG and push
+        # identical transitions themselves — filling them here too would
+        # double-count them in the live buffer. cutoff=0 (fresh init) keeps
+        # nothing but still recovers the high-water mark.
+        tail = read_tail_transitions(self._transitions_journal.path,
+                                     capacity if cutoff > 0 else 1,
+                                     cutoff_env_steps=cutoff)
         # Recover the journaling high-water mark so chunks replayed between
         # the restored checkpoint and the crash point aren't re-journaled.
         self._journal_high_water = max(
             [self._journal_high_water]
-            + [e.get("env_steps", 0) for e in events])
-        fresh = ReplayBuffer.create(self.cfg.learner.replay_capacity,
-                                    self.env.obs_dim)
-        # Fill only up to the restored state's env-step count: the chunks
-        # between checkpoint and crash re-run with restored RNG and push
-        # identical transitions themselves — filling them here too would
-        # double-count them in the live buffer.
-        cutoff = int(state.env_steps)
+            + [e.get("env_steps", 0) for e in events]
+            + ([tail[4]] if tail is not None else []))
+        fresh = ReplayBuffer.create(capacity, self.env.obs_dim)
         warm = fill_replay_from_events(
             fresh, [e for e in events if e.get("env_steps", 0) <= cutoff])
+        if tail is not None and cutoff > 0:
+            warm = fill_replay_from_arrays(warm, *tail[:4])
         if int(warm.size) == 0:
             return state            # nothing journaled yet: keep as restored
         log.info("warm-started replay buffer with %d journaled transitions",

@@ -70,11 +70,18 @@ def forward_flops_per_obs(model: ModelConfig, obs_dim: int,
     if model.kind == "transformer":
         seq = obs_dim - 1                               # window + summary token
         d = model.num_heads * model.head_dim
+        ffn = 16.0 * seq * d * d                        # MLP in/out at ratio 4
+        if model.moe_experts:
+            # Dense-mask MoE evaluates every expert on every token (E x the
+            # dense FFN); top-k capacity dispatch evaluates ~k experts per
+            # token (drops make this a slight overcount; the dispatch/combine
+            # one-hot matmuls are routing overhead, not model FLOPs).
+            ffn *= (model.moe_top_k if model.moe_top_k else model.moe_experts)
         per_layer = (
             6.0 * seq * d * d        # qkv projection
             + 2.0 * seq * seq * d    # causal QK^T + PV (useful half of 4*s^2*d)
             + 2.0 * seq * d * d      # output projection
-            + 16.0 * seq * d * d     # MLP in/out at ratio 4
+            + ffn
         )
         return model.num_layers * per_layer + 2.0 * seq * 3 * d  # + embed
     raise ValueError(f"unknown model kind {model.kind!r}")

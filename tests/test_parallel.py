@@ -399,3 +399,22 @@ class TestPartitionedTrainingEndToEnd:
         cfg.model.moe_top_k = 2
         cfg.model.num_layers = 2
         self._run(cfg, cpu_devices)
+
+    @pytest.mark.parametrize("kind", ["mlp", "transformer"])
+    def test_tp_axis_actually_shards_params_via_config(self, tmp_path,
+                                                       cpu_devices, kind):
+        """A tp axis in parallel.mesh_shape must shard the Megatron-split
+        weights through the public Orchestrator surface, not silently
+        replicate them."""
+        cfg = self._cfg(tmp_path, {"dp": 2, "tp": 4})
+        cfg.model.kind = kind
+        cfg.model.num_layers = 2
+        orch = self._run(cfg, cpu_devices)
+        params = orch.train_state.params
+        if kind == "transformer":
+            w = params["blocks"][0]["qkv"]["w"]       # column-parallel
+        else:
+            w = params["torso1"]["w"]                 # column-parallel
+        spec = w.sharding.spec
+        assert "tp" in jax.tree.leaves(tuple(spec)), spec
+        orch.stop()

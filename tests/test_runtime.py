@@ -284,12 +284,7 @@ class TestJournalBackedReplay:
         orch.send_training_data(PRICES)     # fresh run on the same orch
         orch.start_training(background=False)
         assert orch.is_everything_done().state is ReplyState.COMPLETED
-        from sharetrade_tpu.data.journal import Journal
-        journaled = sum(
-            len(e["action"])
-            for e in Journal(f"{cfg.data.journal_dir}/transitions.journal").replay()
-            if e.get("type") == "transitions")
-        assert journaled == horizon * cfg.parallel.num_workers
+        assert _journaled_rows(cfg) == horizon * cfg.parallel.num_workers
         orch.stop()
 
     def test_heal_after_fault_with_journaled_buffer(self, tmp_path):
@@ -312,13 +307,21 @@ class TestJournalBackedReplay:
         horizon = len(PRICES) - WINDOW
         assert (int(orch.train_state.extras.replay.size)
                 == horizon * cfg.parallel.num_workers)
-        from sharetrade_tpu.data.journal import Journal
-        journaled = sum(
-            len(e["action"])
-            for e in Journal(f"{cfg.data.journal_dir}/transitions.journal").replay()
-            if e.get("type") == "transitions")
-        assert journaled == horizon * cfg.parallel.num_workers
+        assert _journaled_rows(cfg) == horizon * cfg.parallel.num_workers
         orch.stop()
+
+
+def _journaled_rows(cfg) -> int:
+    """Total transition rows in the journal: packed binary records (the
+    runtime's format, data/transitions.py) plus any legacy JSON events."""
+    from sharetrade_tpu.data.journal import Journal
+    from sharetrade_tpu.data.transitions import read_tail_transitions
+    path = f"{cfg.data.journal_dir}/transitions.journal"
+    tail = read_tail_transitions(path, 0)      # 0 = unbounded
+    rows = 0 if tail is None else tail[0].shape[0]
+    rows += sum(len(e["action"]) for e in Journal(path).replay()
+                if e.get("type") == "transitions")
+    return rows
 
 
 @pytest.mark.slow

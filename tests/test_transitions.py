@@ -1,0 +1,193 @@
+"""Packed binary transition codec + tail reader (data/transitions.py and the
+C++ ``stj_read_tail_transitions`` — same semantics, byte-shared format)."""
+
+import numpy as np
+import pytest
+
+from sharetrade_tpu.data.journal import Journal
+from sharetrade_tpu.data.native import native_available
+from sharetrade_tpu.data.transitions import (
+    _python_read_tail,
+    append_transitions,
+    compact_transitions,
+    decode_transitions,
+    encode_transitions,
+    read_tail_transitions,
+)
+
+
+def _batch(n, obs_dim=5, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((n, obs_dim)).astype(np.float32),
+            rng.integers(0, 3, n).astype(np.int32),
+            rng.standard_normal(n).astype(np.float32),
+            rng.standard_normal((n, obs_dim)).astype(np.float32))
+
+
+@pytest.fixture
+def jpath(tmp_path):
+    return str(tmp_path / "transitions.journal")
+
+
+class TestCodec:
+    def test_roundtrip(self):
+        obs, act, rew, nxt = _batch(7)
+        payload = encode_transitions(obs, act, rew, nxt, env_steps=42)
+        out = decode_transitions(payload)
+        assert out is not None
+        np.testing.assert_array_equal(out[0], obs)
+        np.testing.assert_array_equal(out[1], act)
+        np.testing.assert_array_equal(out[2], rew)
+        np.testing.assert_array_equal(out[3], nxt)
+        assert out[4] == 42
+
+    def test_rejects_non_transition_payloads(self):
+        assert decode_transitions(b"") is None
+        assert decode_transitions(b'{"type":"transitions"}') is None
+        # Truncated body: magic ok, sizes wrong.
+        payload = encode_transitions(*_batch(4), env_steps=1)
+        assert decode_transitions(payload[:-3]) is None
+
+    def test_rejects_inconsistent_shapes(self):
+        obs, act, rew, nxt = _batch(4)
+        with pytest.raises(ValueError, match="inconsistent"):
+            encode_transitions(obs, act[:2], rew, nxt)
+
+
+class TestTailReader:
+    def _write(self, jpath, batches, env_steps):
+        with Journal(jpath) as j:
+            for b, es in zip(batches, env_steps):
+                append_transitions(j, *b, env_steps=es)
+
+    def test_reads_back_in_order(self, jpath):
+        batches = [_batch(3, seed=s) for s in range(3)]
+        self._write(jpath, batches, [10, 20, 30])
+        tail = read_tail_transitions(jpath, 0)
+        assert tail is not None
+        obs, act, rew, nxt, high = tail
+        assert high == 30
+        np.testing.assert_array_equal(
+            obs, np.concatenate([b[0] for b in batches]))
+        np.testing.assert_array_equal(
+            nxt, np.concatenate([b[3] for b in batches]))
+
+    def test_tail_bounded_by_max_rows(self, jpath):
+        batches = [_batch(4, seed=s) for s in range(5)]
+        self._write(jpath, batches, [1, 2, 3, 4, 5])
+        obs, act, rew, nxt, high = read_tail_transitions(jpath, 6)
+        # Walking back: records 5 and 4 cover >= 6 rows; older ones dropped.
+        assert obs.shape[0] == 8
+        np.testing.assert_array_equal(
+            obs, np.concatenate([batches[3][0], batches[4][0]]))
+        assert high == 5
+
+    def test_cutoff_excludes_newer_but_keeps_high_water(self, jpath):
+        batches = [_batch(2, seed=s) for s in range(4)]
+        self._write(jpath, batches, [5, 10, 15, 20])
+        obs, act, rew, nxt, high = read_tail_transitions(
+            jpath, 0, cutoff_env_steps=12)
+        assert obs.shape[0] == 4          # env_steps 5 and 10 only
+        assert high == 20                 # high water sees everything
+        np.testing.assert_array_equal(
+            obs, np.concatenate([batches[0][0], batches[1][0]]))
+
+    def test_cutoff_excluding_everything_still_returns_high_water(self, jpath):
+        """Zero keepable rows must NOT collapse to None: losing high_water
+        would re-journal the excluded chunks with duplicate stamps (the
+        double-journaling guard, e.g. after compaction dropped old records)."""
+        self._write(jpath, [_batch(2, seed=s) for s in range(2)], [50, 60])
+        tail = read_tail_transitions(jpath, 0, cutoff_env_steps=10)
+        assert tail is not None
+        obs, act, rew, nxt, high = tail
+        assert obs.shape[0] == 0 and act.shape == (0,)
+        assert high == 60
+        fb = _python_read_tail(jpath, 0, 10)
+        assert fb[0].shape[0] == 0 and fb[4] == 60
+
+    def test_mixed_json_and_binary_log(self, jpath):
+        """JSON events and packed records share a journal: replay() yields
+        only the JSON events, the tail reader only the packed records."""
+        with Journal(jpath) as j:
+            j.append({"type": "fetch", "symbol": "MSFT"})
+            # Reward bytes crafted to contain "\n7\n": the native replay
+            # newline-splits raw payloads, and the fragment b"7" parses as
+            # valid (non-dict) JSON — replay must yield dict events only.
+            obs, act, _rew, nxt = _batch(1, obs_dim=2)
+            rew = np.frombuffer(b"\n7\n\x00", np.float32)
+            append_transitions(j, obs, act, rew, nxt, env_steps=7)
+            j.append({"type": "fetch", "symbol": "GOOG"})
+        events = list(Journal(jpath).replay())
+        assert [e["symbol"] for e in events] == ["MSFT", "GOOG"]
+        tail = read_tail_transitions(jpath, 0)
+        assert tail[0].shape[0] == 1 and tail[4] == 7
+        np.testing.assert_array_equal(tail[2], rew)
+        if native_available():
+            from sharetrade_tpu.data.native import NativeJournal
+            assert [e["symbol"] for e in NativeJournal(jpath).replay()] == [
+                "MSFT", "GOOG"]
+
+    def test_torn_tail_stops_cleanly(self, jpath):
+        batches = [_batch(2, seed=s) for s in range(2)]
+        self._write(jpath, batches, [1, 2])
+        with open(jpath, "r+b") as f:
+            f.seek(0, 2)
+            f.truncate(f.tell() - 5)       # rip the last record's tail
+        obs, act, rew, nxt, high = read_tail_transitions(jpath, 0)
+        assert obs.shape[0] == 2           # only the intact first record
+        assert high == 1
+
+    def test_missing_file(self, tmp_path):
+        assert read_tail_transitions(str(tmp_path / "nope"), 0) is None
+
+    @pytest.mark.skipif(not native_available(),
+                        reason="native journal not built")
+    def test_native_matches_python_fallback(self, jpath):
+        batches = [_batch(3, seed=s) for s in range(4)]
+        self._write(jpath, batches, [3, 6, 9, 12])
+        for max_rows, cutoff in [(0, 0), (5, 0), (0, 7), (4, 10)]:
+            native = read_tail_transitions(jpath, max_rows,
+                                           cutoff_env_steps=cutoff)
+            fallback = _python_read_tail(jpath, max_rows, cutoff)
+            assert (native is None) == (fallback is None)
+            if native is None:
+                continue
+            for a, b in zip(native, fallback):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_compaction_keeps_tail_and_stamps(self, jpath):
+        """Compaction drops only records older than the keep_rows tail and
+        preserves record boundaries, so cutoff filtering still works."""
+        batches = [_batch(4, seed=s) for s in range(6)]
+        with Journal(jpath) as j:
+            for b, es in zip(batches, [1, 2, 3, 4, 5, 6]):
+                append_transitions(j, *b, env_steps=es)
+            import os
+            size_before = os.path.getsize(jpath)
+            assert compact_transitions(j, keep_rows=8)   # keep last 2 records
+            assert os.path.getsize(jpath) < size_before
+            # Appends continue cleanly after the rewrite.
+            append_transitions(j, *_batch(4, seed=9), env_steps=7)
+        obs, act, rew, nxt, high = read_tail_transitions(jpath, 0)
+        assert obs.shape[0] == 12 and high == 7
+        # Per-record stamps survive: cutoff can still split the kept tail.
+        cut, *_rest, high2 = read_tail_transitions(jpath, 0,
+                                                   cutoff_env_steps=6)
+        assert cut.shape[0] == 8 and high2 == 7
+
+    def test_compaction_noop_when_tail_covers_everything(self, jpath):
+        with Journal(jpath) as j:
+            append_transitions(j, *_batch(4), env_steps=1)
+            assert not compact_transitions(j, keep_rows=100)
+        assert read_tail_transitions(jpath, 0)[0].shape[0] == 4
+
+    @pytest.mark.skipif(not native_available(),
+                        reason="native journal not built")
+    def test_native_journal_appends_binary(self, jpath):
+        from sharetrade_tpu.data.native import NativeJournal
+        obs, act, rew, nxt = _batch(5, seed=9)
+        with NativeJournal(jpath) as nj:
+            append_transitions(nj, obs, act, rew, nxt, env_steps=11)
+        tail = read_tail_transitions(jpath, 0)
+        np.testing.assert_array_equal(tail[0], obs)
+        assert tail[4] == 11
