@@ -58,7 +58,9 @@ def iter_framed_records(path: str) -> Iterator[tuple[int, bytes]]:
     """Yield ``(end_offset, payload)`` for each intact record, stopping at
     the first torn/corrupt one — the single read-side definition of the
     framing (mirrors ``write_framed_bytes`` on the write side; the C++
-    backend's ``scan_file`` implements the same walk)."""
+    backend's ``scan_file`` implements the same walk). Stopping short of EOF
+    is logged: every reader (replay, tail decode, compaction) otherwise
+    silently drops whatever sits past the corruption."""
     if not os.path.exists(path):
         return
     offset = 0
@@ -73,6 +75,10 @@ def iter_framed_records(path: str) -> Iterator[tuple[int, bytes]]:
                 break
             offset += _HEADER.size + length
             yield offset, payload
+    remaining = os.path.getsize(path) - offset
+    if remaining:
+        log.warning("journal %s: corrupt record at offset %d, ignoring %d "
+                    "trailing bytes", path, offset, remaining)
 
 
 class Journal:

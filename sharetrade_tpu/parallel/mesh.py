@@ -53,7 +53,8 @@ def build_mesh(cfg: ParallelConfig | None = None, devices=None) -> Mesh:
 
 def init_distributed(coordinator_address: str | None = None,
                      num_processes: int | None = None,
-                     process_id: int | None = None) -> bool:
+                     process_id: int | None = None,
+                     cpu_collectives: str | None = None) -> bool:
     """Multi-host bring-up (the reference's never-built Akka Cluster tier,
     README.md:13, build.sbt:13 akka-remote on the classpath but dormant).
 
@@ -71,12 +72,19 @@ def init_distributed(coordinator_address: str | None = None,
     3. No-op — single-process: returns whether jax already reports multiple
        processes.
 
+    ``cpu_collectives`` selects the CPU cross-process collective backend
+    ("gloo" or "mpi") — on TPU the collectives ride ICI/DCN and this is
+    unused, but it makes the multi-process path runnable (and tested,
+    tests/test_distributed.py::TestTwoProcessSmoke) on CPU-only hosts.
+
     Returns True when running multi-process. Idempotent: a second call after
     successful bring-up is a no-op (jax raises on double-initialize).
     """
     import os
     if jax.distributed.is_initialized():
         return jax.process_count() > 1
+    if cpu_collectives is not None:
+        jax.config.update("jax_cpu_collectives_implementation", cpu_collectives)
     if coordinator_address is not None:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

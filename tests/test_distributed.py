@@ -1,20 +1,35 @@
-"""Multi-host (DCN) bring-up gating — the reference's dormant remoting tier
+"""Multi-host (DCN) bring-up — the reference's dormant remoting tier
 (build.sbt:13 akka-remote on the classpath, README.md:13 "Akka Clustering
-will come later") made explicit and testable.
+will come later") made explicit, testable, AND runnable.
 
-A REAL 2-process smoke is environmentally blocked here: this host's
-interpreter startup binds jax to the single tunneled TPU chip
-(JAX_PLATFORMS=cpu is overridden), so two distributed processes would both
-claim the same chip. These tests therefore pin the *gating contract* of
-``init_distributed`` — which tier fires, with which arguments, and its
-idempotence — against a recorded ``jax.distributed.initialize``; the
-documented bring-up recipe lives in its docstring (parallel/mesh.py).
+Two tiers of coverage:
+
+- ``TestInitDistributedGating`` pins the gating contract of
+  ``init_distributed`` (which tier fires, with which arguments, idempotence)
+  against a recorded ``jax.distributed.initialize``.
+- ``TestTwoProcessSmoke`` runs the real thing: two OS processes, each its
+  own jax runtime (CPU backend, gloo standing in for DCN), brought up via
+  ``init_distributed`` and running sharded PPO training chunks over a dp
+  mesh that SPANS the processes (tools/dist_smoke_worker.py). The in-process
+  interpreter here is bound to the tunneled TPU chip by the site hook, so
+  the children scrub that hook's trigger from their environment and run
+  CPU-only — the same code path a real multi-host TPU pod takes, with DCN
+  collectives swapped for gloo.
 """
+
+import json
+import os
+import socket
+import subprocess
+import sys
 
 import pytest
 
 from sharetrade_tpu.parallel import init_distributed
 from sharetrade_tpu.parallel import mesh as mesh_mod
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO_ROOT, "tools", "dist_smoke_worker.py")
 
 
 class _Recorder:
@@ -68,3 +83,45 @@ class TestInitDistributedGating:
             mesh_mod.jax.distributed, "is_initialized", lambda: True)
         init_distributed("host0:8476", num_processes=2, process_id=0)
         assert recorded_initialize.calls == []
+
+
+@pytest.mark.slow
+class TestTwoProcessSmoke:
+    """The multi-process training path, executed for real (not mocked)."""
+
+    NPROC = 2
+
+    def _spawn(self, pid: int, port: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        # Scrub the site hook's trigger so the child's jax never registers
+        # the axon TPU plugin (two processes cannot share the one chip), and
+        # drop the parent's 8-virtual-device flag: one CPU device per
+        # process makes the global mesh genuinely cross-process.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = ""
+        return subprocess.Popen(
+            [sys.executable, WORKER, f"127.0.0.1:{port}",
+             str(self.NPROC), str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO_ROOT)
+
+    def test_sharded_training_across_processes(self):
+        with socket.socket() as s:  # reserve a free coordinator port
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = [self._spawn(pid, port) for pid in range(self.NPROC)]
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+        assert sorted(o["process_id"] for o in outs) == [0, 1]
+        for o in outs:
+            assert o["process_count"] == self.NPROC
+            assert o["num_devices"] == self.NPROC
+            assert o["env_steps"] > 0
+        # The dp gradient all-reduce crossed the process boundary and both
+        # replicas hold identical post-update parameters.
+        assert outs[0]["param_sum"] == outs[1]["param_sum"]
+        assert outs[0]["env_steps"] == outs[1]["env_steps"]
