@@ -54,17 +54,23 @@ def write_framed(path: str, events: list[dict[str, Any]]) -> None:
         [json.dumps(e, separators=(",", ":")).encode() for e in events])
 
 
-def iter_framed_records(path: str) -> Iterator[tuple[int, bytes]]:
+def iter_framed_records(path: str, *, warn: bool = True) -> Iterator[tuple[int, bytes]]:
     """Yield ``(end_offset, payload)`` for each intact record, stopping at
     the first torn/corrupt one — the single read-side definition of the
     framing (mirrors ``write_framed_bytes`` on the write side; the C++
-    backend's ``scan_file`` implements the same walk). Stopping short of EOF
-    is logged: every reader (replay, tail decode, compaction) otherwise
-    silently drops whatever sits past the corruption."""
+    backend's ``scan_file`` implements the same walk).
+
+    Stopping short of the size the file had when the walk started is logged
+    (``warn=False`` for callers that log their own recovery action, e.g.
+    torn-tail truncation at open): every reader — replay, tail decode,
+    compaction — otherwise silently drops whatever sits past the corruption.
+    The size is captured up front so records appended concurrently during
+    the walk don't masquerade as corruption."""
     if not os.path.exists(path):
         return
     offset = 0
     with open(path, "rb") as f:
+        size = os.fstat(f.fileno()).st_size
         while True:
             header = f.read(_HEADER.size)
             if len(header) < _HEADER.size:
@@ -75,10 +81,10 @@ def iter_framed_records(path: str) -> Iterator[tuple[int, bytes]]:
                 break
             offset += _HEADER.size + length
             yield offset, payload
-    remaining = os.path.getsize(path) - offset
-    if remaining:
-        log.warning("journal %s: corrupt record at offset %d, ignoring %d "
-                    "trailing bytes", path, offset, remaining)
+    remaining = size - offset
+    if remaining > 0 and warn:
+        log.warning("journal %s: corrupt/torn record at offset %d, ignoring "
+                    "%d trailing bytes", path, offset, remaining)
 
 
 class Journal:
@@ -138,7 +144,8 @@ class Journal:
         if not os.path.exists(self.path):
             return None
         end = 0
-        for end, _payload in iter_framed_records(self.path):
+        # warn=False: this path logs its own, action-bearing message below.
+        for end, _payload in iter_framed_records(self.path, warn=False):
             pass
         if end == os.path.getsize(self.path):
             return None

@@ -55,27 +55,42 @@ def _block_size(padded: int) -> int:
     return 256 if padded % 256 == 0 else 128
 
 
-def reference_attention(q, k, v, *, causal: bool = True, sm_scale: float | None = None):
-    """Plain XLA attention — the numeric ground truth for the kernel."""
+def reference_attention(q, k, v, *, causal: bool = True, sm_scale: float | None = None,
+                        local_window: int | None = None):
+    """Plain XLA attention — the numeric ground truth for the kernel.
+
+    ``local_window=W`` restricts each query row p to the band of keys
+    ``(p-W, p]`` — sliding-window (banded) causal attention: a query sees
+    exactly the W keys ending at itself, so a sliding price window can be
+    attended inside one long sequence without reprocessing it per step.
+    """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    if local_window is not None and not causal:
+        raise ValueError("local_window requires causal attention")
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * sm_scale
     if causal:
         t_q, t_k = scores.shape[-2], scores.shape[-1]
         row = jax.lax.broadcasted_iota(jnp.int32, (t_q, t_k), 0)
         col = jax.lax.broadcasted_iota(jnp.int32, (t_q, t_k), 1)
-        scores = jnp.where(col <= row, scores, _NEG_INF)
+        mask = col <= row
+        if local_window is not None:
+            mask = mask & (col > row - local_window)
+        scores = jnp.where(mask, scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                  causal: bool, sm_scale: float, kv_len: int, kv_pad: int):
+                  causal: bool, sm_scale: float, kv_len: int, kv_pad: int,
+                  local_window: int | None):
     """One (batch*head, q-block) program: online-softmax over K blocks.
 
     ``kv_len`` is the true key count (padding columns beyond it are masked);
-    ``kv_pad`` is the padded extent the loop tiles over.
+    ``kv_pad`` is the padded extent the loop tiles over. ``local_window=W``
+    bands the causal mask to keys ``(row-W, row]`` and skips K blocks
+    entirely below the band, so compute is O(T·W) instead of O(T²).
     """
     q_block = q_ref.shape[1]
     head_dim = q_ref.shape[2]
@@ -85,11 +100,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     # accumulation and softmax run in f32 via preferred_element_type.
     q = q_ref[0]  # (block_q, d)
 
+    first_k_block = 0
     num_k_blocks = pl.cdiv(kv_pad, block_k)
     if causal:
         # Blocks entirely above the causal frontier contribute nothing.
         last_row = (qi + 1) * q_block - 1
         num_k_blocks = jnp.minimum(num_k_blocks, pl.cdiv(last_row + 1, block_k))
+    if local_window is not None:
+        # Blocks entirely below the band contribute nothing either.
+        first_row = qi * q_block
+        first_k_block = jnp.maximum(
+            0, (first_row - local_window + 1) // block_k)
 
     row_ids = qi * q_block + jax.lax.broadcasted_iota(
         jnp.int32, (q_block, block_k), 0)
@@ -105,6 +126,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         mask = col_ids < kv_len  # padding columns are not real keys
         if causal:
             mask = mask & (col_ids <= row_ids)
+        if local_window is not None:
+            mask = mask & (col_ids > row_ids - local_window)
         s = jnp.where(mask, s, _NEG_INF)
 
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
@@ -117,7 +140,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     acc0 = jnp.zeros((q_block, head_dim), jnp.float32)
     m0 = jnp.full((q_block,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((q_block,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (acc0, m0, l0))
+    acc, m, l = jax.lax.fori_loop(first_k_block, num_k_blocks, body,
+                                  (acc0, m0, l0))
 
     # Fully-masked (padding) query rows have l == 0; emit zeros, not NaNs.
     l_safe = jnp.where(l > 0, l, 1.0)
@@ -154,7 +178,7 @@ def _pad_inputs(q, k, v):
     return qp, kp, vp, d_pad
 
 
-def _flash_forward(q, k, v, causal: bool, sm_scale: float, interpret: bool):
+def _flash_forward(q, k, v, causal, sm_scale, local_window, interpret):
     """Returns ``(out, lse)`` — lse is the backward's O(T) residual."""
     batch, heads, seq_len, head_dim = q.shape
     kv_len = k.shape[2]
@@ -171,7 +195,8 @@ def _flash_forward(q, k, v, causal: bool, sm_scale: float, interpret: bool):
 
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, causal=causal,
-        sm_scale=sm_scale, kv_len=kv_len, kv_pad=kv_pad)
+        sm_scale=sm_scale, kv_len=kv_len, kv_pad=kv_pad,
+        local_window=local_window)
 
     out, lse = pl.pallas_call(
         kernel,
@@ -199,7 +224,8 @@ def _flash_forward(q, k, v, causal: bool, sm_scale: float, interpret: bool):
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, *, block_k: int, causal: bool,
-                         sm_scale: float, kv_len: int, kv_pad: int):
+                         sm_scale: float, kv_len: int, kv_pad: int,
+                         local_window: int | None):
     """dQ, tiled over query blocks: dq = Σ_kb (p∘(dpᵀv − δ))·scale @ k."""
     q_block = q_ref.shape[1]
     qi = pl.program_id(1)
@@ -214,10 +240,14 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     row_ids = qi * q_block + jax.lax.broadcasted_iota(
         jnp.int32, (q_block, block_k), 0)
 
+    first_k_block = 0
     num_k_blocks = pl.cdiv(kv_pad, block_k)
     if causal:
         last_row = (qi + 1) * q_block - 1
         num_k_blocks = jnp.minimum(num_k_blocks, pl.cdiv(last_row + 1, block_k))
+    if local_window is not None:
+        first_k_block = jnp.maximum(
+            0, (qi * q_block - local_window + 1) // block_k)
 
     def body(kb, dq):
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
@@ -228,19 +258,22 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = col_ids < kv_len
         if causal:
             mask = mask & (col_ids <= row_ids)
+        if local_window is not None:
+            mask = mask & (col_ids > row_ids - local_window)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
         dp = _dot(do, v_blk.T)
         ds = (p * (dp - delta[:, None]) * sm_scale).astype(k_blk.dtype)
         return dq + _dot(ds, k_blk)
 
     dq0 = jnp.zeros((q_block, q_ref.shape[2]), jnp.float32)
-    dq = jax.lax.fori_loop(0, num_k_blocks, body, dq0)
+    dq = jax.lax.fori_loop(first_k_block, num_k_blocks, body, dq0)
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, block_q: int, causal: bool,
-                          sm_scale: float, kv_len: int, t_pad: int):
+                          sm_scale: float, kv_len: int, t_pad: int,
+                          local_window: int | None):
     """dK/dV, tiled over key blocks: dv = Σ_qb pᵀ·do; dk = Σ_qb dsᵀ·q·scale."""
     block_k = k_ref.shape[1]
     kb = pl.program_id(1)
@@ -254,6 +287,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     num_q_blocks = t_pad // block_q
     # Causal: query blocks strictly before this key block see none of it.
     qb_start = (kb * block_k) // block_q if causal else 0
+    qb_end = num_q_blocks
+    if local_window is not None:
+        # Banded: key c is seen only by queries p ≤ c + W - 1; blocks past
+        # that frontier contribute nothing.
+        last_q_row = (kb + 1) * block_k - 1 + local_window - 1
+        qb_end = jnp.minimum(num_q_blocks, pl.cdiv(last_q_row + 1, block_q))
 
     def body(qb, carry):
         dk, dv = carry
@@ -268,6 +307,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             row_ids = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             mask = mask & (col_ids <= row_ids)
+            if local_window is not None:
+                mask = mask & (col_ids > row_ids - local_window)
         p = jnp.where(mask, jnp.exp(s - lse_blk[:, None]), 0.0)
 
         dv = dv + _dot(p.astype(do_blk.dtype).T, do_blk)
@@ -277,12 +318,13 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         return dk, dv
 
     zeros = jnp.zeros((block_k, k_ref.shape[2]), jnp.float32)
-    dk, dv = jax.lax.fori_loop(qb_start, num_q_blocks, body, (zeros, zeros))
+    dk, dv = jax.lax.fori_loop(qb_start, qb_end, body, (zeros, zeros))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, interpret):
+def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, local_window,
+                    interpret):
     batch, heads, seq_len, head_dim = q.shape
     kv_len = k.shape[2]
 
@@ -305,7 +347,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, interpret):
     block_q, block_k = _block_size(t_pad), _block_size(kv_pad)
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, block_k=block_k, causal=causal,
-        sm_scale=sm_scale, kv_len=kv_len, kv_pad=kv_pad)
+        sm_scale=sm_scale, kv_len=kv_len, kv_pad=kv_pad,
+        local_window=local_window)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, t_pad // block_q),
@@ -324,7 +367,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, interpret):
 
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, block_q=block_q, causal=causal,
-        sm_scale=sm_scale, kv_len=kv_len, t_pad=t_pad)
+        sm_scale=sm_scale, kv_len=kv_len, t_pad=t_pad,
+        local_window=local_window)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh, kv_pad // block_k),
@@ -353,20 +397,21 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, interpret):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_attention(q, k, v, causal, sm_scale, interpret):
-    out, _ = _flash_forward(q, k, v, causal, sm_scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal, sm_scale, local_window, interpret):
+    out, _ = _flash_forward(q, k, v, causal, sm_scale, local_window, interpret)
     return out
 
 
-def _flash_fwd_rule(q, k, v, causal, sm_scale, interpret):
-    out, lse = _flash_forward(q, k, v, causal, sm_scale, interpret)
+def _flash_fwd_rule(q, k, v, causal, sm_scale, local_window, interpret):
+    out, lse = _flash_forward(q, k, v, causal, sm_scale, local_window, interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, sm_scale, interpret, residuals, g):
+def _flash_bwd_rule(causal, sm_scale, local_window, interpret, residuals, g):
     q, k, v, out, lse = residuals
-    return _flash_backward(q, k, v, out, lse, g, causal, sm_scale, interpret)
+    return _flash_backward(q, k, v, out, lse, g, causal, sm_scale,
+                           local_window, interpret)
 
 
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -374,8 +419,15 @@ _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     sm_scale: float | None = None,
+                    local_window: int | None = None,
                     use_pallas: bool | None = None):
     """Causal MHA over (batch, heads, seq, head_dim).
+
+    ``local_window=W`` restricts each query to the W-key band ending at
+    itself (sliding-window attention, Mistral-style), letting a sliding
+    price window be attended inside ONE long sequence. Compute and the
+    K-block loop skip everything outside the band, so cost is O(T·W)
+    rather than O(T²).
 
     ``use_pallas=None`` auto-selects: the kernel on TPU, the XLA reference
     elsewhere (the unit suite runs the kernel through the Pallas interpreter
@@ -385,9 +437,17 @@ def flash_attention(q, k, v, *, causal: bool = True,
         raise ValueError(f"expected (batch, heads, seq, head_dim), got {q.shape}")
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    if local_window is not None:
+        if not causal:
+            raise ValueError("local_window requires causal attention")
+        if local_window < 1:
+            raise ValueError(f"local_window must be >= 1, got {local_window}")
+        if local_window >= q.shape[2]:
+            local_window = None    # band covers everything: plain causal
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if not use_pallas:
-        return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                                   local_window=local_window)
     interpret = jax.default_backend() != "tpu"
-    return _flash_attention(q, k, v, causal, sm_scale, interpret)
+    return _flash_attention(q, k, v, causal, sm_scale, local_window, interpret)

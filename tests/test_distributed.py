@@ -112,10 +112,19 @@ class TestTwoProcessSmoke:
             port = s.getsockname()[1]
         procs = [self._spawn(pid, port) for pid in range(self.NPROC)]
         outs = []
-        for p in procs:
-            out, err = p.communicate(timeout=600)
-            assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-            outs.append(json.loads(out.strip().splitlines()[-1]))
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=600)
+                assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+                outs.append(json.loads(out.strip().splitlines()[-1]))
+        finally:
+            # A failed/timed-out rank must not leak its peer: the survivor
+            # blocks forever in the gloo/coordinator barrier, holding the
+            # port and hanging the run.
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
         assert sorted(o["process_id"] for o in outs) == [0, 1]
         for o in outs:
             assert o["process_count"] == self.NPROC

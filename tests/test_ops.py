@@ -130,3 +130,92 @@ def test_bfloat16_path():
     assert got.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(got, np.float32),
                                np.asarray(want, np.float32), atol=3e-2, rtol=3e-2)
+
+
+class TestLocalWindow:
+    """Banded (sliding-window) attention — the episode-mode primitive."""
+
+    def test_banded_matches_dense_mask_fast(self):
+        q, k, v = _rand_qkv(jax.random.PRNGKey(6), seq=64)
+        got = flash_attention(q, k, v, causal=True, local_window=16,
+                              use_pallas=True)
+        want = reference_attention(q, k, v, causal=True, local_window=16)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_band_semantics_hand_check(self):
+        # window=1: each query attends only itself -> output == v.
+        q, k, v = _rand_qkv(jax.random.PRNGKey(7), seq=8, d=32)
+        got = flash_attention(q, k, v, causal=True, local_window=1,
+                              use_pallas=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(v), atol=2e-5)
+
+    def test_window_covering_sequence_equals_causal(self):
+        q, k, v = _rand_qkv(jax.random.PRNGKey(8), seq=64)
+        banded = flash_attention(q, k, v, causal=True, local_window=64,
+                                 use_pallas=True)
+        causal = flash_attention(q, k, v, causal=True, use_pallas=True)
+        np.testing.assert_allclose(np.asarray(banded), np.asarray(causal),
+                                   atol=1e-6)
+
+    def test_keys_outside_band_are_invisible(self):
+        # Perturbing a key/value outside every query's band changes nothing
+        # for queries whose band excludes it.
+        q, k, v = _rand_qkv(jax.random.PRNGKey(9), seq=64)
+        w = 8
+        base = flash_attention(q, k, v, causal=True, local_window=w,
+                               use_pallas=True)
+        k2 = k.at[:, :, 10, :].add(100.0)
+        v2 = v.at[:, :, 10, :].add(-50.0)
+        pert = flash_attention(q, k2, v2, causal=True, local_window=w,
+                               use_pallas=True)
+        # Queries 18+ have bands starting at >= 11: unaffected.
+        np.testing.assert_allclose(np.asarray(base[:, :, 18:]),
+                                   np.asarray(pert[:, :, 18:]), atol=1e-5)
+        assert not np.allclose(np.asarray(base[:, :, 10:18]),
+                               np.asarray(pert[:, :, 10:18]))
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seq,window", [(256, 64), (403, 202), (512, 256)])
+    def test_banded_matches_reference(self, seq, window):
+        # 403 = the episode-mode replay span for window 202, unroll 202.
+        q, k, v = _rand_qkv(jax.random.PRNGKey(10), seq=seq)
+        got = flash_attention(q, k, v, causal=True, local_window=window,
+                              use_pallas=True)
+        want = reference_attention(q, k, v, causal=True, local_window=window)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.slow
+    def test_banded_gradients_match_reference(self):
+        q, k, v = _rand_qkv(jax.random.PRNGKey(11), seq=96, d=32)
+
+        def loss_kernel(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, local_window=24, use_pallas=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(
+                q, k, v, causal=True, local_window=24) ** 2)
+
+        g_kernel = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_kernel, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4)
+
+    @pytest.mark.slow
+    def test_banded_grad_compiles_on_backend(self):
+        x = jnp.zeros((2, 2, 433, 64), jnp.float32)  # W=202 span, T=232
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           local_window=202, use_pallas=True))
+
+        jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(x, x, x).compile()
+
+    def test_rejects_noncausal_band(self):
+        q = jnp.zeros((1, 1, 8, 32))
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, q, q, causal=False, local_window=4,
+                            use_pallas=True)
