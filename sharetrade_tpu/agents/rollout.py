@@ -113,6 +113,15 @@ def replay_forward(model: Model, params: Any, traj: StepData, init_carry,
     recomputes from stored observations — the FLOPs-for-HBM trade that
     makes large agent batches fit.
     """
+    if model.apply_unroll is not None:
+        # The model replays a whole trajectory natively (episode-mode
+        # transformer: one banded pass over the unroll's tick sequence
+        # instead of T window forwards).
+        fwd = model.apply_unroll
+        if remat:
+            fwd = jax.checkpoint(fwd)
+        return fwd(params, traj.obs, init_carry)
+
     stateless = not jax.tree.leaves(init_carry)
     if stateless:
         t, b = traj.obs.shape[:2]

@@ -51,6 +51,13 @@ class ModelConfig:
     head_dim: int = 64
     seq_block: int = 128               # pallas attention block size
     dtype: str = "float32"             # compute dtype ("bfloat16" on TPU for speed)
+    # "window" re-attends the full price window per env step (the reference's
+    # 203-float observation kept as a sequence); "episode" embeds each tick
+    # once and runs sliding-window flash attention over the episode's tick
+    # stream with an incremental K/V-cache rollout — one O(T+window) replay
+    # pass instead of T O(window) window forwards (transformer only;
+    # models/transformer_episode.py).
+    seq_mode: str = "window"
     # Attention partitioning: "flash" = local Pallas kernel per device;
     # "ring" = sequence-parallel ring attention over the mesh's sp axis
     # (ppermute K/V rotation, arbitrary sp size); "ulysses" = all_to_all

@@ -35,6 +35,12 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
     """
     dtype = _DTYPES[cfg.dtype]
     actions = cfg.num_actions if num_actions is None else num_actions
+    if cfg.seq_mode not in ("window", "episode"):
+        raise ValueError(f"unknown model.seq_mode {cfg.seq_mode!r}")
+    if cfg.seq_mode == "episode" and cfg.kind != "transformer":
+        raise ValueError(
+            f"model.seq_mode='episode' is a transformer mode; "
+            f"model.kind={cfg.kind!r} would silently ignore it")
     if cfg.kind == "mlp":
         if head == "q":
             return q_mlp(obs_dim, cfg.hidden_dim, actions,
@@ -51,6 +57,19 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
         # Pallas kernel; the XLA reference path is numerically identical.
         use_pallas = (False if mesh is not None
                       and mesh.devices.flat[0].platform != "tpu" else None)
+        if cfg.seq_mode == "episode":
+            if (cfg.attention != "flash" or cfg.pipeline_blocks
+                    or cfg.moe_experts):
+                raise ValueError(
+                    "model.seq_mode='episode' supports flash attention only "
+                    "(no ring/ulysses/pipeline_blocks/moe yet) — drop those "
+                    "options or use seq_mode='window'")
+            from sharetrade_tpu.models.transformer_episode import (
+                episode_transformer_policy)
+            return episode_transformer_policy(
+                obs_dim, actions, num_layers=cfg.num_layers,
+                num_heads=cfg.num_heads, head_dim=cfg.head_dim, dtype=dtype,
+                use_pallas=use_pallas)
         if cfg.attention in ("ring", "ulysses"):
             if mesh is None or "sp" not in mesh.axis_names:
                 raise ValueError(

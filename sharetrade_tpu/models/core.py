@@ -52,6 +52,14 @@ class Model:
     # into the flash kernel's batch*heads grid) provide this; everyone else
     # gets vmap of `apply` via `apply_batched`.
     apply_batch: Callable[[Any, jax.Array, Any], tuple[ModelOut, Any]] | None = None
+    # Optional whole-unroll training forward (params, (T, B, obs_dim) obs,
+    # unroll-start carry_batch) -> (logits (T, B, A), values (T, B), aux).
+    # Models that can replay a trajectory more cheaply than T per-step
+    # forwards provide this (the episode-mode transformer runs ONE banded
+    # pass over the unroll's tick sequence); rollout.replay_forward
+    # dispatches to it.
+    apply_unroll: Callable[[Any, jax.Array, Any],
+                           tuple[jax.Array, jax.Array, jax.Array]] | None = None
 
 
 def apply_batched(model: Model, params: Any, obs_batch: jax.Array,

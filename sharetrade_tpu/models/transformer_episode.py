@@ -1,0 +1,293 @@
+"""Episode-mode transformer: the tick stream IS the sequence.
+
+The window-mode policy (models/transformer.py) re-embeds and re-attends the
+full price window for every env step, so a T-step PPO replay reprocesses
+T x (window+1) tokens per agent even though consecutive windows share all
+but one tick. Episode mode is the TPU-first inversion: embed each tick
+ONCE, run sliding-window (banded) flash attention over the episode's tick
+sequence (ops/attention.py local_window), and read one output per env step
+— an O(T + L*window) forward replaces T O(window) window forwards (~15-50x
+fewer tokens for the BASELINE unrolls). This is also the long-context
+story: the training pass handles long unrolls (the full 5,843-step MSFT
+episode fits one banded pass) as ONE sequence instead of a stack of
+windows. (The kernel currently stages full-length K/V per program, so
+sequences are bounded by VMEM at ~tens of thousands of tokens; tiling K/V
+over the band would lift that.)
+
+Architecture notes (deliberately different from window mode — this is a
+redesign, not a re-tiling):
+
+- Tokens carry step-invariant features only (log-return and its magnitude):
+  keys must mean the same thing to every query that sees them, so the
+  window-anchored price normalization of window mode cannot appear on the
+  key side. Scale-invariance across decades of price levels is preserved —
+  log-returns are dimensionless.
+- Positions enter via rotary embeddings (RoPE) at ABSOLUTE tick indices:
+  relative offsets inside each query's band are then position-exact
+  regardless of where the band sits in the episode, and rollout/replay use
+  the same indices so their numerics agree.
+- The portfolio state (budget, shares) is injected on the head side: a
+  learned projection added to the final-layer representation at each step's
+  query position. Attention over prices does not depend on the agent's
+  wallet; the decision head combines market context with it (the classic
+  features+state actor-critic split). The reference folds budget/shares
+  into the network input instead (QDecisionPolicyActor.scala:18, 203-dim
+  x); window mode keeps that shape, episode mode redesigns it.
+
+Rollout runs incrementally with a per-layer rolling K/V cache of exactly
+``window`` entries (a Mistral-style sliding-window cache): one token's
+qkv/mlp plus a 1 x window attention row per step. The training replay runs
+the banded forward over [carried history | chunk ticks]. Both compute the
+same function of the same tick series: the carry stores the
+(L-1)*(window-1) ticks the deepest layer's receptive field reaches past
+the chunk boundary, episode starts left-pad with the first price on both
+paths, and RoPE uses absolute indices — so replayed logits match rollout
+logits to numerical tolerance (tests/test_models.py::TestEpisodeMode).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sharetrade_tpu.models.core import Model, ModelOut, dense, dense_init
+from sharetrade_tpu.models.transformer import _layer_norm
+from sharetrade_tpu.ops.attention import flash_attention
+
+_EPS = 1e-6
+
+
+def _rope(x: jax.Array, positions: jax.Array, *, base: float = 10000.0):
+    """Rotary position embedding. x: (B, H, S, D) with D even; positions:
+    (B, S) absolute indices (negative is fine — episode-start padding sits
+    at negative ticks)."""
+    half = x.shape[-1] // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions[:, None, :, None].astype(jnp.float32) * freqs
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+def _tick_features(series: jax.Array) -> jax.Array:
+    """(B, S) prices -> (B, S, 3) step-invariant token features."""
+    logp = jnp.log(jnp.maximum(series, _EPS))
+    ret = jnp.concatenate(
+        [jnp.zeros_like(logp[:, :1]), logp[:, 1:] - logp[:, :-1]], axis=1)
+    return jnp.stack([ret, jnp.abs(ret), jnp.zeros_like(ret)], axis=-1)
+
+
+def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
+                               num_layers: int = 2, num_heads: int = 4,
+                               head_dim: int = 64, mlp_ratio: int = 4,
+                               dtype=jnp.float32,
+                               use_pallas: bool | None = None) -> Model:
+    """Build the episode-mode policy (``ModelConfig.seq_mode="episode"``)."""
+    if head_dim % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
+    window = obs_dim - 2                    # ticks per observation window
+    hist_len = (num_layers - 1) * (window - 1)
+    d_model = num_heads * head_dim
+    sm_scale = head_dim ** -0.5
+
+    def init(key):
+        keys = jax.random.split(key, 5 + 6 * num_layers)
+        params = {
+            "embed": dense_init(keys[0], 3, d_model, dtype=dtype),
+            "port": dense_init(keys[1], 3, d_model, scale=0.02, dtype=dtype),
+            "policy": dense_init(keys[2], d_model, num_actions, scale=0.01,
+                                 dtype=dtype),
+            "value": dense_init(keys[3], d_model, 1, dtype=dtype),
+            "final_ln": {"scale": jnp.ones((d_model,), dtype),
+                         "bias": jnp.zeros((d_model,), dtype)},
+            "blocks": [],
+        }
+        for i in range(num_layers):
+            k = keys[5 + 6 * i: 5 + 6 * (i + 1)]
+            params["blocks"].append({
+                "ln1": {"scale": jnp.ones((d_model,), dtype),
+                        "bias": jnp.zeros((d_model,), dtype)},
+                "qkv": dense_init(k[0], d_model, 3 * d_model, dtype=dtype),
+                "proj": dense_init(k[1], d_model, d_model,
+                                   scale=0.02 / max(num_layers, 1), dtype=dtype),
+                "ln2": {"scale": jnp.ones((d_model,), dtype),
+                        "bias": jnp.zeros((d_model,), dtype)},
+                "mlp_in": dense_init(k[2], d_model, mlp_ratio * d_model,
+                                     dtype=dtype),
+                "mlp_out": dense_init(k[3], mlp_ratio * d_model, d_model,
+                                      scale=0.02 / max(num_layers, 1),
+                                      dtype=dtype),
+            })
+        return params
+
+    def forward(params, series, positions, port_feats, *, want_kv=False):
+        """Banded forward over a (B, S) tick series.
+
+        ``port_feats`` (B, S, 3) is zero except at query positions. Returns
+        (logits (B, S, A), values (B, S), per-layer rotated (k, v) lists
+        when ``want_kv`` — the rollout cache seed).
+        """
+        bsz, s_len = series.shape
+        x = dense(params["embed"], _tick_features(series).astype(dtype))
+        kv = []
+        for blk in params["blocks"]:
+            h = _layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
+            qkv = dense(blk["qkv"], h).reshape(
+                bsz, s_len, 3, num_heads, head_dim)
+            q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
+            q = _rope(q, positions)
+            k = _rope(k, positions)
+            attn = flash_attention(q, k, v, causal=True, sm_scale=sm_scale,
+                                   local_window=window, use_pallas=use_pallas)
+            if want_kv:
+                kv.append((k[:, :, -window:], v[:, :, -window:]))
+            attn = attn.transpose(0, 2, 1, 3).reshape(
+                bsz, s_len, d_model).astype(dtype)
+            x = x + dense(blk["proj"], attn)
+            h = _layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
+            x = x + dense(blk["mlp_out"], jax.nn.gelu(dense(blk["mlp_in"], h)))
+        hn = _layer_norm(x, params["final_ln"]["scale"],
+                         params["final_ln"]["bias"])
+        hn = hn + dense(params["port"], port_feats.astype(dtype))
+        logits = dense(params["policy"], hn).astype(jnp.float32)
+        values = dense(params["value"], hn).astype(jnp.float32)[..., 0]
+        return logits, values, kv
+
+    def _port_feats(budget, shares, anchor):
+        """(…,) scalars -> (…, 3) head-side portfolio features; anchor is
+        the step's newest price (the same normalization window mode uses
+        for its portfolio token, models/transformer.py)."""
+        anchor = jnp.maximum(anchor, _EPS)
+        return jnp.stack([budget / (anchor * 100.0), shares / 100.0,
+                          jnp.ones_like(budget)], axis=-1)
+
+    def _prefill(params, obs):
+        """Episode-start pass: [first-price pads | first window], caching
+        the last ``window`` rotated K/Vs per layer."""
+        bsz = obs.shape[0]
+        win = obs[:, :window]
+        pads = jnp.repeat(win[:, :1], hist_len, axis=1)
+        series = jnp.concatenate([pads, win], axis=1)
+        positions = jnp.broadcast_to(
+            jnp.arange(-hist_len, window, dtype=jnp.int32)[None, :],
+            series.shape)
+        port = jnp.zeros(series.shape + (3,), jnp.float32)
+        port = port.at[:, -1, :].set(
+            _port_feats(obs[:, window], obs[:, window + 1], win[:, -1]))
+        logits, values, kv = forward(params, series, positions, port,
+                                     want_kv=True)
+        cache_k = jnp.stack([k for k, _ in kv], axis=1)  # (B, L, H, W, Dh)
+        cache_v = jnp.stack([v for _, v in kv], axis=1)
+        carry = {
+            "k": cache_k, "v": cache_v,
+            "hist": jnp.repeat(win[:, :1], hist_len, axis=1),
+            "t": jnp.ones((bsz,), jnp.int32),
+        }
+        return ModelOut(logits=logits[:, -1], value=values[:, -1],
+                        aux=jnp.float32(0.0)), carry
+
+    def _incremental(params, obs, carry):
+        """One-token step against the rolling K/V cache."""
+        bsz = obs.shape[0]
+        new, prev = obs[:, window - 1], obs[:, window - 2]
+        ret = (jnp.log(jnp.maximum(new, _EPS))
+               - jnp.log(jnp.maximum(prev, _EPS)))
+        tok = jnp.stack([ret, jnp.abs(ret), jnp.zeros_like(ret)], axis=-1)
+        x = dense(params["embed"], tok.astype(dtype))[:, None, :]  # (B, 1, d)
+        pos = (carry["t"] + window - 1).astype(jnp.int32)[:, None]  # (B, 1)
+
+        new_k, new_v = [], []
+        for li, blk in enumerate(params["blocks"]):
+            h = _layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
+            qkv = dense(blk["qkv"], h).reshape(bsz, 1, 3, num_heads, head_dim)
+            q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
+            q = _rope(q, pos)
+            k = _rope(k, pos)
+            k_all = jnp.concatenate([carry["k"][:, li, :, 1:], k], axis=2)
+            v_all = jnp.concatenate([carry["v"][:, li, :, 1:], v], axis=2)
+            new_k.append(k_all)
+            new_v.append(v_all)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k_all,
+                           preferred_element_type=jnp.float32) * sm_scale
+            probs = jax.nn.softmax(s, axis=-1).astype(v_all.dtype)
+            attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v_all)
+            attn = attn.transpose(0, 2, 1, 3).reshape(
+                bsz, 1, d_model).astype(dtype)
+            x = x + dense(blk["proj"], attn)
+            h = _layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
+            x = x + dense(blk["mlp_out"], jax.nn.gelu(dense(blk["mlp_in"], h)))
+        hn = _layer_norm(x[:, 0], params["final_ln"]["scale"],
+                         params["final_ln"]["bias"])
+        hn = hn + dense(params["port"], _port_feats(
+            obs[:, window], obs[:, window + 1], new).astype(dtype))
+        logits = dense(params["policy"], hn).astype(jnp.float32)
+        values = dense(params["value"], hn).astype(jnp.float32)[..., 0]
+        hist = carry["hist"]
+        if hist_len:
+            # Tick t (the window's oldest) leaves the window this step.
+            hist = jnp.concatenate([hist[:, 1:], obs[:, :1]], axis=1)
+        carry = {"k": jnp.stack(new_k, axis=1),
+                 "v": jnp.stack(new_v, axis=1),
+                 "hist": hist, "t": carry["t"] + 1}
+        return ModelOut(logits=logits, value=values,
+                        aux=jnp.float32(0.0)), carry
+
+    def apply_batch(params, obs, carry):
+        # All agents advance in lockstep (the env steps the whole batch
+        # together), so the episode-start predicate is uniform: t[0].
+        return jax.lax.cond(
+            carry["t"][0] == 0,
+            lambda c: _prefill(params, obs),
+            lambda c: _incremental(params, obs, c),
+            carry)
+
+    def apply(params, obs, carry):
+        carry_b = jax.tree.map(lambda x: x[None], carry)
+        outs, new_c = apply_batch(params, obs[None], carry_b)
+        return (ModelOut(logits=outs.logits[0], value=outs.value[0],
+                         aux=outs.aux),
+                jax.tree.map(lambda x: x[0], new_c))
+
+    def apply_unroll(params, obs, carry):
+        """Training replay: ONE banded pass over [history | chunk ticks].
+
+        ``obs`` is the stored (T, B, obs_dim) trajectory; ``carry`` the
+        batched episode carry at unroll START (PPO already threads exactly
+        this for recurrent policies). Returns (logits (T, B, A),
+        values (T, B), aux scalar).
+        """
+        t_len, bsz = obs.shape[0], obs.shape[1]
+        first_win = obs[0, :, :window]                     # ticks t0..t0+W-1
+        newer = obs[1:, :, window - 1].T                   # (B, T-1)
+        t0 = carry["t"].astype(jnp.int32)                  # (B,)
+        # At episode start the carry's history is the init_carry zeros the
+        # prefill never saw; substitute the first-price padding the prefill
+        # actually used so both paths read the same series.
+        hist = jnp.where((t0 == 0)[:, None], first_win[:, :1], carry["hist"])
+        series = jnp.concatenate([hist, first_win, newer], axis=1)
+        s_len = hist_len + window + t_len - 1
+        positions = (t0[:, None] - hist_len
+                     + jnp.arange(s_len, dtype=jnp.int32)[None, :])
+        q_pos = hist_len + window - 1 + jnp.arange(t_len)  # static indices
+        anchor = obs[:, :, window - 1]                     # (T, B)
+        feats = _port_feats(obs[:, :, window], obs[:, :, window + 1], anchor)
+        port = jnp.zeros((bsz, s_len, 3), jnp.float32)
+        port = port.at[:, q_pos, :].set(feats.swapaxes(0, 1))
+        logits, values, _ = forward(params, series, positions, port)
+        return (logits[:, q_pos].swapaxes(0, 1),
+                values[:, q_pos].swapaxes(0, 1), jnp.float32(0.0))
+
+    def init_carry():
+        return {
+            "k": jnp.zeros((num_layers, num_heads, window, head_dim), dtype),
+            "v": jnp.zeros((num_layers, num_heads, window, head_dim), dtype),
+            "hist": jnp.zeros((hist_len,), jnp.float32),
+            "t": jnp.int32(0),
+        }
+
+    return Model(init=init, apply=apply, apply_batch=apply_batch,
+                 apply_unroll=apply_unroll, init_carry=init_carry,
+                 obs_dim=obs_dim, num_actions=num_actions,
+                 name="transformer_episode")
