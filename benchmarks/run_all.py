@@ -119,6 +119,20 @@ def make_configs() -> dict[str, FrameworkConfig]:
             learner__remat=True,
             model__num_layers=2, model__num_heads=2, model__head_dim=128,
             model__dtype="bfloat16"),
+        # Episode-mode transformer (model.seq_mode="episode"): ticks embed
+        # once, banded flash attention over the episode's tick stream, one
+        # O(T+L*window) replay pass per chunk instead of T window forwards.
+        "ppo_tr_episode": base(
+            learner__algo="ppo", model__kind="transformer",
+            model__seq_mode="episode",
+            learner__unroll_len=32, runtime__chunk_steps=32,
+            model__num_layers=2, model__num_heads=4, model__head_dim=64),
+        "ppo_tr_episode_b256_bf16": base(
+            learner__algo="ppo", model__kind="transformer",
+            model__seq_mode="episode", parallel__num_workers=256,
+            learner__unroll_len=128, runtime__chunk_steps=128,
+            model__num_layers=2, model__num_heads=2, model__head_dim=128,
+            model__dtype="bfloat16"),
         # Mesh-sharded row (ParallelConfig.mesh_shape): dp-sharded agents,
         # Megatron column/row tp split of the MLP. Skips unless the host
         # exposes 8 devices (v5e-8); capability is CPU-mesh-tested either way.

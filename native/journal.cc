@@ -11,11 +11,15 @@
 // Exposed as a C ABI consumed via ctypes (sharetrade_tpu/data/native.py) —
 // the environment has no pybind11, and ctypes keeps the binding dependency-free.
 
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 #if !defined(_WIN32)
@@ -297,5 +301,141 @@ void* stj_read_all(const char* path, uint64_t* out_len) {
 }
 
 void stj_free(void* buf) { free(buf); }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Async journal writer: a background thread drains a bounded in-memory queue
+// into the framed log, so the training loop's per-chunk journal append is a
+// memcpy instead of a synchronous multi-MB write+flush (the "replay/
+// persistence bandwidth without starving the step loop" concern, SURVEY.md
+// §7.4 — the role LevelDB's own background write path plays for the
+// reference's journal). Durability window == queue depth: a crash loses at
+// most the queued-but-unwritten records, which the resume-time high-water
+// logic already tolerates (missing tail ⇒ fewer warm-start rows, never
+// corruption — frames are written whole by one thread).
+
+namespace {
+
+struct AsyncWriter {
+  Journal* j = nullptr;
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv_submit;  // worker waits: queue non-empty / stop
+  std::condition_variable cv_space;   // producers wait: room / drained
+  std::deque<std::string> queue;
+  size_t queued_bytes = 0;
+  size_t max_bytes = 0;
+  bool stop = false;
+  bool idle = true;                   // worker drained and wrote everything
+  int error = 0;                      // first write error, sticky
+};
+
+void writer_loop(AsyncWriter* w) {
+  std::vector<std::string> batch;
+  for (;;) {
+    {
+      std::unique_lock<std::mutex> lk(w->mu);
+      w->cv_submit.wait(lk, [&] { return w->stop || !w->queue.empty(); });
+      if (w->queue.empty() && w->stop) return;
+      while (!w->queue.empty()) {
+        batch.push_back(std::move(w->queue.front()));
+        w->queue.pop_front();
+      }
+      w->queued_bytes = 0;
+      w->idle = false;
+    }
+    w->cv_space.notify_all();
+    int err = 0;
+    for (const std::string& payload : batch) {
+      uint8_t header[8];
+      put_u32(header, (uint32_t)payload.size());
+      put_u32(header + 4,
+              crc32_of(reinterpret_cast<const uint8_t*>(payload.data()),
+                       payload.size()));
+      if (fwrite(header, 1, 8, w->j->fh) != 8) { err = 2; break; }
+      if (!payload.empty() &&
+          fwrite(payload.data(), 1, payload.size(), w->j->fh)
+              != payload.size()) { err = 3; break; }
+    }
+    if (!err && fflush(w->j->fh) != 0) err = 4;
+#if !defined(_WIN32)
+    if (!err && w->j->fsync_each && fsync(fileno(w->j->fh)) != 0) err = 5;
+#endif
+    batch.clear();
+    {
+      std::lock_guard<std::mutex> lk(w->mu);
+      if (err && !w->error) w->error = err;
+      w->idle = w->queue.empty();
+    }
+    w->cv_space.notify_all();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open an async writer over a journal (torn-tail recovery as stj_open).
+// `max_queue_bytes` bounds producer-side memory; submit blocks when full.
+void* stj_writer_open(const char* path, uint64_t max_queue_bytes,
+                      int fsync_each) {
+  void* jh = stj_open(path, fsync_each);
+  if (!jh) return nullptr;
+  AsyncWriter* w = new AsyncWriter;
+  w->j = static_cast<Journal*>(jh);
+  w->max_bytes = max_queue_bytes ? (size_t)max_queue_bytes : (64u << 20);
+  w->worker = std::thread(writer_loop, w);
+  return w;
+}
+
+// Enqueue one payload (copied). Blocks while the queue is over budget.
+// Returns the sticky error code of the background writer (0 = ok).
+int stj_writer_submit(void* handle, const char* payload, uint32_t length) {
+  AsyncWriter* w = static_cast<AsyncWriter*>(handle);
+  if (!w) return 1;
+  {
+    std::unique_lock<std::mutex> lk(w->mu);
+    if (w->stop) return 1;
+    // An empty queue always admits the payload, even one larger than the
+    // whole budget — otherwise a single oversized record (big transition
+    // batches) would wait on a predicate that can never become true.
+    w->cv_space.wait(lk, [&] {
+      return w->queued_bytes == 0 ||
+             w->queued_bytes + length <= w->max_bytes || w->error;
+    });
+    if (w->error) return w->error;
+    w->queue.emplace_back(payload, payload + length);
+    w->queued_bytes += length;
+    w->idle = false;
+  }
+  w->cv_submit.notify_one();
+  return 0;
+}
+
+// Block until everything submitted so far is written and flushed.
+int stj_writer_flush(void* handle) {
+  AsyncWriter* w = static_cast<AsyncWriter*>(handle);
+  if (!w) return 1;
+  std::unique_lock<std::mutex> lk(w->mu);
+  w->cv_space.wait(lk, [&] { return (w->idle && w->queue.empty()) || w->error; });
+  return w->error;
+}
+
+// Flush, join the worker, close the file. Returns the sticky error code.
+int stj_writer_close(void* handle) {
+  AsyncWriter* w = static_cast<AsyncWriter*>(handle);
+  if (!w) return 1;
+  {
+    std::lock_guard<std::mutex> lk(w->mu);
+    w->stop = true;
+  }
+  w->cv_submit.notify_one();
+  if (w->worker.joinable()) w->worker.join();
+  int err = w->error;
+  stj_close(w->j);
+  delete w;
+  return err;
+}
 
 }  // extern "C"

@@ -27,6 +27,12 @@ class DataConfig:
     synthetic_seed: int = 1992
     journal_dir: str = "journal"       # event journal root (reference: LevelDB dir)
     use_native_journal: bool = True    # prefer the C++ journal if built
+    # Drain hot-path journal appends (the per-chunk transition records of
+    # learner.journal_replay) through the C++ background-thread writer so the
+    # training loop never blocks on file IO. Durability window = the writer's
+    # bounded queue; falls back to synchronous appends when the native
+    # library isn't built.
+    async_transition_writer: bool = True
 
 
 @dataclass

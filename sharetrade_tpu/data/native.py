@@ -49,6 +49,18 @@ def _load() -> ctypes.CDLL | None:
                 lib.stj_read_tail_transitions.argtypes = [
                     ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
                     ctypes.POINTER(ctypes.c_uint64)]
+            if hasattr(lib, "stj_writer_open"):
+                # Async background-thread writer (older .so builds lack it).
+                lib.stj_writer_open.restype = ctypes.c_void_p
+                lib.stj_writer_open.argtypes = [
+                    ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int]
+                lib.stj_writer_submit.restype = ctypes.c_int
+                lib.stj_writer_submit.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+                lib.stj_writer_flush.restype = ctypes.c_int
+                lib.stj_writer_flush.argtypes = [ctypes.c_void_p]
+                lib.stj_writer_close.restype = ctypes.c_int
+                lib.stj_writer_close.argtypes = [ctypes.c_void_p]
             _lib = lib
             return lib
     return None
@@ -144,6 +156,113 @@ class NativeJournal:
                 self._handle = None
 
     def __enter__(self) -> "NativeJournal":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+def async_writer_available() -> bool:
+    lib = _load()
+    return lib is not None and hasattr(lib, "stj_writer_open")
+
+
+class AsyncNativeJournal:
+    """Journal whose appends drain through a C++ background thread.
+
+    Same contract as :class:`NativeJournal` plus non-blocking appends: the
+    training loop's per-chunk transition write becomes a queue copy while a
+    native thread does the framing/IO (bounded queue — submit blocks when
+    over budget, so memory can't run away). Reads and compaction quiesce the
+    writer first, so every read still sees all appends that returned.
+
+    Durability window == queue depth: a crash loses at most queued records;
+    the journal-backed replay's high-water recovery treats that as a shorter
+    tail, never as corruption.
+    """
+
+    def __init__(self, path: str, *, fsync: bool = False,
+                 max_queue_bytes: int = 64 << 20):
+        lib = _load()
+        if lib is None or not hasattr(lib, "stj_writer_open"):
+            raise ImportError(
+                "native async writer not built (make -C native)")
+        self.path = path
+        self._lib = lib
+        self._fsync = fsync
+        self._max_queue = max_queue_bytes
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._handle = lib.stj_writer_open(
+            path.encode(), max_queue_bytes, 1 if fsync else 0)
+        if not self._handle:
+            raise OSError(f"stj_writer_open failed for {path}")
+
+    def append(self, event: dict[str, Any]) -> None:
+        self.append_bytes(json.dumps(event, separators=(",", ":")).encode())
+
+    def append_bytes(self, payload: bytes) -> None:
+        with self._lock:
+            rc = self._lib.stj_writer_submit(
+                self._handle, payload, len(payload))
+        if rc != 0:
+            raise OSError(f"stj_writer_submit failed rc={rc}")
+
+    def flush(self) -> None:
+        """Block until every append that returned is on disk (fflush'd;
+        fsync'd when the journal was opened with fsync)."""
+        with self._lock:
+            rc = self._lib.stj_writer_flush(self._handle)
+        if rc != 0:
+            raise OSError(f"stj_writer_flush failed rc={rc}")
+
+    def replay(self) -> Iterator[dict[str, Any]]:
+        self.flush()
+        from sharetrade_tpu.data.journal import iter_framed_records
+        for _offset, payload in iter_framed_records(self.path):
+            if payload[:4] == b"STR1":
+                continue  # packed transition record, not a JSON event
+            try:
+                event = json.loads(payload)
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if isinstance(event, dict):
+                yield event
+
+    def compact(self, event_list: list[dict[str, Any]]) -> None:
+        self.compact_payloads([
+            json.dumps(e, separators=(",", ":")).encode()
+            for e in event_list])
+
+    def compact_payloads(self, payloads: list[bytes]) -> None:
+        """Atomic rewrite: quiesce + close the writer (its FILE* would
+        otherwise keep appending to the replaced inode), rewrite, reopen."""
+        from sharetrade_tpu.data.journal import write_framed_bytes
+        tmp_path = f"{self.path}.compact-{os.getpid()}"
+        with self._lock:
+            rc = self._lib.stj_writer_close(self._handle)
+            self._handle = None
+            if rc != 0:
+                raise OSError(f"stj_writer_close failed rc={rc}")
+            write_framed_bytes(tmp_path, payloads)
+            os.replace(tmp_path, self.path)
+            self._handle = self._lib.stj_writer_open(
+                self.path.encode(), self._max_queue, 1 if self._fsync else 0)
+            if not self._handle:
+                raise OSError(f"stj_writer_open failed reopening {self.path}")
+
+    def __len__(self) -> int:
+        return sum(1 for _ in self.replay())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._handle:
+                rc = self._lib.stj_writer_close(self._handle)
+                self._handle = None
+                if rc != 0:
+                    raise OSError(f"stj_writer_close failed rc={rc}")
+
+    def __enter__(self) -> "AsyncNativeJournal":
         return self
 
     def __exit__(self, *exc: Any) -> None:

@@ -178,6 +178,12 @@ def compact_transitions(journal, keep_rows: int) -> bool:
     Returns True when anything was dropped. (The reference delegates this to
     LevelDB's per-actor compaction intervals, application.conf:7-14.)
     """
+    # Async-writer journals buffer appends in a background thread; reading
+    # journal.path without quiescing would compute the keep-boundary from a
+    # stale snapshot and the rewrite would DROP the queued records.
+    flush = getattr(journal, "flush", None)
+    if flush is not None:
+        flush()
     payloads = [p for _off, p in iter_framed_records(journal.path)]
     rows = 0
     boundary = len(payloads)

@@ -109,9 +109,18 @@ class Orchestrator:
         if cfg.learner.algo == "dqn" and cfg.learner.journal_replay:
             import os
             from sharetrade_tpu.data.service import _open_journal
-            self._transitions_journal = _open_journal(
-                os.path.join(cfg.data.journal_dir, "transitions.journal"),
-                prefer_native=cfg.data.use_native_journal)
+            path = os.path.join(cfg.data.journal_dir, "transitions.journal")
+            self._transitions_journal = None
+            if cfg.data.async_transition_writer and cfg.data.use_native_journal:
+                # Hot-path appends drain through the C++ background thread;
+                # the step loop never blocks on journal IO.
+                from sharetrade_tpu.data.native import (
+                    AsyncNativeJournal, async_writer_available)
+                if async_writer_available():
+                    self._transitions_journal = AsyncNativeJournal(path)
+            if self._transitions_journal is None:
+                self._transitions_journal = _open_journal(
+                    path, prefer_native=cfg.data.use_native_journal)
 
     # ------------------------------------------------------------------
     # protocol: SendTrainingData (TrainerRouterActor.scala:77-81)

@@ -112,7 +112,32 @@ def forward_equivalents_per_agent_step(cfg: LearnerConfig,
     raise ValueError(f"unknown algo {cfg.algo!r}")
 
 
+def _episode_mode_flops_per_agent_step(cfg: FrameworkConfig,
+                                       obs_dim: int) -> float:
+    """Episode-mode transformer (models/transformer_episode.py): the unroll
+    replays as ONE banded pass over S = L*(window-1)+T tokens instead of T
+    window-length forwards, and the rollout is a single incremental token
+    per step (band-width attention row). Counted per agent-step:
+
+        rollout:  1 token   (24*d^2 matmuls + 4*window*d attention)
+        replay:   epochs x 3 (fwd+bwd) x (S / T) tokens
+    """
+    model, learner = cfg.model, cfg.learner
+    w = obs_dim - 2
+    d = model.num_heads * model.head_dim
+    per_token = (model.num_layers * (24.0 * d * d + 4.0 * w * d)
+                 + 2.0 * 3 * d        # tick embed
+                 + 2.0 * d * (model.num_actions + 1 + 3))  # heads + port
+    t = max(learner.unroll_len, 1)
+    s = model.num_layers * (w - 1) + t
+    epochs = learner.ppo_epochs if learner.algo == "ppo" else 1
+    return per_token * (1.0 + epochs * 3.0 * (s / t))
+
+
 def train_flops_per_agent_step(cfg: FrameworkConfig, obs_dim: int) -> float:
+    if (cfg.model.kind == "transformer" and cfg.model.seq_mode == "episode"
+            and cfg.learner.algo in ("pg", "a2c", "ppo")):
+        return _episode_mode_flops_per_agent_step(cfg, obs_dim)
     return (forward_flops_per_obs(cfg.model, obs_dim, cfg.learner.algo)
             * forward_equivalents_per_agent_step(
                 cfg.learner, cfg.parallel.num_workers))

@@ -62,3 +62,110 @@ def test_native_corrupt_length_header_is_torn_tail(tmp_journal_path):
         assert [e["n"] for e in nj.replay()] == [1]
         nj.append({"n": 2})
         assert [e["n"] for e in nj.replay()] == [1, 2]
+
+
+class TestAsyncWriter:
+    """C++ background-thread writer (stj_writer_*): appends become queue
+    copies; flush/close/compaction quiesce; the on-disk format stays the
+    shared framed log."""
+
+    def _async(self, path, **kw):
+        from sharetrade_tpu.data.native import (
+            AsyncNativeJournal, async_writer_available)
+        if not async_writer_available():
+            pytest.skip("async writer not in built .so (make -C native)")
+        return AsyncNativeJournal(path, **kw)
+
+    def test_append_flush_read_roundtrip(self, tmp_journal_path):
+        with self._async(tmp_journal_path) as aj:
+            for n in range(200):
+                aj.append({"n": n})
+            aj.flush()
+            # Python backend reads the flushed bytes directly.
+            with Journal(tmp_journal_path) as j:
+                assert [e["n"] for e in j.replay()] == list(range(200))
+            assert [e["n"] for e in aj.replay()] == list(range(200))
+
+    def test_close_drains_queue(self, tmp_journal_path):
+        aj = self._async(tmp_journal_path)
+        payload = os.urandom(4096)
+        for _ in range(500):
+            aj.append_bytes(b"STR0" + payload)   # ~2 MB queued
+        aj.close()                               # must drain, not drop
+        from sharetrade_tpu.data.journal import iter_framed_records
+        records = list(iter_framed_records(tmp_journal_path))
+        assert len(records) == 500
+
+    def test_bounded_queue_backpressure(self, tmp_journal_path):
+        # A queue budget smaller than one burst: submits must block (not
+        # fail, not drop) until the worker drains.
+        with self._async(tmp_journal_path, max_queue_bytes=64 << 10) as aj:
+            chunk = os.urandom(16 << 10)
+            for _ in range(64):                  # 1 MB through a 64 KB queue
+                aj.append_bytes(chunk)
+            aj.flush()
+        from sharetrade_tpu.data.journal import iter_framed_records
+        assert len(list(iter_framed_records(tmp_journal_path))) == 64
+
+    def test_compaction_quiesces_and_resumes(self, tmp_journal_path):
+        with self._async(tmp_journal_path) as aj:
+            for n in range(10):
+                aj.append({"n": n})
+            aj.compact([{"n": 9}])
+            aj.append({"n": 10})
+            assert [e["n"] for e in aj.replay()] == [9, 10]
+        with Journal(tmp_journal_path) as j:
+            assert [e["n"] for e in j.replay()] == [9, 10]
+
+    def test_torn_tail_recovery_on_open(self, tmp_journal_path):
+        with self._async(tmp_journal_path) as aj:
+            aj.append({"n": 1})
+        with open(tmp_journal_path, "ab") as f:
+            f.write(b"\x55\x00\x00\x00garbage")
+        with self._async(tmp_journal_path) as aj:
+            aj.append({"n": 2})
+            assert [e["n"] for e in aj.replay()] == [1, 2]
+
+    def test_transitions_through_async_writer(self, tmp_journal_path):
+        import numpy as np
+        from sharetrade_tpu.data.transitions import (
+            append_transitions, read_tail_transitions)
+        with self._async(tmp_journal_path) as aj:
+            obs = np.arange(12, dtype=np.float32).reshape(3, 4)
+            append_transitions(aj, obs, np.array([0, 1, 2], np.int32),
+                               np.array([1.0, 2.0, 3.0], np.float32),
+                               obs + 1.0, env_steps=7)
+            aj.flush()
+            tail = read_tail_transitions(tmp_journal_path, 10)
+        assert tail is not None
+        np.testing.assert_array_equal(tail[0], obs)
+        assert tail[4] == 7
+
+    def test_oversized_payload_does_not_deadlock(self, tmp_journal_path):
+        # One payload bigger than the whole queue budget must be admitted
+        # when the queue is empty, not wait on an unsatisfiable predicate.
+        with self._async(tmp_journal_path, max_queue_bytes=1024) as aj:
+            aj.append_bytes(os.urandom(4096))
+            aj.flush()
+        from sharetrade_tpu.data.journal import iter_framed_records
+        assert len(list(iter_framed_records(tmp_journal_path))) == 1
+
+    def test_compaction_sees_queued_records(self, tmp_journal_path):
+        # compact_transitions over an async journal must quiesce the writer
+        # first: the keep-boundary computed from a stale on-disk snapshot
+        # would otherwise drop records still in the queue.
+        import numpy as np
+        from sharetrade_tpu.data.transitions import (
+            append_transitions, compact_transitions, read_tail_transitions)
+        with self._async(tmp_journal_path) as aj:
+            obs = np.ones((4, 3), np.float32)
+            for n in range(8):
+                append_transitions(aj, obs * n, np.zeros(4, np.int32),
+                                   np.zeros(4, np.float32), obs,
+                                   env_steps=n + 1)
+            # No flush: records may still be queued when compaction runs.
+            compact_transitions(aj, keep_rows=16)   # keep last 4 records
+            tail = read_tail_transitions(tmp_journal_path, 0)
+        assert tail is not None
+        assert tail[4] == 8            # newest record survived
+        assert tail[0].shape[0] == 16  # exactly the kept tail
