@@ -64,6 +64,14 @@ def main() -> int:
     t0 = time.time()
     jax.block_until_ready(jax.jit(agent.step)(state))
     print(f"  ok ppo+transformer train step  ({time.time() - t0:.1f}s)")
+
+    # Episode-mode flagship: banded kernel fwd+bwd at the real replay span,
+    # inside the full train step (prefill cond + incremental cache + banded
+    # replay must all lower).
+    cfg.model.seq_mode = "episode"
+    agent = build_agent(cfg, env_params)
+    state = agent.init(jax.random.PRNGKey(0))
+    smoke("ppo+transformer EPISODE train step", agent.step, state)
     print("compile smoke: ALL OK")
     return 0
 
