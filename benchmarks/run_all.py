@@ -58,11 +58,26 @@ def bench_config(name: str, cfg: FrameworkConfig, *, chunks: int) -> dict:
     ts, _ = step(ts)                       # compile + warm chunk
     jax.block_until_ready(ts.params)
 
-    t0 = time.perf_counter()
-    for _ in range(chunks):
-        ts, _ = step(ts)
-    jax.block_until_ready(ts.params)
-    elapsed = time.perf_counter() - t0
+    horizon = trading.num_steps(env_params)
+    if (chunks + 1) * agent.steps_per_chunk > horizon:
+        # The episode can't cover warm + timed chunks (the env freezes past
+        # its horizon — timing frozen chunks would count dead steps, e.g.
+        # the full-episode config). Re-init per rep and time each live
+        # chunk individually.
+        elapsed = 0.0
+        for rep in range(chunks):
+            ts = init(jax.random.PRNGKey(rep + 1))
+            jax.block_until_ready(ts.params)
+            t0 = time.perf_counter()
+            ts, _ = step(ts)
+            jax.block_until_ready(ts.params)
+            elapsed += time.perf_counter() - t0
+    else:
+        t0 = time.perf_counter()
+        for _ in range(chunks):
+            ts, _ = step(ts)
+        jax.block_until_ready(ts.params)
+        elapsed = time.perf_counter() - t0
 
     agent_steps = chunks * agent.steps_per_chunk * agent.num_agents
     rate = agent_steps / elapsed
@@ -131,6 +146,17 @@ def make_configs() -> dict[str, FrameworkConfig]:
             learner__algo="ppo", model__kind="transformer",
             model__seq_mode="episode", parallel__num_workers=256,
             learner__unroll_len=128, runtime__chunk_steps=128,
+            model__num_layers=2, model__num_heads=2, model__head_dim=128,
+            model__dtype="bfloat16"),
+        # The reference's ENTIRE workload as one compiled chunk: 10 workers x
+        # the full 5,845-step episode (6,046 prices - 201 window,
+        # env/trading.py num_steps), rollout + GAE + clipped updates, with
+        # the replay as a single ~6k-token banded pass (long-context tier).
+        # Each timed rep starts from a fresh init so every step is live.
+        "ppo_tr_episode_full_episode": base(
+            learner__algo="ppo", model__kind="transformer",
+            model__seq_mode="episode",
+            learner__unroll_len=5845, runtime__chunk_steps=5845,
             model__num_layers=2, model__num_heads=2, model__head_dim=128,
             model__dtype="bfloat16"),
         # Mesh-sharded row (ParallelConfig.mesh_shape): dp-sharded agents,
