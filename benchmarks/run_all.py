@@ -114,6 +114,9 @@ def make_configs() -> dict[str, FrameworkConfig]:
         "a2c_mlp": base(learner__algo="a2c"),
         "ppo_lstm": base(learner__algo="ppo", model__kind="lstm",
                          learner__unroll_len=128, runtime__chunk_steps=128),
+        "ppo_tcn": base(learner__algo="ppo", model__kind="tcn",
+                        model__hidden_dim=64,
+                        learner__unroll_len=128, runtime__chunk_steps=128),
         "ppo_transformer": base(learner__algo="ppo", model__kind="transformer",
                                 learner__unroll_len=32, runtime__chunk_steps=32,
                                 model__num_layers=2, model__num_heads=4,

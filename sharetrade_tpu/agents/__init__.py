@@ -62,10 +62,10 @@ def build_agent(cfg: FrameworkConfig, env: TradingEnv | trading.EnvParams,
         raise ValueError(
             f"learner.algo={algo!r} requires model.kind='mlp' "
             f"(got {cfg.model.kind!r}); use a2c/ppo for {cfg.model.kind} policies")
-    if env.num_assets > 1 and cfg.model.kind == "transformer":
+    if env.num_assets > 1 and cfg.model.kind in ("transformer", "tcn"):
         raise ValueError(
-            "the transformer tick policy tokenizes a single-asset window; "
-            "use mlp/lstm for multi-asset portfolios")
+            f"the {cfg.model.kind} tick policy tokenizes a single-asset "
+            "window; use mlp/lstm for multi-asset portfolios")
     if model is None:
         model = build_model(cfg.model, env.obs_dim, head=_HEADS[algo],
                             num_actions=env.num_actions, mesh=mesh)

@@ -48,8 +48,8 @@ class EnvConfig:
 class ModelConfig:
     """Policy network (reference: QDecisionPolicyActor.scala:38-50)."""
 
-    kind: str = "mlp"                  # mlp | lstm | transformer
-    hidden_dim: int = 200              # reference h1Dim
+    kind: str = "mlp"                  # mlp | lstm | transformer | tcn
+    hidden_dim: int = 200              # reference h1Dim (tcn: conv channels)
     num_actions: int = 3               # Buy / Sell / Hold
     # transformer-only:
     num_layers: int = 2

@@ -48,6 +48,10 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
         return ac_mlp(obs_dim, cfg.hidden_dim, actions, dtype=dtype)
     if cfg.kind == "lstm":
         return lstm_policy(obs_dim, cfg.hidden_dim, actions, dtype=dtype)
+    if cfg.kind == "tcn":
+        from sharetrade_tpu.models.tcn import tcn_policy
+        return tcn_policy(obs_dim, actions, channels=cfg.hidden_dim,
+                          dtype=dtype)
     if cfg.kind == "transformer":
         attention_fn = None
         pp_mesh = None

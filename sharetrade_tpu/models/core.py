@@ -72,6 +72,34 @@ def apply_batched(model: Model, params: Any, obs_batch: jax.Array,
         lambda o, c: model.apply(params, o, c))(obs_batch, carry_batch)
 
 
+_EPS = 1e-6
+
+
+def tick_window_features(obs: jax.Array, window: int) -> jax.Array:
+    """(B, obs_dim) observations -> (B, window, 3) scale-invariant per-tick
+    features: price relative to the window's last price, log-return, and a
+    zero channel (the window-mode transformer marks its portfolio token
+    there). Shared by every tick-sequence policy (transformer window mode,
+    TCN) so the tokenization cannot silently diverge between families."""
+    prices = obs[:, :window].astype(jnp.float32)
+    anchor = jnp.maximum(prices[:, -1:], _EPS)
+    rel = prices / anchor - 1.0
+    logp = jnp.log(jnp.maximum(prices, _EPS))
+    log_ret = jnp.concatenate(
+        [jnp.zeros_like(logp[:, :1]), logp[:, 1:] - logp[:, :-1]], axis=1)
+    return jnp.stack([rel, log_ret, jnp.zeros_like(rel)], axis=-1)
+
+
+def portfolio_features(budget: jax.Array, shares: jax.Array,
+                       anchor: jax.Array) -> jax.Array:
+    """(…,) scalars -> (…, 3) normalized portfolio features; ``anchor`` is
+    the window's newest price. One definition for every policy head (window
+    transformer's portfolio token, episode mode's head injection, TCN)."""
+    anchor = jnp.maximum(anchor, _EPS)
+    return jnp.stack([budget / (anchor * 100.0), shares / 100.0,
+                      jnp.ones_like(budget)], axis=-1)
+
+
 def dense_init(key: jax.Array, in_dim: int, out_dim: int, *,
                scale: float | None = None, dtype=jnp.float32) -> dict[str, jax.Array]:
     """Dense layer params. Default init is He-normal (std = sqrt(2/in)).

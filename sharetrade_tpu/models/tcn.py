@@ -1,0 +1,114 @@
+"""Temporal convolutional tick policy (TCN).
+
+A third sequence-model family beside the LSTM and the transformer: stacked
+dilated CAUSAL 1-D convolutions over the tokenized price window, receptive
+field doubling per block until it covers the whole window. On TPU the
+channels-last convolutions lower to MXU matmuls (an NWC conv with C_in x
+C_out filters is a batched matmul per tap), so the whole forward is
+MXU-resident with no recurrence — unlike the LSTM there is no sequential
+carry, and unlike the transformer there is no O(W^2) score matrix at all.
+
+The reference has one model (the 203->200->3 MLP,
+QDecisionPolicyActor.scala:38-47); the model zoo generalizes it (SURVEY.md
+§7.1 item 3). The TCN shares the window-mode transformer's tokenization
+(scale-invariant per-tick features; models/transformer.py) and the
+episode-mode head design (portfolio injected at the head,
+models/transformer_episode.py): market features come from the conv stack's
+last position, then a learned projection of (budget, shares) joins before
+the policy/value heads.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from sharetrade_tpu.models.core import (
+    Model, ModelOut, dense, dense_init, portfolio_features,
+    tick_window_features)
+
+KERNEL = 3
+
+
+def default_num_blocks(window: int) -> int:
+    """Blocks needed for the dilated receptive field 1 + (K-1)*(2^B - 1)
+    to cover ``window`` (shared with the FLOP accounting, utils/flops.py)."""
+    return max(1, math.ceil(
+        math.log2(max((window - 1) / (KERNEL - 1) + 1, 2))))
+
+
+def _conv_init(key, kernel: int, c_in: int, c_out: int, dtype):
+    """He-normal (W, I, O) filter + bias."""
+    std = math.sqrt(2.0 / (kernel * c_in))
+    w = jax.random.normal(key, (kernel, c_in, c_out), dtype) * jnp.asarray(
+        std, dtype)
+    return {"w": w, "b": jnp.zeros((c_out,), dtype)}
+
+
+def _causal_conv(p, x, dilation: int):
+    """(B, W, C_in) -> (B, W, C_out), left-padded so position t sees only
+    positions <= t (standard causal dilated conv)."""
+    pad = (KERNEL - 1) * dilation
+    out = jax.lax.conv_general_dilated(
+        x, p["w"], window_strides=(1,), padding=[(pad, 0)],
+        rhs_dilation=(dilation,),
+        dimension_numbers=("NWC", "WIO", "NWC"),
+        preferred_element_type=jnp.float32)
+    return out.astype(x.dtype) + p["b"]
+
+
+def tcn_policy(obs_dim: int = 203, num_actions: int = 3, *,
+               channels: int = 64, num_blocks: int | None = None,
+               dtype=jnp.float32) -> Model:
+    """Build the TCN policy (``ModelConfig.kind="tcn"``).
+
+    ``num_blocks=None`` auto-sizes the stack so the dilated receptive field
+    ``1 + (K-1)*(2^B - 1)`` covers the whole price window.
+    """
+    window = obs_dim - 2
+    if num_blocks is None:
+        num_blocks = default_num_blocks(window)
+
+    def init(key):
+        keys = jax.random.split(key, 4 + 2 * num_blocks)
+        params = {
+            "embed": dense_init(keys[0], 3, channels, dtype=dtype),
+            "port": dense_init(keys[1], 3, channels, scale=0.02, dtype=dtype),
+            "policy": dense_init(keys[2], channels, num_actions, scale=0.01,
+                                 dtype=dtype),
+            "value": dense_init(keys[3], channels, 1, dtype=dtype),
+            "blocks": [],
+        }
+        for i in range(num_blocks):
+            params["blocks"].append({
+                "conv": _conv_init(keys[4 + 2 * i], KERNEL, channels,
+                                   channels, dtype),
+                "mix": dense_init(keys[5 + 2 * i], channels, channels,
+                                  scale=0.02, dtype=dtype),
+            })
+        return params
+
+    def apply_batch(params, obs, carry):
+        tokens = tick_window_features(obs, window)               # (B, W, 3)
+        x = dense(params["embed"], tokens.astype(dtype))         # (B, W, C)
+        for i, blk in enumerate(params["blocks"]):
+            h = jax.nn.gelu(_causal_conv(blk["conv"], x, dilation=2 ** i))
+            x = x + dense(blk["mix"], h)
+        summary = x[:, -1]                                       # (B, C)
+        port = portfolio_features(
+            obs[:, window], obs[:, window + 1], obs[:, window - 1])
+        summary = summary + dense(params["port"], port.astype(dtype))
+        logits = dense(params["policy"], summary).astype(jnp.float32)
+        value = dense(params["value"], summary).astype(jnp.float32)[:, 0]
+        return ModelOut(logits=logits, value=value,
+                        aux=jnp.float32(0.0)), carry
+
+    def apply(params, obs, carry):
+        outs, carry = apply_batch(params, obs[None], carry)
+        return ModelOut(logits=outs.logits[0], value=outs.value[0],
+                        aux=outs.aux), carry
+
+    return Model(init=init, apply=apply, apply_batch=apply_batch,
+                 obs_dim=obs_dim, num_actions=num_actions, name="tcn")

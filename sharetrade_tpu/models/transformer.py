@@ -18,7 +18,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from sharetrade_tpu.models.core import Model, ModelOut, dense, dense_init
+from sharetrade_tpu.models.core import (
+    Model, ModelOut, dense, dense_init, portfolio_features,
+    tick_window_features)
 from sharetrade_tpu.ops.attention import flash_attention
 
 
@@ -144,19 +146,11 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         return out, jnp.float32(0.0)
 
     def tokenize(obs):
-        """(B, obs_dim) -> (B, seq, 3) token features."""
-        prices = obs[:, :window].astype(jnp.float32)
-        budget, shares = obs[:, window], obs[:, window + 1]
-        anchor = jnp.maximum(prices[:, -1:], 1e-6)               # (B, 1)
-        rel = prices / anchor - 1.0
-        logp = jnp.log(jnp.maximum(prices, 1e-6))
-        log_ret = jnp.concatenate(
-            [jnp.zeros_like(logp[:, :1]), logp[:, 1:] - logp[:, :-1]], axis=1)
-        tick_tokens = jnp.stack(
-            [rel, log_ret, jnp.zeros_like(rel)], axis=-1)        # (B, window, 3)
-        portfolio_token = jnp.stack(
-            [budget / (anchor[:, 0] * 100.0), shares / 100.0,
-             jnp.ones_like(budget)], axis=-1)                    # (B, 3)
+        """(B, obs_dim) -> (B, seq, 3): shared tick features plus a final
+        portfolio token (its flag channel is the tick features' zero one)."""
+        tick_tokens = tick_window_features(obs, window)          # (B, window, 3)
+        portfolio_token = portfolio_features(
+            obs[:, window], obs[:, window + 1], obs[:, window - 1])  # (B, 3)
         return jnp.concatenate([tick_tokens, portfolio_token[:, None, :]], axis=1)
 
     def apply_batch(params, obs, carry):

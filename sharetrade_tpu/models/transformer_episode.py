@@ -50,7 +50,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from sharetrade_tpu.models.core import Model, ModelOut, dense, dense_init
+from sharetrade_tpu.models.core import (
+    Model, ModelOut, dense, dense_init, portfolio_features)
 from sharetrade_tpu.models.transformer import _layer_norm
 from sharetrade_tpu.ops.attention import flash_attention
 
@@ -155,13 +156,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         values = dense(params["value"], hn).astype(jnp.float32)[..., 0]
         return logits, values, kv
 
-    def _port_feats(budget, shares, anchor):
-        """(…,) scalars -> (…, 3) head-side portfolio features; anchor is
-        the step's newest price (the same normalization window mode uses
-        for its portfolio token, models/transformer.py)."""
-        anchor = jnp.maximum(anchor, _EPS)
-        return jnp.stack([budget / (anchor * 100.0), shares / 100.0,
-                          jnp.ones_like(budget)], axis=-1)
+    _port_feats = portfolio_features  # shared head-side normalization
 
     def _prefill(params, obs):
         """Episode-start pass: [first-price pads | first window], caching

@@ -67,6 +67,17 @@ def forward_flops_per_obs(model: ModelConfig, obs_dim: int,
         # gate matmul (16*h^2), then policy + value heads.
         h = model.hidden_dim
         return 2.0 * h * obs_dim + 16.0 * h * h + 2.0 * h * (acts + 1)
+    if model.kind == "tcn":
+        # models/tcn.py: per block a K-tap dilated conv (2*W*K*C^2) plus a
+        # 1x1 mix (2*W*C^2); block count auto-sized to cover the window
+        # (kernel width and sizing imported so the accounting can't drift
+        # from the model).
+        from sharetrade_tpu.models.tcn import KERNEL, default_num_blocks
+        w = obs_dim - 2
+        c = model.hidden_dim
+        per_block = 2.0 * w * KERNEL * c * c + 2.0 * w * c * c
+        return (default_num_blocks(w) * per_block
+                + 2.0 * w * 3 * c + 2.0 * c * (acts + 1 + 3))
     if model.kind == "transformer":
         seq = obs_dim - 1                               # window + summary token
         d = model.num_heads * model.head_dim
