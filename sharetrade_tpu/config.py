@@ -65,11 +65,13 @@ class ModelConfig:
     # models/transformer_episode.py).
     seq_mode: str = "window"
     # Attention partitioning: "flash" = local Pallas kernel per device;
-    # "ring" = sequence-parallel ring attention over the mesh's sp axis
-    # (ppermute K/V rotation, arbitrary sp size); "ulysses" = all_to_all
+    # "ring" = sequence-parallel attention over the mesh's sp axis — full
+    # K/V rotation in window mode (parallel/ring_attention.py), a single
+    # neighbor halo exchange in episode mode (parallel/episode_sp.py, the
+    # band crosses at most one shard boundary); "ulysses" = all_to_all
     # head<->sequence re-partition running the full-sequence local kernel
-    # per head group (sp must divide num_heads). Both need a mesh with sp>1
-    # — the long-context scale-out paths.
+    # per head group (window mode only; sp must divide num_heads). ring/
+    # ulysses need a mesh with sp>1 — the long-context scale-out paths.
     attention: str = "flash"
     # Pipeline the transformer blocks over the mesh's pp axis (one block per
     # stage; requires num_layers == pp size and a mesh with pp>1).

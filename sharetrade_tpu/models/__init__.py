@@ -62,18 +62,31 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
         use_pallas = (False if mesh is not None
                       and mesh.devices.flat[0].platform != "tpu" else None)
         if cfg.seq_mode == "episode":
-            if (cfg.attention != "flash" or cfg.pipeline_blocks
+            if (cfg.attention not in ("flash", "ring") or cfg.pipeline_blocks
                     or cfg.moe_experts):
                 raise ValueError(
-                    "model.seq_mode='episode' supports flash attention only "
-                    "(no ring/ulysses/pipeline_blocks/moe yet) — drop those "
-                    "options or use seq_mode='window'")
+                    "model.seq_mode='episode' supports attention='flash' "
+                    "(local banded) or 'ring' (sp halo exchange) — no "
+                    "ulysses/pipeline_blocks/moe; drop those options or use "
+                    "seq_mode='window'")
+            episode_attention = None
+            if cfg.attention == "ring":
+                if mesh is None or "sp" not in mesh.axis_names:
+                    raise ValueError(
+                        "model.attention='ring' needs a mesh with an 'sp' "
+                        "axis (set parallel.mesh_shape, e.g. "
+                        "{\"dp\": 2, \"sp\": 4})")
+                from sharetrade_tpu.parallel.episode_sp import (
+                    halo_banded_attention_sharded)
+                episode_attention = halo_banded_attention_sharded(
+                    mesh, seq_axis="sp", batch_axis=batch_axis,
+                    use_pallas=use_pallas)
             from sharetrade_tpu.models.transformer_episode import (
                 episode_transformer_policy)
             return episode_transformer_policy(
                 obs_dim, actions, num_layers=cfg.num_layers,
                 num_heads=cfg.num_heads, head_dim=cfg.head_dim, dtype=dtype,
-                use_pallas=use_pallas)
+                use_pallas=use_pallas, attention_fn=episode_attention)
         if cfg.attention in ("ring", "ulysses"):
             if mesh is None or "sp" not in mesh.axis_names:
                 raise ValueError(

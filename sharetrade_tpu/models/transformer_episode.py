@@ -84,14 +84,30 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                                num_layers: int = 2, num_heads: int = 4,
                                head_dim: int = 64, mlp_ratio: int = 4,
                                dtype=jnp.float32,
-                               use_pallas: bool | None = None) -> Model:
-    """Build the episode-mode policy (``ModelConfig.seq_mode="episode"``)."""
+                               use_pallas: bool | None = None,
+                               attention_fn=None) -> Model:
+    """Build the episode-mode policy (``ModelConfig.seq_mode="episode"``).
+
+    ``attention_fn(q, k, v, window) -> out`` overrides the local banded
+    flash kernel in the REPLAY pass — the sequence-parallel hook
+    (``halo_banded_attention_sharded`` shards the tick sequence over an sp
+    mesh axis, parallel/episode_sp.py). The rollout stays local regardless:
+    the incremental path is a 1-token cache attention and the episode-start
+    prefill pins the local kernel (its L*(window-1)+1 rows are too short to
+    shard), so only the replay span constrains the sp size.
+    """
     if head_dim % 2:
         raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
     window = obs_dim - 2                    # ticks per observation window
     hist_len = (num_layers - 1) * (window - 1)
     d_model = num_heads * head_dim
     sm_scale = head_dim ** -0.5
+    def local_attention(q, k, v, w):
+        return flash_attention(q, k, v, causal=True, sm_scale=sm_scale,
+                               local_window=w, use_pallas=use_pallas)
+
+    if attention_fn is None:
+        attention_fn = local_attention
 
     def init(key):
         keys = jax.random.split(key, 5 + 6 * num_layers)
@@ -123,13 +139,18 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
             })
         return params
 
-    def forward(params, series, positions, port_feats, *, want_kv=False):
+    def forward(params, series, positions, port_feats, *, want_kv=False,
+                attn=None):
         """Banded forward over a (B, S) tick series.
 
         ``port_feats`` (B, S, 3) is zero except at query positions. Returns
         (logits (B, S, A), values (B, S), per-layer rotated (k, v) lists
-        when ``want_kv`` — the rollout cache seed).
+        when ``want_kv`` — the rollout cache seed). ``attn`` overrides the
+        attention implementation (the prefill pins the LOCAL kernel: its
+        sequence is the fixed L*(window-1)+1 rows, too short to shard, and
+        rollout is the local path by contract).
         """
+        attn = attn or attention_fn
         bsz, s_len = series.shape
         x = dense(params["embed"], _tick_features(series).astype(dtype))
         kv = []
@@ -140,13 +161,12 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
             q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
             q = _rope(q, positions)
             k = _rope(k, positions)
-            attn = flash_attention(q, k, v, causal=True, sm_scale=sm_scale,
-                                   local_window=window, use_pallas=use_pallas)
+            x_attn = attn(q, k, v, window)
             if want_kv:
                 kv.append((k[:, :, -window:], v[:, :, -window:]))
-            attn = attn.transpose(0, 2, 1, 3).reshape(
+            x_attn = x_attn.transpose(0, 2, 1, 3).reshape(
                 bsz, s_len, d_model).astype(dtype)
-            x = x + dense(blk["proj"], attn)
+            x = x + dense(blk["proj"], x_attn)
             h = _layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
             x = x + dense(blk["mlp_out"], jax.nn.gelu(dense(blk["mlp_in"], h)))
         hn = _layer_norm(x, params["final_ln"]["scale"],
@@ -172,7 +192,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         port = port.at[:, -1, :].set(
             _port_feats(obs[:, window], obs[:, window + 1], win[:, -1]))
         logits, values, kv = forward(params, series, positions, port,
-                                     want_kv=True)
+                                     want_kv=True, attn=local_attention)
         cache_k = jnp.stack([k for k, _ in kv], axis=1)  # (B, L, H, W, Dh)
         cache_v = jnp.stack([v for _, v in kv], axis=1)
         carry = {

@@ -418,3 +418,113 @@ class TestPartitionedTrainingEndToEnd:
         spec = w.sharding.spec
         assert "tp" in jax.tree.leaves(tuple(spec)), spec
         orch.stop()
+
+
+class TestEpisodeSequenceParallel:
+    """Halo-exchange banded attention (parallel/episode_sp.py): the episode
+    transformer's tick sequence sharded over sp with a single neighbor
+    ppermute instead of a full ring."""
+
+    def test_halo_matches_reference_banded(self, cpu_devices):
+        from sharetrade_tpu.parallel.episode_sp import (
+            halo_banded_attention_sharded)
+        from sharetrade_tpu.ops.attention import reference_attention
+        mesh = Mesh(np.asarray(cpu_devices).reshape(8), ("sp",))
+        window = 9
+        key = jax.random.PRNGKey(0)
+        q, k, v = (jax.random.normal(kk, (2, 2, 128, 16))
+                   for kk in jax.random.split(key, 3))
+        attend = halo_banded_attention_sharded(mesh, use_pallas=False)
+        got = attend(q, k, v, window)
+        want = reference_attention(q, k, v, causal=True, local_window=window)
+        # Contract: the first window-1 positions are unspecified (shard 0's
+        # halo is zeros, standing in for "before the sequence"); the episode
+        # series construction guarantees nothing observable reads them.
+        np.testing.assert_allclose(
+            np.asarray(got[:, :, window - 1:]),
+            np.asarray(want[:, :, window - 1:]), rtol=2e-4, atol=2e-5)
+
+    def test_rejects_shard_shorter_than_band(self, cpu_devices):
+        from sharetrade_tpu.parallel.episode_sp import (
+            halo_banded_attention_sharded)
+        mesh = Mesh(np.asarray(cpu_devices).reshape(8), ("sp",))
+        q = jnp.zeros((1, 1, 32, 16))      # 4 per shard < window-1
+        attend = halo_banded_attention_sharded(mesh, use_pallas=False)
+        with pytest.raises(ValueError, match="halo band"):
+            attend(q, q, q, window=9)
+
+    def test_sp_replay_matches_local_replay(self, cpu_devices):
+        """Same params: the sp-sharded episode replay must equal the local
+        banded replay on every observable (per-step) output."""
+        from sharetrade_tpu.agents import build_agent
+        from sharetrade_tpu.agents.rollout import (
+            collect_rollout, replay_forward)
+        from sharetrade_tpu.env import trading
+
+        def make(attention, mesh):
+            cfg = FrameworkConfig()
+            cfg.learner.algo = "ppo"
+            cfg.model.kind = "transformer"
+            cfg.model.seq_mode = "episode"
+            cfg.model.attention = attention
+            cfg.model.num_layers = 2
+            cfg.model.num_heads = 2
+            cfg.model.head_dim = 16
+            cfg.env.window = 16
+            cfg.parallel.num_workers = 4
+            cfg.learner.unroll_len = 34
+            cfg.runtime.chunk_steps = 34
+            env = trading.make_trading_env(
+                jnp.linspace(10.0, 20.0, 64), window=16)
+            return build_agent(cfg, env, mesh=mesh), env
+
+        mesh = Mesh(np.asarray(cpu_devices).reshape(4, 2), ("dp", "sp"))
+        local_agent, env = make("flash", mesh)
+        sp_agent, _ = make("ring", mesh)
+        ts = local_agent.init(jax.random.PRNGKey(0))
+        ts, traj, _, init_carry = collect_rollout(
+            local_agent.model, env, ts, 34, 4)
+        logits_local, values_local, _ = replay_forward(
+            local_agent.model, ts.params, traj, init_carry)
+        logits_sp, values_sp, _ = replay_forward(
+            sp_agent.model, ts.params, traj, init_carry)
+        np.testing.assert_allclose(np.asarray(logits_sp),
+                                   np.asarray(logits_local),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(values_sp),
+                                   np.asarray(values_local),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_episode_ring_requires_sp_mesh(self, cpu_devices):
+        from sharetrade_tpu.config import ModelConfig
+        from sharetrade_tpu.models import build_model
+        cfg = ModelConfig(kind="transformer", seq_mode="episode",
+                          attention="ring", num_heads=2, head_dim=16)
+        with pytest.raises(ValueError, match="sp"):
+            build_model(cfg, 18)
+
+    @pytest.mark.slow
+    def test_episode_sp_training_via_config(self, tmp_path, cpu_devices):
+        """Full PPO training through the Orchestrator: episode mode + sp
+        halo attention selected purely via config."""
+        from sharetrade_tpu.runtime import Orchestrator, ReplyState
+        cfg = FrameworkConfig()
+        cfg.learner.algo = "ppo"
+        cfg.model.kind = "transformer"
+        cfg.model.seq_mode = "episode"
+        cfg.model.attention = "ring"
+        cfg.model.num_layers = 2
+        cfg.model.num_heads = 2
+        cfg.model.head_dim = 16
+        cfg.env.window = 16
+        cfg.parallel.num_workers = 4
+        cfg.parallel.mesh_shape = {"dp": 4, "sp": 2}
+        cfg.learner.unroll_len = 8
+        cfg.runtime.chunk_steps = 8
+        cfg.runtime.checkpoint_dir = str(tmp_path / "ckpts")
+        mesh = build_mesh(cfg.parallel, devices=cpu_devices)
+        orch = Orchestrator(cfg, mesh=mesh)
+        orch.send_training_data(np.linspace(10.0, 20.0, 40, dtype=np.float32))
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.get_avg().ok and np.isfinite(orch.get_avg().value)
