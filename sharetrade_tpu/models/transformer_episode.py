@@ -8,11 +8,12 @@ ONCE, run sliding-window (banded) flash attention over the episode's tick
 sequence (ops/attention.py local_window), and read one output per env step
 — an O(T + L*window) forward replaces T O(window) window forwards (~15-50x
 fewer tokens for the BASELINE unrolls). This is also the long-context
-story: the training pass handles long unrolls (the full 5,843-step MSFT
+story: the training pass handles long unrolls (the full 5,845-step MSFT
 episode fits one banded pass) as ONE sequence instead of a stack of
-windows. (The kernel currently stages full-length K/V per program, so
-sequences are bounded by VMEM at ~tens of thousands of tokens; tiling K/V
-over the band would lift that.)
+windows; past ~512k K/V elements the kernel switches to streaming one K/V
+block per grid step (ops/attention.py ``_STREAM_KV_ELEMS``), so sequence
+length is bounded by HBM, not VMEM — 32k-token banded gradients compile
+and run.
 
 Architecture notes (deliberately different from window mode — this is a
 redesign, not a re-tiling):

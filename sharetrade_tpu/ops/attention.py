@@ -26,6 +26,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 BLOCK_Q = 128
 BLOCK_K = 128
@@ -193,6 +194,16 @@ def _flash_forward(q, k, v, causal, sm_scale, local_window, interpret):
     kv_pad = kp.shape[1]
     block_q, block_k = _block_size(t_pad), _block_size(kv_pad)
 
+    if local_window is not None and kv_pad * d_pad > _STREAM_KV_ELEMS:
+        # Banded long sequence: stream K/V one block per grid step — VMEM
+        # holds O(block + window) regardless of sequence length.
+        out, lse = _banded_forward(
+            qp, kp, vp, d_pad, (kv_len, block_q, block_k), sm_scale,
+            local_window, interpret)
+        out = out.reshape(batch, heads, t_pad, d_pad)[:, :, :seq_len, :head_dim]
+        lse = lse.reshape(batch, heads, 8, t_pad)[:, :, 0, :seq_len]
+        return out, lse
+
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, causal=causal,
         sm_scale=sm_scale, kv_len=kv_len, kv_pad=kv_pad,
@@ -220,6 +231,137 @@ def _flash_forward(q, k, v, causal, sm_scale, local_window, interpret):
     out = out.reshape(batch, heads, t_pad, d_pad)[:, :, :seq_len, :head_dim]
     lse = lse.reshape(batch, heads, 8, t_pad)[:, :, 0, :seq_len]
     return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Streaming banded kernels: when local_window is set, K/V stream through VMEM
+# one block per grid step (a third grid axis walks the band) instead of the
+# whole padded K/V staging per program. VMEM then holds O(block + window)
+# regardless of sequence length, so episode-mode replay spans are bounded by
+# HBM, not by the ~16 MB VMEM (the full-KV kernels above keep serving the
+# local_window=None paths, which genuinely need all keys).
+#
+# The band for query block i spans key rows [i*bq - W + 1, (i+1)*bq - 1]:
+# at most cdiv(bq + W - 1, bk) + 1 key blocks — a STATIC count, so the grid
+# axis has fixed extent and out-of-range steps (clamped by the index_map)
+# are masked via virtual-vs-clipped block-index comparison.
+#
+# Short sequences stay on the full-KV kernels (streaming's extra grid steps
+# cost ~20% there); the dispatch threshold is the per-tensor K/V element
+# count beyond which full staging approaches the VMEM budget.
+
+_STREAM_KV_ELEMS = 1 << 19          # 512k elems ≈ 2 MB f32 per K/V tensor
+
+
+def _band_extent(window: int, span_block: int, other_block: int,
+                 num_other_blocks: int) -> int:
+    return min(num_other_blocks, -(-(span_block + window - 1) // other_block) + 1)
+
+
+def _band_first_k(i, block_q: int, block_k: int, window: int):
+    """First key block of query block ``i``'s band — the ONE definition the
+    index_maps and the in-kernel virtual/clipped masks must share."""
+    return jnp.maximum(0, (i * block_q - window + 1) // block_k)
+
+
+def _band_k_index(block_q: int, block_k: int, window: int,
+                  num_k_blocks: int):
+    """BlockSpec index_map walking query block ``i``'s band at step ``j``."""
+    def index(b, i, j):
+        return (b, jnp.minimum(_band_first_k(i, block_q, block_k, window) + j,
+                               num_k_blocks - 1), 0)
+    return index
+
+
+def _flash_banded_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                             acc_ref, m_ref, l_ref, *, block_k: int,
+                             sm_scale: float, kv_len: int,
+                             num_k_blocks: int, window: int,
+                             band_blocks: int):
+    q_block = q_ref.shape[1]
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    virtual = _band_first_k(qi, q_block, block_k, window) + j
+    clipped = jnp.minimum(virtual, num_k_blocks - 1)  # what index_map fetched
+
+    q = q_ref[0]
+    k_blk = k_ref[0]
+    v_blk = v_ref[0]
+    s = _dot(q, k_blk.T) * sm_scale
+    row_ids = qi * q_block + jax.lax.broadcasted_iota(
+        jnp.int32, (q_block, block_k), 0)
+    col_ids = clipped * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (q_block, block_k), 1)
+    mask = ((col_ids < kv_len) & (col_ids <= row_ids)
+            & (col_ids > row_ids - window) & (virtual == clipped))
+    s = jnp.where(mask, s, _NEG_INF)
+
+    m_prev = m_ref[0]
+    l_prev = l_ref[0]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    acc_ref[...] = (acc_ref[...] * alpha[:, None]
+                    + _dot(p.astype(v_blk.dtype), v_blk))
+    m_ref[...] = jnp.broadcast_to(m_new[None, :], m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new[None, :], l_ref.shape)
+
+    @pl.when(j == band_blocks - 1)
+    def _finish():
+        l = l_ref[0]
+        m = m_ref[0]
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_row = jnp.where(l > 0, m + jnp.log(l_safe), 0.0)
+        lse_ref[0] = jnp.broadcast_to(lse_row[None, :], (8, q_block))
+
+
+def _banded_forward(qp, kp, vp, d_pad, seq_params, sm_scale, window,
+                    interpret):
+    """Streaming-banded forward over padded (bh, t_pad, d_pad) inputs."""
+    bh, t_pad, _ = qp.shape
+    kv_pad = kp.shape[1]
+    kv_len, block_q, block_k = seq_params
+    num_k_blocks = kv_pad // block_k
+    band_blocks = _band_extent(window, block_q, block_k, num_k_blocks)
+
+    k_index = _band_k_index(block_q, block_k, window, num_k_blocks)
+
+    kernel = functools.partial(
+        _flash_banded_fwd_kernel, block_k=block_k, sm_scale=sm_scale,
+        kv_len=kv_len, num_k_blocks=num_k_blocks, window=window,
+        band_blocks=band_blocks)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, t_pad // block_q, band_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), k_index),
+            pl.BlockSpec((1, block_k, d_pad), k_index),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t_pad, d_pad), qp.dtype),
+            jax.ShapeDtypeStruct((bh, 8, t_pad), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d_pad), jnp.float32),
+            pltpu.VMEM((8, block_q), jnp.float32),
+            pltpu.VMEM((8, block_q), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -323,6 +465,162 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def _flash_banded_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                            dq_ref, dq_acc_ref, *, block_k: int,
+                            sm_scale: float, kv_len: int, num_k_blocks: int,
+                            window: int, band_blocks: int):
+    q_block = q_ref.shape[1]
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    virtual = _band_first_k(qi, q_block, block_k, window) + j
+    clipped = jnp.minimum(virtual, num_k_blocks - 1)
+
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0][0]
+    delta = delta_ref[0][0]
+    k_blk = k_ref[0]
+    v_blk = v_ref[0]
+    s = _dot(q, k_blk.T) * sm_scale
+    row_ids = qi * q_block + jax.lax.broadcasted_iota(
+        jnp.int32, (q_block, block_k), 0)
+    col_ids = clipped * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (q_block, block_k), 1)
+    mask = ((col_ids < kv_len) & (col_ids <= row_ids)
+            & (col_ids > row_ids - window) & (virtual == clipped))
+    p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+    dp = _dot(do, v_blk.T)
+    ds = (p * (dp - delta[:, None]) * sm_scale).astype(k_blk.dtype)
+    dq_acc_ref[...] = dq_acc_ref[...] + _dot(ds, k_blk)
+
+    @pl.when(j == band_blocks - 1)
+    def _finish():
+        dq_ref[0] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _flash_banded_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                             dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
+                             block_q: int, sm_scale: float, kv_len: int,
+                             num_q_blocks: int, window: int,
+                             band_blocks: int):
+    block_k = k_ref.shape[1]
+    kb = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    first = (kb * block_k) // block_q          # causal: earlier q blocks see nothing
+    virtual = first + j
+    clipped = jnp.minimum(virtual, num_q_blocks - 1)
+
+    k_blk = k_ref[0]
+    v_blk = v_ref[0]
+    q_blk = q_ref[0]
+    do_blk = do_ref[0]
+    lse_blk = lse_ref[0][0]
+    delta_blk = delta_ref[0][0]
+
+    s = _dot(q_blk, k_blk.T) * sm_scale
+    row_ids = clipped * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    col_ids = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = ((col_ids < kv_len) & (col_ids <= row_ids)
+            & (col_ids > row_ids - window) & (virtual == clipped))
+    p = jnp.where(mask, jnp.exp(s - lse_blk[:, None]), 0.0)
+    dv_acc_ref[...] = dv_acc_ref[...] + _dot(p.astype(do_blk.dtype).T, do_blk)
+    dp = _dot(do_blk, v_blk.T)
+    ds = (p * (dp - delta_blk[:, None]) * sm_scale).astype(q_blk.dtype)
+    dk_acc_ref[...] = dk_acc_ref[...] + _dot(ds.T, q_blk)
+
+    @pl.when(j == band_blocks - 1)
+    def _finish():
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _banded_backward(qp, kp, vp, gp, lse_p, delta, d_pad, seq_params,
+                     sm_scale, window, interpret):
+    """Streaming-banded dQ and dK/dV over padded (bh, …) inputs."""
+    bh, t_pad, _ = qp.shape
+    kv_pad = kp.shape[1]
+    kv_len, block_q, block_k = seq_params
+    num_k_blocks = kv_pad // block_k
+    num_q_blocks = t_pad // block_q
+
+    k_index = _band_k_index(block_q, block_k, window, num_k_blocks)
+
+    band_k = _band_extent(window, block_q, block_k, num_k_blocks)
+    dq_kernel = functools.partial(
+        _flash_banded_dq_kernel, block_k=block_k, sm_scale=sm_scale,
+        kv_len=kv_len, num_k_blocks=num_k_blocks, window=window,
+        band_blocks=band_k)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, num_q_blocks, band_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), k_index),
+            pl.BlockSpec((1, block_k, d_pad), k_index),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_pad, d_pad), qp.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d_pad), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, gp, lse_p, delta)
+
+    def q_index(b, i, j):
+        first = (i * block_k) // block_q
+        return (b, jnp.minimum(first + j, num_q_blocks - 1), 0)
+
+    def qrow_index(b, i, j):
+        first = (i * block_k) // block_q
+        return (b, 0, jnp.minimum(first + j, num_q_blocks - 1))
+
+    band_q = _band_extent(window, block_k, block_q, num_q_blocks)
+    dkv_kernel = functools.partial(
+        _flash_banded_dkv_kernel, block_q=block_q, sm_scale=sm_scale,
+        kv_len=kv_len, num_q_blocks=num_q_blocks, window=window,
+        band_blocks=band_q)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, num_k_blocks, band_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), q_index),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d_pad), q_index),
+            pl.BlockSpec((1, 8, block_q), qrow_index),
+            pl.BlockSpec((1, 8, block_q), qrow_index),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, kv_pad, d_pad), kp.dtype),
+            jax.ShapeDtypeStruct((bh, kv_pad, d_pad), vp.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, gp, lse_p, delta)
+    return dq, dk, dv
+
+
 def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, local_window,
                     interpret):
     batch, heads, seq_len, head_dim = q.shape
@@ -345,6 +643,16 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, local_window,
     lse_p = jnp.broadcast_to(lse_p[:, None, :], (bh, 8, t_pad))
 
     block_q, block_k = _block_size(t_pad), _block_size(kv_pad)
+
+    if local_window is not None and kv_pad * d_pad > _STREAM_KV_ELEMS:
+        dq, dk, dv = _banded_backward(
+            qp, kp, vp, gp, lse_p, delta, d_pad,
+            (kv_len, block_q, block_k), sm_scale, local_window, interpret)
+        dq = dq.reshape(batch, heads, t_pad, d_pad)[:, :, :seq_len, :head_dim]
+        dk = dk.reshape(batch, heads, kv_pad, d_pad)[:, :, :kv_len, :head_dim]
+        dv = dv.reshape(batch, heads, kv_pad, d_pad)[:, :, :kv_len, :head_dim]
+        return dq, dk, dv
+
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, block_k=block_k, causal=causal,
         sm_scale=sm_scale, kv_len=kv_len, kv_pad=kv_pad,

@@ -21,6 +21,9 @@ from sharetrade_tpu.parallel.moe import (  # noqa: F401
     moe_apply_topk_a2a,
     moe_apply_topk_sharded,
 )
+from sharetrade_tpu.parallel.episode_sp import (  # noqa: F401
+    halo_banded_attention_sharded,
+)
 from sharetrade_tpu.parallel.pipeline import pipeline_apply, stack_stage_params  # noqa: F401
 from sharetrade_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,

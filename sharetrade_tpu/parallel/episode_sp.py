@@ -50,7 +50,10 @@ def halo_banded_attention_sharded(mesh: Mesh, *, seq_axis: str = "sp",
     n = mesh.shape[seq_axis]
 
     def attend(q, k, v, window: int):
-        if n == 1:
+        if n == 1 or window == 1:
+            # One shard, or a 1-wide band (each query attends only itself:
+            # the halo is empty and kl[:, :, -0:] would grab the WHOLE
+            # shard) — the local kernel is exact either way.
             return flash_attention(q, k, v, causal=True, local_window=window,
                                    use_pallas=use_pallas)
         seq = q.shape[2]

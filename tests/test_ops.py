@@ -219,3 +219,40 @@ class TestLocalWindow:
         with pytest.raises(ValueError, match="causal"):
             flash_attention(q, q, q, causal=False, local_window=4,
                             use_pallas=True)
+
+    @pytest.mark.slow
+    def test_streaming_path_matches_reference_long_seq(self):
+        # 8192 x d128 crosses the _STREAM_KV_ELEMS dispatch threshold, so
+        # this exercises the STREAMING banded kernels (K/V one block per
+        # grid step) against the dense-mask reference — short-seq tests
+        # above cover the full-KV banded path.
+        from sharetrade_tpu.ops import attention as att
+        q, k, v = _rand_qkv(jax.random.PRNGKey(12), batch=1, heads=1,
+                            seq=8192, d=128)
+        assert 8192 * 128 > att._STREAM_KV_ELEMS
+        got = flash_attention(q, k, v, causal=True, local_window=202,
+                              use_pallas=True)
+        want = reference_attention(q, k, v, causal=True, local_window=202)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.slow
+    def test_streaming_gradients_match_reference_long_seq(self):
+        from sharetrade_tpu.ops import attention as att
+        q, k, v = _rand_qkv(jax.random.PRNGKey(13), batch=1, heads=1,
+                            seq=8192, d=128)
+        assert 8192 * 128 > att._STREAM_KV_ELEMS
+
+        def loss_kernel(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, local_window=202, use_pallas=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(
+                q, k, v, causal=True, local_window=202) ** 2)
+
+        g_kernel = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_kernel, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-4)
