@@ -26,7 +26,7 @@ REFERENCE_CEILING = 58_450 / 1_005.0  # see bench.py derivation
 
 
 def bench_config(name: str, cfg: FrameworkConfig, *, chunks: int) -> dict:
-    series = synthetic_price_series(length=6046)
+    series = synthetic_price_series(length=cfg.data.synthetic_length)
     env_params = trading.env_from_prices(
         series.prices, window=cfg.env.window,
         initial_budget=cfg.env.initial_budget)
@@ -160,6 +160,16 @@ def make_configs() -> dict[str, FrameworkConfig]:
             learner__algo="ppo", model__kind="transformer",
             model__seq_mode="episode",
             learner__unroll_len=5845, runtime__chunk_steps=5845,
+            model__num_layers=2, model__num_heads=2, model__head_dim=128,
+            model__dtype="bfloat16"),
+        # Long-context ceiling: a 32,768-step synthetic episode trained as
+        # ONE chunk — the replay is a ~33k-token banded pass through the
+        # STREAMING kernels (K/V one block per grid step; VMEM-unbounded).
+        "ppo_tr_episode_32k_ctx": base(
+            learner__algo="ppo", model__kind="transformer",
+            model__seq_mode="episode",
+            data__synthetic_length=32768 + 202,
+            learner__unroll_len=32768, runtime__chunk_steps=32768,
             model__num_layers=2, model__num_heads=2, model__head_dim=128,
             model__dtype="bfloat16"),
         # Mesh-sharded row (ParallelConfig.mesh_shape): dp-sharded agents,

@@ -264,6 +264,12 @@ def _band_first_k(i, block_q: int, block_k: int, window: int):
     return jnp.maximum(0, (i * block_q - window + 1) // block_k)
 
 
+def _band_first_q(i, block_q: int, block_k: int):
+    """First query block that can see key block ``i`` (causal lower bound)
+    — shared by the dkv kernel and its q/lse/delta index_maps."""
+    return (i * block_k) // block_q
+
+
 def _band_k_index(block_q: int, block_k: int, window: int,
                   num_k_blocks: int):
     """BlockSpec index_map walking query block ``i``'s band at step ``j``."""
@@ -517,8 +523,7 @@ def _flash_banded_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    first = (kb * block_k) // block_q          # causal: earlier q blocks see nothing
-    virtual = first + j
+    virtual = _band_first_q(kb, block_q, block_k) + j
     clipped = jnp.minimum(virtual, num_q_blocks - 1)
 
     k_blk = k_ref[0]
@@ -581,12 +586,12 @@ def _banded_backward(qp, kp, vp, gp, lse_p, delta, d_pad, seq_params,
     )(qp, kp, vp, gp, lse_p, delta)
 
     def q_index(b, i, j):
-        first = (i * block_k) // block_q
-        return (b, jnp.minimum(first + j, num_q_blocks - 1), 0)
+        return (b, jnp.minimum(_band_first_q(i, block_q, block_k) + j,
+                               num_q_blocks - 1), 0)
 
     def qrow_index(b, i, j):
-        first = (i * block_k) // block_q
-        return (b, 0, jnp.minimum(first + j, num_q_blocks - 1))
+        return (b, 0, jnp.minimum(_band_first_q(i, block_q, block_k) + j,
+                                  num_q_blocks - 1))
 
     band_q = _band_extent(window, block_k, block_q, num_q_blocks)
     dkv_kernel = functools.partial(
