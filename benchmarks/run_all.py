@@ -168,7 +168,7 @@ def make_configs() -> dict[str, FrameworkConfig]:
         "ppo_tr_episode_32k_ctx": base(
             learner__algo="ppo", model__kind="transformer",
             model__seq_mode="episode",
-            data__synthetic_length=32768 + 202,
+            data__synthetic_length=32768 + 201,
             learner__unroll_len=32768, runtime__chunk_steps=32768,
             model__num_layers=2, model__num_heads=2, model__head_dim=128,
             model__dtype="bfloat16"),

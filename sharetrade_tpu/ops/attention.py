@@ -434,7 +434,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     num_q_blocks = t_pad // block_q
     # Causal: query blocks strictly before this key block see none of it.
-    qb_start = (kb * block_k) // block_q if causal else 0
+    qb_start = _band_first_q(kb, block_q, block_k) if causal else 0
     qb_end = num_q_blocks
     if local_window is not None:
         # Banded: key c is seen only by queries p ≤ c + W - 1; blocks past
