@@ -151,6 +151,14 @@ def make_configs() -> dict[str, FrameworkConfig]:
             learner__unroll_len=128, runtime__chunk_steps=128,
             model__num_layers=2, model__num_heads=2, model__head_dim=128,
             model__dtype="bfloat16"),
+        # Longer unrolls amortize the sequential rollout against the one
+        # banded replay pass — the episode-mode throughput sweet spot.
+        "ppo_tr_episode_b128_u1024_bf16": base(
+            learner__algo="ppo", model__kind="transformer",
+            model__seq_mode="episode", parallel__num_workers=128,
+            learner__unroll_len=1024, runtime__chunk_steps=1024,
+            model__num_layers=2, model__num_heads=2, model__head_dim=128,
+            model__dtype="bfloat16"),
         # The reference's ENTIRE workload as one compiled chunk: 10 workers x
         # the full 5,845-step episode (6,046 prices - 201 window,
         # env/trading.py num_steps), rollout + GAE + clipped updates, with
