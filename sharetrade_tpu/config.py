@@ -23,6 +23,10 @@ class DataConfig:
     """L1 market-data layer (reference: SharePriceGetter.scala)."""
 
     csv_path: str | None = None        # price CSV ("price, date" rows); None -> synthetic
+    # HTTP market-data endpoint serving the same CSV rows; "{symbol}" is
+    # substituted (the reference FAKES this call, SharePriceGetter.scala:83
+    # — here it's real). Takes precedence over csv_path.
+    http_url: str | None = None
     synthetic_length: int = 6046       # matches the MSFT fixture's line count
     synthetic_seed: int = 1992
     journal_dir: str = "journal"       # event journal root (reference: LevelDB dir)

@@ -55,6 +55,43 @@ def csv_provider(path: str) -> Callable[..., PriceSeries]:
     return fetch
 
 
+def http_provider(url_template: str, *,
+                  timeout: float = 30.0) -> Callable[..., PriceSeries]:
+    """Fetch ``price, date`` CSV rows over HTTP — the market-data API the
+    reference only pretends to call (``queryData`` is documented as "faking
+    a http query" while reading a classpath file,
+    SharePriceGetter.scala:83-102). ``url_template`` may carry a
+    ``{symbol}`` placeholder, e.g. ``http://quotes.internal/prices/{symbol}.csv``.
+
+    Responses parse through the same line parser as local CSV files
+    (data/ingest.py ``parse_price_lines``: bad rows dropped, date-sorted),
+    so the two sources are byte-interchangeable; fetch failures raise
+    (urllib.error) and surface through the service's caller."""
+    from urllib.parse import quote
+    from urllib.request import urlopen
+
+    from sharetrade_tpu.data.ingest import parse_price_lines
+
+    def fetch(symbol: str, start=None, end=None) -> PriceSeries:
+        # quote() so symbols with spaces/slashes ('BRK B', 'NYSE/BRK.A')
+        # can't break the path; replace() not format() so templates may
+        # contain other literal braces.
+        url = url_template.replace("{symbol}", quote(symbol, safe=""))
+        with urlopen(url, timeout=timeout) as resp:
+            text = resp.read().decode("utf-8", errors="replace")
+        series = parse_price_lines(symbol, text.splitlines())
+        if series.prices.size == 0:
+            # A 200 whose body parses to nothing (error page, captive
+            # portal, truncated response) must fail LOUDLY: caching or
+            # journaling an empty series would poison every later request
+            # for the symbol, surviving restarts via replay.
+            raise ValueError(
+                f"HTTP price fetch for {symbol!r} from {url} returned no "
+                f"parsable 'price, date' rows ({len(text)} bytes)")
+        return series
+    return fetch
+
+
 def synthetic_provider(length: int = 6046, seed: int = 1992) -> Callable[..., PriceSeries]:
     def fetch(symbol: str, start=None, end=None) -> PriceSeries:
         # Per-symbol seed derivation: distinct symbols get distinct (but
@@ -73,7 +110,9 @@ class PriceDataService:
     ):
         cfg = config or DataConfig()
         if provider is None:
-            if cfg.csv_path:
+            if cfg.http_url:
+                provider = http_provider(cfg.http_url)
+            elif cfg.csv_path:
                 provider = csv_provider(cfg.csv_path)
             else:
                 provider = synthetic_provider(cfg.synthetic_length, cfg.synthetic_seed)

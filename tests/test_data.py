@@ -181,3 +181,111 @@ def test_native_journal_compact(tmp_journal_path):
     # Python backend reads the compacted file (byte compatibility holds).
     with Journal(tmp_journal_path) as j:
         assert len(j) == 2
+
+
+class TestHttpProvider:
+    """The market-data HTTP fetch the reference fakes
+    (SharePriceGetter.scala:83 "faking a http query"), made real and
+    exercised against a live localhost server."""
+
+    @pytest.fixture
+    def price_server(self):
+        import http.server
+        import threading
+
+        body = b"56.08, 1992-07-22\n55.65, 1992-07-23\nbad row\n57.01, 1992-07-24\n"
+        requested = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                requested.append(self.path)
+                if self.path.startswith("/prices/"):
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/csv")
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def log_message(self, *args):
+                pass
+
+        server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            yield f"http://127.0.0.1:{server.server_port}", requested
+        finally:
+            server.shutdown()
+            thread.join()
+
+    def test_fetch_parses_like_csv(self, price_server):
+        from sharetrade_tpu.data.service import http_provider
+        base, requested = price_server
+        fetch = http_provider(base + "/prices/{symbol}.csv")
+        series = fetch("MSFT")
+        assert requested == ["/prices/MSFT.csv"]
+        assert series.symbol == "MSFT"
+        assert list(series.prices) == [56.08, 55.65, 57.01]  # bad row dropped
+        assert str(series.dates[0]) == "1992-07-22"
+
+    def test_service_over_http_caches_and_journals(self, price_server,
+                                                   tmp_journal_path):
+        from sharetrade_tpu.config import DataConfig
+        from sharetrade_tpu.data.journal import Journal
+        from sharetrade_tpu.data.service import PriceDataService
+        base, requested = price_server
+        cfg = DataConfig(http_url=base + "/prices/{symbol}.csv")
+        service = PriceDataService(journal=Journal(tmp_journal_path),
+                                   config=cfg)
+        first = service.request("MSFT")
+        again = service.request("MSFT")     # served from cache, no refetch
+        assert len(requested) == 1
+        assert list(first.series.prices) == list(again.series.prices)
+        service.close()
+        # Journal replay rebuilds the cache without touching the network.
+        revived = PriceDataService(journal=Journal(tmp_journal_path),
+                                   config=cfg)
+        assert len(requested) == 1
+        assert list(revived.request("MSFT").series.prices) == [
+            56.08, 55.65, 57.01]
+        revived.close()
+
+    def test_fetch_failure_raises(self):
+        from urllib.error import URLError
+        from sharetrade_tpu.data.service import http_provider
+        fetch = http_provider("http://127.0.0.1:9/prices/{symbol}.csv",
+                              timeout=0.5)
+        with pytest.raises((URLError, OSError)):
+            fetch("MSFT")
+
+    def test_empty_body_fails_loudly(self):
+        import http.server
+        import threading
+        from sharetrade_tpu.data.service import http_provider
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"<html>maintenance</html>")
+
+            def log_message(self, *args):
+                pass
+
+        server = http.server.HTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            fetch = http_provider(
+                f"http://127.0.0.1:{server.server_port}/p/{{symbol}}")
+            with pytest.raises(ValueError, match="no parsable"):
+                fetch("MSFT")
+        finally:
+            server.shutdown()
+
+    def test_symbol_is_url_quoted(self, price_server):
+        from sharetrade_tpu.data.service import http_provider
+        base, requested = price_server
+        fetch = http_provider(base + "/prices/{symbol}.csv")
+        fetch("BRK B")
+        assert requested[-1] == "/prices/BRK%20B.csv"
