@@ -334,6 +334,7 @@ struct AsyncWriter {
 void writer_loop(AsyncWriter* w) {
   std::vector<std::string> batch;
   for (;;) {
+    bool poisoned;
     {
       std::unique_lock<std::mutex> lk(w->mu);
       w->cv_submit.wait(lk, [&] { return w->stop || !w->queue.empty(); });
@@ -344,8 +345,23 @@ void writer_loop(AsyncWriter* w) {
       }
       w->queued_bytes = 0;
       w->idle = false;
+      poisoned = w->error != 0;
     }
     w->cv_space.notify_all();
+    if (poisoned) {
+      // After a write error the file may end in a partially-written frame;
+      // the framed reader stops at that torn record, so any frame appended
+      // past it would be silently invisible on recovery. Drain-and-drop so
+      // the file ends exactly at the torn tail the recovery logic handles
+      // (producers see the sticky error from submit and fail loudly).
+      batch.clear();
+      {
+        std::lock_guard<std::mutex> lk(w->mu);
+        w->idle = w->queue.empty();
+      }
+      w->cv_space.notify_all();
+      continue;
+    }
     int err = 0;
     for (const std::string& payload : batch) {
       uint8_t header[8];

@@ -112,11 +112,31 @@ def batched_carry(model, num_agents: int):
 
 def portfolio_metrics(env: TradingEnv, env_state) -> dict[str, jax.Array]:
     """The router's aggregation: mean/std over worker portfolios
-    (TrainerRouterActor.scala:137-151) plus richer distribution stats."""
+    (TrainerRouterActor.scala:137-151) plus richer distribution stats.
+
+    Two aggregation views are emitted side by side:
+
+    - ``portfolio_mean``/``portfolio_std``: continuous stats over ALL agents,
+      including in-flight ones (progressive — richer than the reference).
+    - ``portfolio_mean_trained``/``portfolio_std_trained``: stats over only
+      the agents whose episode cursor reached the horizon — the reference's
+      exact ``GetAvg`` observable, which asks the *trained* children only
+      (TrainerRouterActor.scala:84-95,137-139). ``trained_workers`` carries
+      the mask count so the host can answer NotComputed when it is zero
+      (masked stats are 0-filled then, never NaN, to stay jit-safe).
+    """
     values = jax.vmap(env.portfolio_value)(env_state)
+    done = (env_state.t >= env.num_steps).astype(jnp.float32)
+    n_done = jnp.sum(done)
+    safe_n = jnp.maximum(n_done, 1.0)
+    mean_t = jnp.sum(values * done) / safe_n
+    var_t = jnp.sum(done * (values - mean_t) ** 2) / safe_n
     return {
         "portfolio_mean": jnp.mean(values),
         "portfolio_std": jnp.std(values),
         "portfolio_min": jnp.min(values),
         "portfolio_max": jnp.max(values),
+        "portfolio_mean_trained": mean_t,
+        "portfolio_std_trained": jnp.sqrt(var_t),
+        "trained_workers": n_done,
     }

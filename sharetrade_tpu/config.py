@@ -162,6 +162,11 @@ class RuntimeConfig:
     max_restarts: int = 10
     poll_interval_s: float = 0.05
     profile_dir: str | None = None     # jax.profiler trace output
+    # GetAvg/GetStd reply semantics: False = progressive stats over ALL
+    # agents (richer than the reference); True = the reference's exact
+    # observable — average only workers whose episode finished, NotComputed
+    # until at least one has (TrainerRouterActor.scala:84-95,137-139).
+    query_trained_only: bool = False
 
 
 @dataclass

@@ -66,11 +66,24 @@ def http_provider(url_template: str, *,
     Responses parse through the same line parser as local CSV files
     (data/ingest.py ``parse_price_lines``: bad rows dropped, date-sorted),
     so the two sources are byte-interchangeable; fetch failures raise
-    (urllib.error) and surface through the service's caller."""
-    from urllib.parse import quote
+    (urllib.error) and surface through the service's caller.
+
+    Only http/https URLs are accepted (urlopen would happily serve
+    ``file://`` — a config-injection path into the price cache/journal) and
+    the response body is capped at ``max_bytes`` so a hostile or
+    misconfigured endpoint can't balloon host memory."""
+    from urllib.parse import quote, urlsplit
     from urllib.request import urlopen
 
     from sharetrade_tpu.data.ingest import parse_price_lines
+
+    max_bytes = 64 * 1024 * 1024   # 64 MiB ≈ 3000 years of daily closes
+
+    scheme = urlsplit(url_template).scheme.lower()
+    if scheme not in ("http", "https"):
+        raise ValueError(
+            f"http_provider requires an http(s) URL, got scheme {scheme!r} "
+            f"in {url_template!r}")
 
     def fetch(symbol: str, start=None, end=None) -> PriceSeries:
         # quote() so symbols with spaces/slashes ('BRK B', 'NYSE/BRK.A')
@@ -78,7 +91,12 @@ def http_provider(url_template: str, *,
         # contain other literal braces.
         url = url_template.replace("{symbol}", quote(symbol, safe=""))
         with urlopen(url, timeout=timeout) as resp:
-            text = resp.read().decode("utf-8", errors="replace")
+            body = resp.read(max_bytes + 1)
+        if len(body) > max_bytes:
+            raise ValueError(
+                f"HTTP price fetch for {symbol!r} from {url} exceeded the "
+                f"{max_bytes}-byte response cap")
+        text = body.decode("utf-8", errors="replace")
         series = parse_price_lines(symbol, text.splitlines())
         if series.prices.size == 0:
             # A 200 whose body parses to nothing (error page, captive

@@ -251,10 +251,25 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                         aux=jnp.float32(0.0)), carry
 
     def apply_batch(params, obs, carry):
-        # All agents advance in lockstep (the env steps the whole batch
-        # together), so the episode-start predicate is uniform: t[0].
+        """Batched rollout step.
+
+        INVARIANT: the whole batch must sit at the same episode step —
+        prefill-vs-incremental dispatches on ``carry["t"][0]`` alone. This
+        holds for every env in this framework (the batch resets and steps in
+        lockstep; rollout.py freezes finished agents in place rather than
+        resetting them), but an env with per-agent resets or a
+        heterogeneously-restored carry would silently run the wrong path for
+        some agents. Eager (non-traced) calls assert the uniformity."""
+        t = carry["t"]
+        if not isinstance(t, jax.core.Tracer):
+            import numpy as _np
+            tn = _np.asarray(t)
+            if tn.size and (tn.min() != tn.max()):
+                raise ValueError(
+                    f"episode transformer requires a lockstep batch: carry "
+                    f"t spans [{tn.min()}, {tn.max()}]")
         return jax.lax.cond(
-            carry["t"][0] == 0,
+            t[0] == 0,
             lambda c: _prefill(params, obs),
             lambda c: _incremental(params, obs, c),
             carry)

@@ -17,12 +17,13 @@ kernel (ops/attention.py ``local_window``) computes exactly the halo-band
 semantics; the pad rows' outputs are sliced off.
 
 Shard 0 has no predecessor: its ``ppermute`` destination is unwritten and
-arrives as ZEROS. That is safe — not by masking, but by construction of the
-episode series (models/transformer_episode.py): the first ``hist_len +
-window - 1`` positions are padding/history whose outputs are never read,
-and every REAL query position's receptive field (through all layers) stays
-within the materialized series, so zero-halo garbage can only flow into
-outputs that are discarded.
+arrives as ZEROS. Zero keys would still receive softmax weight (score 0,
+not -inf), so shard 0's first ``window-1`` outputs are CORRECTED exactly:
+those queries' bands lie entirely inside the local prefix (query j < w-1
+attends keys 0..j), so one small causal pass over the first ``window-1``
+local rows computes their true outputs, selected by ``axis_index == 0``.
+The function is therefore exact for any caller — not just ones (like
+models/transformer_episode.py) whose leading positions are never read.
 """
 
 from __future__ import annotations
@@ -85,7 +86,20 @@ def halo_banded_attention_sharded(mesh: Mesh, *, seq_axis: str = "sp",
             qp = jnp.pad(ql, [(0, 0), (0, 0), (halo, 0), (0, 0)])
             out = flash_attention(qp, kv_k, kv_v, causal=True,
                                   local_window=window, use_pallas=use_pallas)
-            return out[:, :, halo:]
+            out = out[:, :, halo:]
+            # Shard 0's zero-filled halo rows would otherwise take softmax
+            # weight (score 0, not -inf) in its first `halo` outputs. Those
+            # queries' true bands sit entirely inside the local prefix
+            # (query j < window-1 attends keys 0..j), so a small plain-causal
+            # pass over the first `halo` local rows is their exact answer.
+            # O(window^2) per shard vs the O(S*window) main pass; computed
+            # everywhere, used only where axis_index == 0.
+            head_exact = flash_attention(
+                ql[:, :, :halo], kl[:, :, :halo], vl[:, :, :halo],
+                causal=True, use_pallas=use_pallas)
+            first = (jax.lax.axis_index(seq_axis) == 0)
+            head = jnp.where(first, head_exact, out[:, :, :halo])
+            return jnp.concatenate([head, out[:, :, halo:]], axis=2)
 
         out = sharded(q, k, v)
         return out[:, :, :seq] if pad else out

@@ -486,24 +486,43 @@ class Orchestrator:
             return QueryReply(ReplyState.NOT_COMPUTED)
         return QueryReply(ReplyState.COMPLETED)
 
-    def _stat(self, key: str) -> QueryReply:
+    def _stat(self, key: str, *, trained_only: bool = False) -> QueryReply:
         phase = self.lifecycle.phase
         if phase is Phase.AWAITING_DATA:
             return QueryReply(ReplyState.NO_TRAINING_DATA)
+        if phase is Phase.FAILED:
+            # A dead run must not serve its stale pre-failure snapshot as a
+            # RESULT — the reference's protocol has no reply arm for "here is
+            # a number from a run that died" (TrainerRouterActor.scala:15-34),
+            # and is_everything_done() already answers NOT_COMPUTED here.
+            return QueryReply(ReplyState.NOT_COMPUTED)
         with self._snapshot_lock:
-            value = self._snapshot.get(key)
+            snap = dict(self._snapshot)
+        if trained_only:
+            # Reference GetAvg semantics: average only the workers that
+            # FINISHED training (it asks the trained list, nobody else —
+            # TrainerRouterActor.scala:84-95,137-139). NotComputed until at
+            # least one agent's episode cursor reached the horizon.
+            if snap.get("trained_workers", 0.0) < 1.0:
+                return QueryReply(ReplyState.NOT_COMPUTED)
+            key = f"{key}_trained"
+        value = snap.get(key)
         if value is None:
             return QueryReply(ReplyState.NOT_COMPUTED)
         # Mid-run replies use the latest chunk snapshot — progressive stats
-        # (the reference answers from whichever workers finished; here every
-        # agent contributes continuously).
+        # over all agents by default; ``trained_only`` reproduces the
+        # reference's completed-workers-at-time-t observable.
         return QueryReply(ReplyState.RESULT, value)
 
-    def get_avg(self) -> QueryReply:
-        return self._stat("portfolio_mean")
+    def get_avg(self, *, trained_only: bool | None = None) -> QueryReply:
+        if trained_only is None:
+            trained_only = self.cfg.runtime.query_trained_only
+        return self._stat("portfolio_mean", trained_only=trained_only)
 
-    def get_std(self) -> QueryReply:
-        return self._stat("portfolio_std")
+    def get_std(self, *, trained_only: bool | None = None) -> QueryReply:
+        if trained_only is None:
+            trained_only = self.cfg.runtime.query_trained_only
+        return self._stat("portfolio_std", trained_only=trained_only)
 
     def snapshot(self) -> dict[str, float]:
         with self._snapshot_lock:

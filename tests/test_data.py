@@ -289,3 +289,39 @@ class TestHttpProvider:
         fetch = http_provider(base + "/prices/{symbol}.csv")
         fetch("BRK B")
         assert requested[-1] == "/prices/BRK%20B.csv"
+
+    def test_rejects_non_http_schemes(self, tmp_path):
+        """urlopen would happily serve file:// — a config-injection path
+        reading local files into the price cache/journal."""
+        from sharetrade_tpu.data.service import http_provider
+        secret = tmp_path / "secret.csv"
+        secret.write_text("56.08, 1992-07-22\n")
+        with pytest.raises(ValueError, match="http"):
+            http_provider(f"file://{secret}")
+        with pytest.raises(ValueError, match="http"):
+            http_provider("ftp://quotes.example/{symbol}.csv")
+
+    def test_oversized_response_rejected(self, monkeypatch):
+        """A hostile/misconfigured endpoint can't balloon host memory: the
+        body is read through a hard byte cap and over-cap responses raise."""
+        import sharetrade_tpu.data.service as service_mod
+        from sharetrade_tpu.data.service import http_provider
+
+        class FakeResp:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def read(self, n=-1):
+                # Pretend the body never ends: always fills the request.
+                return b"x" * (n if n > 0 else 1)
+
+        # Patch before construction: http_provider binds urlopen at build
+        # time (`from urllib.request import urlopen` in its body).
+        monkeypatch.setattr("urllib.request.urlopen",
+                            lambda url, timeout: FakeResp())
+        fetch = http_provider("http://quotes.example/{symbol}.csv")
+        with pytest.raises(ValueError, match="response cap"):
+            fetch("MSFT")

@@ -107,6 +107,57 @@ class TestAsyncWriter:
         from sharetrade_tpu.data.journal import iter_framed_records
         assert len(list(iter_framed_records(tmp_journal_path))) == 64
 
+    def test_write_error_poisons_writer_and_preserves_torn_tail(
+            self, tmp_journal_path, tmp_path):
+        """After a background write error the writer must go sticky-error and
+        STOP appending: frames written past a partially-written (torn) frame
+        would be invisible to the framed reader, which stops at the first
+        corrupt record. Forced via RLIMIT_FSIZE in a subprocess (writes past
+        the cap fail with EFBIG once SIGXFSZ is ignored)."""
+        import subprocess
+        import sys
+        import textwrap
+        self._async(str(tmp_path / "probe.journal")).close()  # skip-if-unbuilt
+        script = textwrap.dedent("""
+            import resource, signal, sys
+            sys.path.insert(0, sys.argv[2])
+            from sharetrade_tpu.data.native import AsyncNativeJournal
+            signal.signal(signal.SIGXFSZ, signal.SIG_IGN)
+            aj = AsyncNativeJournal(sys.argv[1])
+            aj.append_bytes(b"A" * 64)
+            aj.flush()                          # below the cap: lands
+            resource.setrlimit(resource.RLIMIT_FSIZE, (4096, resource.getrlimit(
+                resource.RLIMIT_FSIZE)[1]))
+            aj.append_bytes(b"B" * 16384)       # blows the cap mid-frame
+            try:
+                aj.flush()
+                sys.exit(3)                     # error must surface
+            except OSError:
+                pass
+            try:
+                aj.append_bytes(b"C" * 64)      # sticky error or drained-drop
+            except OSError:
+                pass
+            try:
+                aj.close()
+            except OSError:
+                pass
+            print("POISONED_OK")
+        """)
+        proc = subprocess.run(
+            [sys.executable, "-c", script, tmp_journal_path,
+             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        assert "POISONED_OK" in proc.stdout
+        # Recovery sees the pre-error record; the post-error "C" frame was
+        # dropped, NOT appended past the torn "B" frame (where the framed
+        # reader would never reach it).
+        from sharetrade_tpu.data.journal import iter_framed_records
+        payloads = [p for _o, p in iter_framed_records(tmp_journal_path)]
+        assert payloads and payloads[0] == b"A" * 64
+        assert b"C" * 64 not in payloads
+
     def test_compaction_quiesces_and_resumes(self, tmp_journal_path):
         with self._async(tmp_journal_path) as aj:
             for n in range(10):

@@ -181,6 +181,87 @@ class TestSupervision:
         assert orch.restarts == 0  # resumed in place
 
 
+class TestFailedPhaseProtocol:
+    def test_failed_run_serves_no_results(self, tmp_path):
+        """A dead run must not serve its stale pre-failure snapshot as a
+        RESULT: after the restart budget is exhausted, GetAvg/GetStd answer
+        NotComputed like IsEverythingDone does (the reference protocol has no
+        'result from a dead run' arm, TrainerRouterActor.scala:15-34)."""
+        cfg = fast_cfg(tmp_path)
+        calls = []
+
+        def fake_step(ts):
+            calls.append(1)
+            return ts, {"env_steps": float(min(len(calls), 2)),
+                        "updates": 0.0, "portfolio_mean": 10.0,
+                        "portfolio_std": 0.0}
+
+        def chaos(chunk_idx, metrics):
+            if chunk_idx >= 2:   # let two chunks land a snapshot first
+                raise ValueError("poisoned")  # policy: stop -> FAILED
+
+        orch = Orchestrator(cfg, step_override=fake_step, fault_hook=chaos)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.lifecycle.phase is Phase.FAILED
+        assert orch.snapshot()["portfolio_mean"] == 10.0  # snapshot exists...
+        assert orch.get_avg().state is ReplyState.NOT_COMPUTED  # ...not served
+        assert orch.get_std().state is ReplyState.NOT_COMPUTED
+        assert orch.is_everything_done().state is ReplyState.NOT_COMPUTED
+
+
+class TestTrainedOnlyQueries:
+    """The reference's GetAvg averages only workers that FINISHED training
+    (it asks the trained list, TrainerRouterActor.scala:84-95,137-139);
+    trained_only reproduces that observable next to the default progressive
+    stats."""
+
+    def test_not_computed_until_a_worker_finishes(self, tmp_path):
+        cfg = fast_cfg(tmp_path)
+        horizon = len(PRICES) - WINDOW
+        chunks = []
+
+        def fake_step(ts):
+            chunks.append(1)
+            # Chunk 1: nobody finished; chunk 2: 2 of 4 agents finished.
+            n = len(chunks)
+            return ts, {"env_steps": float(min(n * 16, horizon)),
+                        "updates": 0.0,
+                        "portfolio_mean": 11.0, "portfolio_std": 1.0,
+                        "portfolio_mean_trained": 10.0,
+                        "portfolio_std_trained": 0.0,
+                        "trained_workers": 0.0 if n < 2 else 2.0}
+
+        orch = Orchestrator(cfg, step_override=fake_step)
+        orch.send_training_data(PRICES)
+        orch.lifecycle.to(Phase.TRAINING)
+        ts, m = fake_step(None)
+        orch._snapshot = m
+        assert orch.get_avg(trained_only=True).state is ReplyState.NOT_COMPUTED
+        assert orch.get_avg().ok  # progressive stats still answer
+        ts, m = fake_step(None)
+        orch._snapshot = m
+        assert orch.get_avg(trained_only=True) == QueryReply(
+            ReplyState.RESULT, 10.0)
+        assert orch.get_std(trained_only=True) == QueryReply(
+            ReplyState.RESULT, 0.0)
+        assert orch.get_avg() == QueryReply(ReplyState.RESULT, 11.0)
+
+    def test_real_run_emits_trained_stats(self, tmp_path):
+        """At completion every agent's cursor sits at the horizon, so the
+        trained-only view matches the all-agents view."""
+        cfg = fast_cfg(tmp_path)
+        cfg.runtime.query_trained_only = True   # config-level switch
+        orch = run_end_to_end(cfg, PRICES)
+        snap = orch.snapshot()
+        assert snap["trained_workers"] == cfg.parallel.num_workers
+        avg = orch.get_avg()    # trained-only via config
+        assert avg.ok
+        assert avg.value == pytest.approx(snap["portfolio_mean"], rel=1e-6)
+        assert orch.get_avg(trained_only=False).value == pytest.approx(
+            avg.value, rel=1e-6)
+
+
 class TestStubbedStepSeam:
     def test_lifecycle_without_ml(self, tmp_path):
         """Full lifecycle with fake compute — the TestKit seam where
