@@ -1,0 +1,100 @@
+"""Decompose the episode-mode PPO flagship chunk: rollout vs update vs host.
+
+VERDICT r2 weak #1: the flagship's 5-11% MFU was asserted to be
+rollout-bound but never measured. This script times the two phases of the
+chunk separately (each as its own jitted program over identical state) and
+captures a jax.profiler trace of the fused step, so BASELINE.md can carry a
+measured breakdown instead of an assertion.
+
+Usage: python benchmarks/profile_flagship.py [--config NAME] [--trace DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from benchmarks.run_all import make_configs
+from sharetrade_tpu.agents import build_agent
+from sharetrade_tpu.agents.rollout import collect_rollout
+from sharetrade_tpu.data.synthetic import synthetic_price_series
+from sharetrade_tpu.env import trading
+
+
+def timeit(fn, arg, *, reps=8):
+    out = fn(arg)
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(arg)
+        jax.block_until_ready(jax.tree.leaves(out)[0])
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default="ppo_tr_episode_b128_u1024_bf16")
+    parser.add_argument("--trace", default=None,
+                        help="directory for a jax.profiler trace")
+    args = parser.parse_args()
+
+    cfg = make_configs()[args.config]
+    series = synthetic_price_series(length=cfg.data.synthetic_length)
+    env_params = trading.env_from_prices(
+        series.prices, window=cfg.env.window,
+        initial_budget=cfg.env.initial_budget)
+    env = trading.make_trading_env(
+        series.prices, window=cfg.env.window,
+        initial_budget=cfg.env.initial_budget)
+    agent = build_agent(cfg, env_params)
+    model = agent.model
+    unroll = agent.steps_per_chunk
+    n_agents = agent.num_agents
+
+    # Phase programs over the same TrainState. No donation: the same ts is
+    # reused across reps and phases.
+    rollout_fn = jax.jit(
+        lambda ts: collect_rollout(model, env, ts, unroll, n_agents))
+    step_fn = jax.jit(agent.step)
+
+    ts = agent.init(jax.random.PRNGKey(0))
+    t_roll, (ts_after, traj, bootstrap, init_carry) = timeit(rollout_fn, ts)
+    t_full, _ = timeit(step_fn, ts)
+    t_update = t_full - t_roll
+
+    # Host-visible dispatch floor: an empty jitted identity on the state.
+    ident = jax.jit(lambda ts: ts)
+    t_ident, _ = timeit(ident, ts)
+
+    agent_steps = unroll * n_agents
+    result = {
+        "config": args.config,
+        "agents": n_agents,
+        "unroll": unroll,
+        "chunk_s_full": round(t_full, 4),
+        "chunk_s_rollout": round(t_roll, 4),
+        "chunk_s_update": round(t_update, 4),
+        "rollout_frac": round(t_roll / t_full, 3),
+        "dispatch_floor_s": round(t_ident, 5),
+        "agent_steps_per_s_full": round(agent_steps / t_full, 1),
+        "agent_steps_per_s_rollout_only": round(agent_steps / t_roll, 1),
+        "agent_steps_per_s_update_only": round(agent_steps / t_update, 1),
+        "rollout_us_per_env_step": round(t_roll / unroll * 1e6, 2),
+    }
+    print(json.dumps(result))
+
+    if args.trace:
+        with jax.profiler.trace(args.trace):
+            out = step_fn(ts)
+            jax.block_until_ready(jax.tree.leaves(out)[0])
+        print(f"trace written to {args.trace}")
+
+
+if __name__ == "__main__":
+    main()

@@ -110,14 +110,42 @@ def batched_carry(model, num_agents: int):
                         carry)
 
 
+def healthy_mask(obs: jax.Array) -> jax.Array:
+    """(B, obs_dim) observations -> (B,) bool: rows that are entirely finite.
+
+    The quarantine predicate of the per-agent fault story (the reference's
+    one-dead-child-doesn't-stop-the-other-nine supervision,
+    TrainerRouterActor.scala:141-146, translated to vectorized agents): a
+    poisoned agent (NaN/Inf budget, corrupted price row) is masked out of
+    the shared parameter update on-device — learners AND the observation fed
+    to the network (sanitized to zeros so no NaN flows through the loss) —
+    and the orchestrator respawns just that row between chunks
+    (Orchestrator._heal_agents)."""
+    return jnp.all(jnp.isfinite(obs), axis=-1)
+
+
+def agent_health(env_state) -> jax.Array:
+    """(B,) bool from the env-state pytree: True where every leaf row is
+    finite (the host-visible form of the quarantine predicate)."""
+    leaves = jax.tree.leaves(env_state)
+    b = leaves[0].shape[0]
+    ok = jnp.ones((b,), bool)
+    for leaf in leaves:
+        ok &= jnp.all(jnp.isfinite(leaf.reshape(b, -1)), axis=-1)
+    return ok
+
+
 def portfolio_metrics(env: TradingEnv, env_state) -> dict[str, jax.Array]:
     """The router's aggregation: mean/std over worker portfolios
     (TrainerRouterActor.scala:137-151) plus richer distribution stats.
 
     Two aggregation views are emitted side by side:
 
-    - ``portfolio_mean``/``portfolio_std``: continuous stats over ALL agents,
-      including in-flight ones (progressive — richer than the reference).
+    - ``portfolio_mean``/``portfolio_std``: continuous stats over all
+      HEALTHY agents, including in-flight ones (progressive — richer than
+      the reference). Quarantined (non-finite) rows are excluded, the way a
+      dead child drops out of the reference's aggregation, and counted in
+      ``unhealthy_workers`` so the orchestrator can heal them.
     - ``portfolio_mean_trained``/``portfolio_std_trained``: stats over only
       the agents whose episode cursor reached the horizon — the reference's
       exact ``GetAvg`` observable, which asks the *trained* children only
@@ -126,17 +154,24 @@ def portfolio_metrics(env: TradingEnv, env_state) -> dict[str, jax.Array]:
       (masked stats are 0-filled then, never NaN, to stay jit-safe).
     """
     values = jax.vmap(env.portfolio_value)(env_state)
-    done = (env_state.t >= env.num_steps).astype(jnp.float32)
+    fine = agent_health(env_state).astype(jnp.float32)
+    values = jnp.where(fine > 0, values, 0.0)
+    n_fine = jnp.maximum(jnp.sum(fine), 1.0)
+    mean = jnp.sum(values * fine) / n_fine
+    var = jnp.sum(fine * (values - mean) ** 2) / n_fine
+    done = fine * (env_state.t >= env.num_steps).astype(jnp.float32)
     n_done = jnp.sum(done)
     safe_n = jnp.maximum(n_done, 1.0)
     mean_t = jnp.sum(values * done) / safe_n
     var_t = jnp.sum(done * (values - mean_t) ** 2) / safe_n
+    big = jnp.float32(jnp.finfo(jnp.float32).max)
     return {
-        "portfolio_mean": jnp.mean(values),
-        "portfolio_std": jnp.std(values),
-        "portfolio_min": jnp.min(values),
-        "portfolio_max": jnp.max(values),
+        "portfolio_mean": mean,
+        "portfolio_std": jnp.sqrt(var),
+        "portfolio_min": jnp.min(jnp.where(fine > 0, values, big)),
+        "portfolio_max": jnp.max(jnp.where(fine > 0, values, -big)),
         "portfolio_mean_trained": mean_t,
         "portfolio_std_trained": jnp.sqrt(var_t),
         "trained_workers": n_done,
+        "unhealthy_workers": values.shape[0] - jnp.sum(fine),
     }

@@ -24,7 +24,7 @@ from flax import struct
 
 from sharetrade_tpu.agents.base import (
     Agent, TrainState, batched_carry, batched_reset, build_optimizer,
-    epsilon_greedy, exploit_probability, portfolio_metrics,
+    epsilon_greedy, exploit_probability, healthy_mask, portfolio_metrics,
 )
 from sharetrade_tpu.config import LearnerConfig
 from sharetrade_tpu.env.core import TradingEnv
@@ -129,9 +129,15 @@ def make_dqn_agent(model: Model, env: TradingEnv,
     def one_step(ts: TrainState, _):
         rng, k_act, k_sample = jax.random.split(ts.rng, 3)
         act_keys = jax.random.split(k_act, num_agents)
-        active = ts.env_state.t < horizon
 
-        obs = jax.vmap(env.observe)(ts.env_state)
+        # Horizon freeze + poisoned-row quarantine (base.healthy_mask): a
+        # non-finite agent contributes no transitions to the replay buffer
+        # and no NaNs to the shared network; the orchestrator respawns it.
+        obs_raw = jax.vmap(env.observe)(ts.env_state)
+        healthy = healthy_mask(obs_raw)
+        active = (ts.env_state.t < horizon) & healthy
+        obs = jnp.where(healthy[:, None], obs_raw, 0.0)
+
         q_sel = q_batch(ts.params, obs)
         actions = jax.vmap(lambda k, q: epsilon_greedy(k, q, ts.env_steps, cfg))(
             act_keys, q_sel)
@@ -141,7 +147,8 @@ def make_dqn_agent(model: Model, env: TradingEnv,
                 active.reshape((-1,) + (1,) * (new.ndim - 1)), new, old),
             stepped, ts.env_state)
         rewards = jnp.where(active, rewards, 0.0)
-        next_obs = jax.vmap(env.observe)(env_state)
+        next_obs = jnp.where(healthy[:, None],
+                             jax.vmap(env.observe)(env_state), 0.0)
 
         replay = ts.extras.replay.push(obs, actions, rewards, next_obs, active)
 

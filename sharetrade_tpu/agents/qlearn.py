@@ -30,6 +30,7 @@ from sharetrade_tpu.agents.base import (
     build_optimizer,
     epsilon_greedy,
     exploit_probability,
+    healthy_mask,
     portfolio_metrics,
 )
 from sharetrade_tpu.config import LearnerConfig
@@ -67,10 +68,15 @@ def make_qlearn_agent(model: Model, env: TradingEnv,
         rng, k_act = jax.random.split(ts.rng)
         act_keys = jax.random.split(k_act, num_agents)
 
-        # Freeze agents whose episode is over (chunking may overrun the horizon).
-        active = ts.env_state.t < horizon  # (B,) bool
+        # Freeze agents whose episode is over (chunking may overrun the
+        # horizon) AND quarantine poisoned rows: a non-finite observation
+        # must not reach the shared parameters (base.healthy_mask — the
+        # per-agent fault fence; the orchestrator respawns the row).
+        obs_raw = jax.vmap(env.observe)(ts.env_state)
+        healthy = healthy_mask(obs_raw)
+        active = (ts.env_state.t < horizon) & healthy  # (B,) bool
+        obs = jnp.where(healthy[:, None], obs_raw, 0.0)
 
-        obs = jax.vmap(env.observe)(ts.env_state)
         q_sel, _aux_sel, carry_new = apply_batch(ts.params, obs, ts.carry)
         actions = jax.vmap(lambda k, q: epsilon_greedy(k, q, ts.env_steps, cfg))(
             act_keys, q_sel)
@@ -81,7 +87,8 @@ def make_qlearn_agent(model: Model, env: TradingEnv,
                 active.reshape((-1,) + (1,) * (new.ndim - 1)), new, old),
             stepped, ts.env_state)
         rewards = jnp.where(active, rewards, 0.0)
-        next_obs = jax.vmap(env.observe)(env_state)
+        next_obs = jnp.where(healthy[:, None],
+                             jax.vmap(env.observe)(env_state), 0.0)
 
         def td_loss(params):
             # One stacked forward for Q(s) and Q(s'): tiny matmuls are

@@ -14,7 +14,7 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
-from sharetrade_tpu.agents.base import TrainState
+from sharetrade_tpu.agents.base import TrainState, healthy_mask
 from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models.core import Model, apply_batched
 
@@ -38,7 +38,15 @@ def collect_rollout(model: Model, env: TradingEnv,
     stacks :class:`StepData` along a leading time axis, ``bootstrap_value`` is
     V(s_T) for return bootstrapping, and ``init_carry`` is the recurrent state
     the unroll started from (needed to replay the forward pass in losses).
+
+    Models exposing the precomputed-rollout pair (``apply_rollout_trunk`` /
+    ``apply_rollout_head``, models/core.py) take the parallel-trunk path:
+    the unroll's entire trunk runs as ONE pass up front and the sequential
+    env loop applies only the tiny state-dependent head per step.
     """
+    if model.apply_rollout_trunk is not None:
+        return _collect_rollout_precomputed(
+            model, env, ts, unroll_len, num_agents)
     horizon = env.num_steps
     init_carry = ts.carry
 
@@ -47,8 +55,14 @@ def collect_rollout(model: Model, env: TradingEnv,
         rng, k_act = jax.random.split(rng)
         act_keys = jax.random.split(k_act, num_agents)
 
-        active = (env_state.t < horizon).astype(jnp.float32)
-        obs = jax.vmap(env.observe)(env_state)
+        # Horizon freeze + poisoned-row quarantine: a non-finite agent's
+        # observation is sanitized to zeros (so no NaN reaches the shared
+        # forward/loss) and its row is masked inactive — frozen in place
+        # until the orchestrator respawns it (base.healthy_mask).
+        obs_raw = jax.vmap(env.observe)(env_state)
+        healthy = healthy_mask(obs_raw)
+        active = ((env_state.t < horizon) & healthy).astype(jnp.float32)
+        obs = jnp.where(healthy[:, None], obs_raw, 0.0)
         outs, new_model_carry = apply_batched(model, ts.params, obs, model_carry)
         actions = jax.vmap(
             lambda k, lg: jax.random.categorical(k, lg))(act_keys, outs.logits)
@@ -62,7 +76,8 @@ def collect_rollout(model: Model, env: TradingEnv,
             lambda new, old: jnp.where(
                 mask.reshape((-1,) + (1,) * (new.ndim - 1)), new, old),
             stepped, env_state)
-        rewards = rewards * active
+        # where() not *: a quarantined row's reward is NaN, and NaN*0 = NaN.
+        rewards = jnp.where(mask, rewards, 0.0)
 
         data = StepData(obs=obs, action=actions, logp=logp,
                         value=outs.value, reward=rewards, active=active)
@@ -72,12 +87,150 @@ def collect_rollout(model: Model, env: TradingEnv,
         one_step, (ts.env_state, ts.carry, ts.rng), None, length=unroll_len)
 
     # Bootstrap value for the state the unroll stopped at.
-    final_obs = jax.vmap(env.observe)(env_state)
+    final_raw = jax.vmap(env.observe)(env_state)
+    final_fine = healthy_mask(final_raw)
+    final_obs = jnp.where(final_fine[:, None], final_raw, 0.0)
     final_outs, _ = apply_batched(model, ts.params, final_obs, model_carry)
-    bootstrap = final_outs.value * (env_state.t < horizon).astype(jnp.float32)
+    bootstrap = final_outs.value * (
+        (env_state.t < horizon) & final_fine).astype(jnp.float32)
 
-    steps_taken = jnp.sum(traj.active[:, 0] > 0).astype(jnp.int32)
+    # Count steps where ANY agent advanced (not just agent 0): with
+    # per-agent healing, cursors can diverge — a respawned agent keeps
+    # running after the rest finish, and its chunks must count.
+    steps_taken = jnp.sum(jnp.any(traj.active > 0, axis=1)).astype(jnp.int32)
     new_ts = ts.replace(env_state=env_state, carry=model_carry, rng=rng,
+                        env_steps=ts.env_steps + steps_taken)
+    return new_ts, traj, bootstrap, init_carry
+
+
+def _collect_rollout_precomputed(model: Model, env: TradingEnv,
+                                 ts: TrainState, unroll_len: int,
+                                 num_agents: int):
+    """Rollout with the heavy trunk hoisted OUT of the sequential loop.
+
+    The trading env's prices are action-independent (actions move only
+    budget/shares; the cursor advances one tick per step regardless), so
+    the tick that enters the observation window at each future step is
+    known before any action is taken. The model's trunk — everything up to
+    the portfolio-feature injection — therefore computes for the WHOLE
+    unroll in one parallel banded pass (``apply_rollout_trunk``); the
+    sequential ``lax.scan`` keeps only action sampling, the env transition,
+    and the (B, d)-sized head (``apply_rollout_head``). This removes the
+    measured 70%-of-chunk sequential cache-attention rollout
+    (benchmarks/profile_flagship.py).
+
+    Agents frozen mid-unroll (horizon reached, or quarantined by
+    ``healthy_mask``) read trunk rows computed for cursors they never
+    reached; their outputs are masked inactive exactly as the incremental
+    path masked its lockstep-advanced carry.
+    """
+    horizon = env.num_steps
+    init_carry = ts.carry
+    window = model.obs_dim - 2
+
+    # ---- bulk precompute (everything scalar-unit-hostile hoisted out of
+    # the scan: a vmapped dynamic gather costs ~75-230 us PER ITERATION on
+    # TPU and a threefry split ~120 us, vs ~0.1 us for elementwise math;
+    # as single ops out here they cost milliseconds total) ---------------
+    #
+    # Agent-invariance: every agent replays the SAME price series in
+    # LOCKSTEP (batched_reset broadcasts one reset state; the episode-mode
+    # trunk models are excluded from per-agent row respawn precisely to
+    # keep this, orchestrator._heal_agents), so the price windows AND the
+    # whole trunk are computed for ONE representative agent and broadcast —
+    # the trunk's cost and the window gather drop by a factor of B.
+    # Agents frozen mid-unroll keep stale cursors; their rows are masked
+    # inactive, exactly as the incremental path masked its lockstep carry.
+    state1 = jax.tree.map(lambda x: x[:1], ts.env_state)   # agent 0
+
+    def window_at(i):
+        shifted = state1.replace(t=jnp.minimum(state1.t + i, horizon))
+        return jax.vmap(env.observe)(shifted)[0, :window]
+
+    windows = jax.vmap(window_at)(jnp.arange(unroll_len + 1))  # (T+1, W)
+    # Trade price at step i = the price just past step i's window == the
+    # newest price of step i+1's window.
+    trade_prices = windows[1:, -1]                             # (T,)
+
+    rng, k_noise = jax.random.split(ts.rng)
+    # Gumbel-max sampling noise for the whole unroll: argmax(logits + g)
+    # IS a categorical draw, with zero in-loop RNG traffic.
+    gumbel = jax.random.gumbel(
+        k_noise, (unroll_len, num_agents, model.num_actions), jnp.float32)
+
+    obs1_raw = jax.vmap(env.observe)(state1)
+    # Sanitize ONLY the wallet features: the price window comes from the
+    # static series (always finite) and is all the trunk reads — zeroing
+    # the whole row when agent 0's wallet is poisoned would corrupt the
+    # SHARED trunk for every healthy agent.
+    obs1 = jnp.concatenate(
+        [obs1_raw[:, :window],
+         jnp.where(jnp.isfinite(obs1_raw[:, window:]),
+                   obs1_raw[:, window:], 0.0)], axis=-1)
+    carry1 = jax.tree.map(lambda x: x[:1], ts.carry)
+    hn1, carry1_out = model.apply_rollout_trunk(
+        ts.params, obs1, windows[None, 1:, -1], carry1)
+    hn_base = hn1[0]                                           # (T+1, d)
+    new_model_carry = jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (num_agents,) + x.shape[1:]),
+        carry1_out)
+
+    step_priced = env.step_priced
+
+    def one_step(env_state, inputs):
+        win_i, price_i, g_i, hn_i = inputs
+        # Assemble the observation from the precomputed (shared) window +
+        # the live wallet (the only state-dependent features).
+        obs_raw = jnp.concatenate(
+            [jnp.broadcast_to(win_i, (num_agents, window)),
+             env_state.budget[:, None], env_state.shares[:, None]],
+            axis=-1)
+        healthy = healthy_mask(obs_raw)
+        active = ((env_state.t < horizon) & healthy).astype(jnp.float32)
+        obs = jnp.where(healthy[:, None], obs_raw, 0.0)
+
+        outs = model.apply_rollout_head(
+            ts.params,
+            jnp.broadcast_to(hn_i, (num_agents,) + hn_i.shape), obs)
+        actions = jnp.argmax(outs.logits + g_i, axis=-1).astype(jnp.int32)
+        log_probs = jax.nn.log_softmax(outs.logits)
+        # one_hot contraction, not take_along_axis: gathers are scalar-unit
+        # dispatches inside a scan.
+        logp = jnp.sum(
+            log_probs * jax.nn.one_hot(actions, log_probs.shape[-1]), axis=-1)
+
+        if step_priced is not None:
+            stepped, rewards = jax.vmap(
+                step_priced, in_axes=(0, 0, None))(env_state, actions, price_i)
+        else:
+            stepped, rewards = jax.vmap(env.step)(env_state, actions)
+        mask = active.astype(bool)
+        new_env = jax.tree.map(
+            lambda new, old: jnp.where(
+                mask.reshape((-1,) + (1,) * (new.ndim - 1)), new, old),
+            stepped, env_state)
+        rewards = jnp.where(mask, rewards, 0.0)
+
+        data = StepData(obs=obs, action=actions, logp=logp,
+                        value=outs.value, reward=rewards, active=active)
+        return new_env, data
+
+    env_state, traj = jax.lax.scan(
+        one_step, ts.env_state,
+        (windows[:-1], trade_prices, gumbel, hn_base[:unroll_len]))
+
+    final_raw = jax.vmap(env.observe)(env_state)
+    final_fine = healthy_mask(final_raw)
+    final_obs = jnp.where(final_fine[:, None], final_raw, 0.0)
+    final_outs = model.apply_rollout_head(
+        ts.params,
+        jnp.broadcast_to(hn_base[unroll_len],
+                         (num_agents,) + hn_base.shape[1:]), final_obs)
+    bootstrap = final_outs.value * (
+        (env_state.t < horizon) & final_fine).astype(jnp.float32)
+
+    steps_taken = jnp.sum(jnp.any(traj.active > 0, axis=1)).astype(jnp.int32)
+    new_ts = ts.replace(env_state=env_state, carry=new_model_carry, rng=rng,
                         env_steps=ts.env_steps + steps_taken)
     return new_ts, traj, bootstrap, init_carry
 

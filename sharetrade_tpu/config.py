@@ -91,6 +91,14 @@ class ModelConfig:
     # buffer, picks past ``moe_capacity_factor`` headroom are dropped.
     moe_top_k: int = 0
     moe_capacity_factor: float = 1.25
+    # How top-k expert traffic moves over the ep mesh axis: "psum" routes
+    # replicated tokens and psums the partial outputs (every device sees the
+    # global batch); "a2a" shards the tokens over ep and moves only the
+    # dispatched capacity buffers through two all_to_alls — the GShard
+    # pattern whose communication volume is independent of E and never
+    # materializes the global batch on one device. Requires moe_top_k>0 and
+    # a mesh with an ep axis.
+    moe_dispatch: str = "psum"
 
 
 @dataclass
@@ -167,6 +175,15 @@ class RuntimeConfig:
     # observable — average only workers whose episode finished, NotComputed
     # until at least one has (TrainerRouterActor.scala:84-95,137-139).
     query_trained_only: bool = False
+    # Per-agent fault recovery (the reference heals ONE dead child while the
+    # other nine keep training, TrainerRouterActor.scala:141-146): learners
+    # quarantine non-finite agent rows on-device so poison never reaches the
+    # shared parameters, and the orchestrator respawns just those rows
+    # (fresh env cursor + carry) between chunks — survivors lose nothing.
+    # Whole-state checkpoint restore remains the fallback for faults the
+    # row-respawn can't fix (poisoned params, device errors, episode-mode
+    # transformers whose K/V carry requires a lockstep batch).
+    partial_recovery: bool = True
 
 
 @dataclass

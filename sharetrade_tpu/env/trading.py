@@ -133,17 +133,22 @@ def make_trading_env(prices, window: int = 201, initial_budget: float = 2400.0,
         obs_dim=params.window + 2,
         num_actions=NUM_ACTIONS,
         num_assets=1,
+        step_priced=lambda s, a, p: step(params, s, a, trade_price=p),
     )
 
 
-def step(params: EnvParams, state: EnvState, action: jax.Array):
+def step(params: EnvParams, state: EnvState, action: jax.Array,
+         trade_price: jax.Array | None = None):
     """Apply one action; returns ``(new_state, reward)``.
 
     Branch-free Buy/Sell/Hold with feasibility masking, so it vectorizes
     cleanly under ``vmap`` and stays a single fused XLA computation under
-    ``lax.scan``.
+    ``lax.scan``. ``trade_price`` overrides the by-cursor gather (the
+    ``TradingEnv.step_priced`` fast path — precomputed-rollout loops pass
+    the price to keep gathers out of the sequential scan).
     """
-    trade_price = params.prices[state.t + params.window]
+    if trade_price is None:
+        trade_price = params.prices[state.t + params.window]
 
     can_buy = (action == BUY) & (state.budget >= trade_price)
     can_sell = (action == SELL) & (state.shares > 0)

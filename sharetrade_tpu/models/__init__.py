@@ -122,6 +122,21 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
         # reachability doesn't depend on the mesh).
         ep_mesh = (mesh if cfg.moe_experts and mesh is not None
                    and "ep" in mesh.axis_names else None)
+        if cfg.moe_dispatch not in ("psum", "a2a"):
+            raise ValueError(
+                f"unknown model.moe_dispatch {cfg.moe_dispatch!r} "
+                "(expected 'psum' or 'a2a')")
+        if cfg.moe_dispatch == "a2a" and cfg.moe_experts:
+            if not cfg.moe_top_k:
+                raise ValueError(
+                    "model.moe_dispatch='a2a' is a top-k dispatch pattern; "
+                    "set model.moe_top_k>0 (the dense-mask top-1 scheme has "
+                    "no capacity buffers to all_to_all)")
+            if ep_mesh is None:
+                raise ValueError(
+                    "model.moe_dispatch='a2a' needs a mesh with an 'ep' "
+                    "axis (set parallel.mesh_shape, e.g. "
+                    "{\"dp\": 2, \"ep\": 4})")
         return transformer_policy(
             obs_dim, actions, num_layers=cfg.num_layers,
             num_heads=cfg.num_heads, head_dim=cfg.head_dim, dtype=dtype,
@@ -129,5 +144,6 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
             pp_mesh=pp_mesh, pp_batch_axis=batch_axis,
             moe_experts=cfg.moe_experts, ep_mesh=ep_mesh,
             moe_top_k=cfg.moe_top_k,
-            moe_capacity_factor=cfg.moe_capacity_factor)
+            moe_capacity_factor=cfg.moe_capacity_factor,
+            moe_dispatch=cfg.moe_dispatch)
     raise ValueError(f"unknown model kind {cfg.kind!r}")

@@ -60,6 +60,25 @@ class Model:
     # dispatches to it.
     apply_unroll: Callable[[Any, jax.Array, Any],
                            tuple[jax.Array, jax.Array, jax.Array]] | None = None
+    # Optional PRECOMPUTED-ROLLOUT pair. Models whose heavy trunk depends
+    # only on action-independent inputs (the episode transformer attends
+    # over price ticks alone; the agent's wallet enters at the head) provide
+    # these, and rollout.collect_rollout then computes the whole unroll's
+    # trunk in ONE parallel pass instead of T sequential cache-attention
+    # steps — the measured 70% of the flagship chunk
+    # (benchmarks/profile_flagship.py).
+    #
+    # apply_rollout_trunk(params, obs (B, obs_dim), future_ticks (B, T),
+    #                     carry) -> (hn_base (B, T+1, d), carry after T) —
+    #   row i is the trunk output for env step t0+i; row T serves the
+    #   bootstrap value.
+    # apply_rollout_head(params, hn_base_row (B, d), obs (B, obs_dim))
+    #   -> ModelOut (batched) — the tiny state-dependent head, applied
+    #   per-step inside the sequential env loop.
+    apply_rollout_trunk: Callable[[Any, jax.Array, jax.Array, Any],
+                                  tuple[jax.Array, Any]] | None = None
+    apply_rollout_head: Callable[[Any, jax.Array, jax.Array],
+                                 ModelOut] | None = None
 
 
 def apply_batched(model: Model, params: Any, obs_batch: jax.Array,

@@ -38,7 +38,8 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                        pp_batch_axis: str | None = None,
                        moe_experts: int = 0, ep_mesh=None,
                        ep_axis: str = "ep", moe_top_k: int = 0,
-                       moe_capacity_factor: float = 1.25) -> Model:
+                       moe_capacity_factor: float = 1.25,
+                       moe_dispatch: str = "psum") -> Model:
     """``attention_fn(q, k, v) -> out`` overrides the local flash kernel —
     the sequence-parallel hook (e.g. ``ring_attention_sharded`` binds a mesh
     so attention rings over the sp axis, parallel/ring_attention.py).
@@ -126,7 +127,22 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
             from sharetrade_tpu.parallel import moe as moe_lib
             flat = h.reshape(-1, d_model)
             if moe_top_k:      # capacity-bucketed top-k dispatch
-                if ep_mesh is not None:
+                if ep_mesh is not None and moe_dispatch == "a2a":
+                    # Token-sharded all_to_all dispatch: pad the token count
+                    # to a multiple of ep (pad rows are marked invalid — no
+                    # buffer slots, no balance-stat contribution) and slice
+                    # the real rows back out.
+                    ep = ep_mesh.shape[ep_axis]
+                    n = flat.shape[0]
+                    pad = (-n) % ep
+                    y, aux = moe_lib.moe_apply_topk_a2a(
+                        blk["moe"],
+                        jnp.pad(flat, ((0, pad), (0, 0))) if pad else flat,
+                        ep_mesh, axis=ep_axis, top_k=moe_top_k,
+                        capacity_factor=moe_capacity_factor,
+                        n_valid=n if pad else None)
+                    y = y[:n] if pad else y
+                elif ep_mesh is not None:
                     y, aux = moe_lib.moe_apply_topk_sharded(
                         blk["moe"], flat, ep_mesh, axis=ep_axis,
                         top_k=moe_top_k, capacity_factor=moe_capacity_factor,

@@ -141,15 +141,18 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         return params
 
     def forward(params, series, positions, port_feats, *, want_kv=False,
-                attn=None):
+                attn=None, kv_offset=0):
         """Banded forward over a (B, S) tick series.
 
         ``port_feats`` (B, S, 3) is zero except at query positions. Returns
         (logits (B, S, A), values (B, S), per-layer rotated (k, v) lists
-        when ``want_kv`` — the rollout cache seed). ``attn`` overrides the
-        attention implementation (the prefill pins the LOCAL kernel: its
-        sequence is the fixed L*(window-1)+1 rows, too short to shard, and
-        rollout is the local path by contract).
+        when ``want_kv``, post-final_ln hidden (B, S, d)). ``kv_offset``
+        shifts the cached window ``offset`` ticks back from the series end
+        (the precomputed-rollout trunk's last tick belongs to the bootstrap
+        position, one step past where the cache should stop). ``attn``
+        overrides the attention implementation (the prefill pins the LOCAL
+        kernel: its sequence is the fixed L*(window-1)+1 rows, too short to
+        shard).
         """
         attn = attn or attention_fn
         bsz, s_len = series.shape
@@ -164,7 +167,8 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
             k = _rope(k, positions)
             x_attn = attn(q, k, v, window)
             if want_kv:
-                kv.append((k[:, :, -window:], v[:, :, -window:]))
+                lo = s_len - window - kv_offset
+                kv.append((k[:, :, lo:lo + window], v[:, :, lo:lo + window]))
             x_attn = x_attn.transpose(0, 2, 1, 3).reshape(
                 bsz, s_len, d_model).astype(dtype)
             x = x + dense(blk["proj"], x_attn)
@@ -172,10 +176,10 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
             x = x + dense(blk["mlp_out"], jax.nn.gelu(dense(blk["mlp_in"], h)))
         hn = _layer_norm(x, params["final_ln"]["scale"],
                          params["final_ln"]["bias"])
-        hn = hn + dense(params["port"], port_feats.astype(dtype))
-        logits = dense(params["policy"], hn).astype(jnp.float32)
-        values = dense(params["value"], hn).astype(jnp.float32)[..., 0]
-        return logits, values, kv
+        hn_port = hn + dense(params["port"], port_feats.astype(dtype))
+        logits = dense(params["policy"], hn_port).astype(jnp.float32)
+        values = dense(params["value"], hn_port).astype(jnp.float32)[..., 0]
+        return logits, values, kv, hn
 
     _port_feats = portfolio_features  # shared head-side normalization
 
@@ -192,8 +196,8 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         port = jnp.zeros(series.shape + (3,), jnp.float32)
         port = port.at[:, -1, :].set(
             _port_feats(obs[:, window], obs[:, window + 1], win[:, -1]))
-        logits, values, kv = forward(params, series, positions, port,
-                                     want_kv=True, attn=local_attention)
+        logits, values, kv, _hn = forward(params, series, positions, port,
+                                          want_kv=True, attn=local_attention)
         cache_k = jnp.stack([k for k, _ in kv], axis=1)  # (B, L, H, W, Dh)
         cache_v = jnp.stack([v for _, v in kv], axis=1)
         carry = {
@@ -205,7 +209,21 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                         aux=jnp.float32(0.0)), carry
 
     def _incremental(params, obs, carry):
-        """One-token step against the rolling K/V cache."""
+        """One-token step against the CIRCULAR K/V cache.
+
+        The cache is a ring, not a shift register: tick j lives at slot
+        ``j mod window`` forever (the prefill writes ticks 0..window-1 at
+        slots 0..window-1, and step t writes its new tick t+window-1 over
+        the evicted tick t-1 — same slot mod window). One
+        ``dynamic_update_slice`` per layer per K/V replaces the old
+        implementation's full-buffer shift-and-restack, cutting per-step
+        cache traffic from O(B·L·H·W·D) copies (~100 MB/step at the
+        flagship shape — measured 70% of the whole training chunk,
+        benchmarks/profile_flagship.py) to one written row. Attention over
+        the ring needs no reordering: RoPE is applied at ABSOLUTE positions
+        before caching and softmax attention is permutation-invariant over
+        the key axis, so slot order never matters.
+        """
         bsz = obs.shape[0]
         new, prev = obs[:, window - 1], obs[:, window - 2]
         ret = (jnp.log(jnp.maximum(new, _EPS))
@@ -213,18 +231,23 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         tok = jnp.stack([ret, jnp.abs(ret), jnp.zeros_like(ret)], axis=-1)
         x = dense(params["embed"], tok.astype(dtype))[:, None, :]  # (B, 1, d)
         pos = (carry["t"] + window - 1).astype(jnp.int32)[:, None]  # (B, 1)
+        # Ring slot of the evicted tick (lockstep batch: t[0] speaks for
+        # all — the apply_batch invariant).
+        slot = jnp.mod(carry["t"][0] - 1, window).astype(jnp.int32)
 
-        new_k, new_v = [], []
+        k_cache, v_cache = carry["k"], carry["v"]     # (B, L, H, W, Dh)
         for li, blk in enumerate(params["blocks"]):
             h = _layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
             qkv = dense(blk["qkv"], h).reshape(bsz, 1, 3, num_heads, head_dim)
             q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
             q = _rope(q, pos)
             k = _rope(k, pos)
-            k_all = jnp.concatenate([carry["k"][:, li, :, 1:], k], axis=2)
-            v_all = jnp.concatenate([carry["v"][:, li, :, 1:], v], axis=2)
-            new_k.append(k_all)
-            new_v.append(v_all)
+            zero = jnp.int32(0)
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k[:, None], (zero, jnp.int32(li), zero, slot, zero))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v[:, None], (zero, jnp.int32(li), zero, slot, zero))
+            k_all, v_all = k_cache[:, li], v_cache[:, li]
             s = jnp.einsum("bhqd,bhkd->bhqk", q, k_all,
                            preferred_element_type=jnp.float32) * sm_scale
             probs = jax.nn.softmax(s, axis=-1).astype(v_all.dtype)
@@ -244,8 +267,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         if hist_len:
             # Tick t (the window's oldest) leaves the window this step.
             hist = jnp.concatenate([hist[:, 1:], obs[:, :1]], axis=1)
-        carry = {"k": jnp.stack(new_k, axis=1),
-                 "v": jnp.stack(new_v, axis=1),
+        carry = {"k": k_cache, "v": v_cache,
                  "hist": hist, "t": carry["t"] + 1}
         return ModelOut(logits=logits, value=values,
                         aux=jnp.float32(0.0)), carry
@@ -306,9 +328,64 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         feats = _port_feats(obs[:, :, window], obs[:, :, window + 1], anchor)
         port = jnp.zeros((bsz, s_len, 3), jnp.float32)
         port = port.at[:, q_pos, :].set(feats.swapaxes(0, 1))
-        logits, values, _ = forward(params, series, positions, port)
+        logits, values, _kv, _hn = forward(params, series, positions, port)
         return (logits[:, q_pos].swapaxes(0, 1),
                 values[:, q_pos].swapaxes(0, 1), jnp.float32(0.0))
+
+    def apply_rollout_trunk(params, obs, future_ticks, carry):
+        """Whole-unroll trunk in ONE banded pass (the precomputed-rollout
+        path, models/core.py): attention sees only price ticks, and prices
+        are action-independent, so the trunk for every future step of the
+        unroll is computable ahead of the env loop — the same series
+        construction as ``apply_unroll``, plus one extra position for the
+        bootstrap value. Replaces T sequential cache-attention steps
+        (measured 70% of the flagship chunk) with one replay-shaped pass.
+
+        ``future_ticks`` (B, T): the tick that enters the window at each of
+        the next T env steps. Returns (hn_base (B, T+1, d), carry after T
+        steps — ring-layout K/V refreshed so a later incremental ``apply``
+        continues seamlessly).
+        """
+        bsz, t_len = future_ticks.shape
+        t0 = carry["t"].astype(jnp.int32)
+        first_win = obs[:, :window]
+        # Episode start: substitute the prefill's first-price padding for
+        # the init_carry zeros (same rule as apply_unroll).
+        hist = jnp.where((t0 == 0)[:, None], first_win[:, :1], carry["hist"])
+        series = jnp.concatenate(
+            [hist, first_win, future_ticks.astype(jnp.float32)], axis=1)
+        s_len = hist_len + window + t_len
+        positions = (t0[:, None] - hist_len
+                     + jnp.arange(s_len, dtype=jnp.int32)[None, :])
+        port = jnp.zeros((bsz, s_len, 3), jnp.float32)
+        _logits, _values, kv, hn = forward(params, series, positions, port,
+                                           want_kv=True, kv_offset=1)
+        q_pos = hist_len + window - 1 + jnp.arange(t_len + 1)
+        hn_base = hn[:, q_pos]
+        # Carry after T steps. The cached window (kv_offset=1) is ticks
+        # [t_end-1, t_end+window-2] in series order; the ring layout stores
+        # tick j at slot j mod window, so roll by (t_end-1) mod window.
+        t_end = t0 + t_len
+        shift = jnp.mod(t_end[0] - 1, window)   # lockstep batch invariant
+        cache_k = jnp.roll(jnp.stack([k for k, _ in kv], axis=1),
+                           shift, axis=3)
+        cache_v = jnp.roll(jnp.stack([v for _, v in kv], axis=1),
+                           shift, axis=3)
+        hist_next = (series[:, t_len:t_len + hist_len] if hist_len
+                     else carry["hist"])
+        return hn_base, {"k": cache_k, "v": cache_v,
+                         "hist": hist_next, "t": t_end}
+
+    def apply_rollout_head(params, hn_row, obs):
+        """The state-dependent remainder of the forward: inject the
+        portfolio features and read the policy/value heads — a few
+        (B, d)-sized ops per env step."""
+        hn = hn_row.astype(dtype) + dense(params["port"], _port_feats(
+            obs[:, window], obs[:, window + 1],
+            obs[:, window - 1]).astype(dtype))
+        logits = dense(params["policy"], hn).astype(jnp.float32)
+        values = dense(params["value"], hn).astype(jnp.float32)[..., 0]
+        return ModelOut(logits=logits, value=values, aux=jnp.float32(0.0))
 
     def init_carry():
         return {
@@ -320,5 +397,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
 
     return Model(init=init, apply=apply, apply_batch=apply_batch,
                  apply_unroll=apply_unroll, init_carry=init_carry,
+                 apply_rollout_trunk=apply_rollout_trunk,
+                 apply_rollout_head=apply_rollout_head,
                  obs_dim=obs_dim, num_actions=num_actions,
                  name="transformer_episode")

@@ -259,7 +259,8 @@ def moe_apply_topk_sharded(params: dict, tokens: jax.Array, mesh: Mesh,
 def moe_apply_topk_a2a(params: dict, tokens: jax.Array, mesh: Mesh,
                        *, axis: str = "ep", top_k: int = 2,
                        capacity_factor: float = 1.25,
-                       group_size: int | None = 1024):
+                       group_size: int | None = 1024,
+                       n_valid: int | None = None):
     """GShard-style all_to_all dispatch: tokens AND experts sharded over
     ``axis``; each shard routes its local tokens, an all_to_all carries the
     dispatched buffers to their expert-owner devices, and a second
@@ -272,6 +273,10 @@ def moe_apply_topk_a2a(params: dict, tokens: jax.Array, mesh: Mesh,
     materializes the global batch. Routing groups are per source shard, so
     drop decisions are shard-local; in the no-drop regime the result equals
     :func:`moe_apply_topk` exactly.
+
+    ``n_valid`` marks rows past it as padding (callers pad the token count
+    up to a multiple of ep): they claim no buffer slots and are excluded
+    from the balance statistics, exactly like group padding.
     """
     num_experts = params["gate"].shape[-1]
     ep = mesh.shape[axis]
@@ -287,6 +292,12 @@ def moe_apply_topk_a2a(params: dict, tokens: jax.Array, mesh: Mesh,
     def local_fn(gate, w_in, w_out, toks):
         # toks: (N/ep, d) — this shard's tokens only.
         gtoks, valid = _pad_groups(toks, group_size)
+        if n_valid is not None:
+            # Global row ids of this shard's rows, laid into the group grid.
+            start = jax.lax.axis_index(axis) * n_local
+            row_ok = (start + jnp.arange(n_local) < n_valid)
+            row_ok = jnp.pad(row_ok, (0, valid.size - n_local))
+            valid = valid * row_ok.reshape(valid.shape).astype(valid.dtype)
         groups = gtoks.shape[0]
         cap = _capacity(gtoks.shape[1], num_experts, top_k, capacity_factor)
         dispatch, combine, (importance, load) = _topk_route(
@@ -294,9 +305,14 @@ def moe_apply_topk_a2a(params: dict, tokens: jax.Array, mesh: Mesh,
             valid)
         # Global balance statistics BEFORE the product: averaging per-shard
         # importance·load products is not the global loss (nonlinear in the
-        # means) and drifts from the single-device reference.
-        aux = _balance_loss(jax.lax.pmean(importance, axis),
-                            jax.lax.pmean(load, axis))
+        # means). Count-weighted: shards can hold unequal VALID counts (the
+        # n_valid pad tail lives on the last shard), so per-shard means are
+        # recombined as global-sum / global-count, not pmean'd.
+        cnt = jnp.sum(valid)
+        total = jnp.maximum(jax.lax.psum(cnt, axis), 1.0)
+        imp_g = jax.lax.psum(importance * jnp.maximum(cnt, 1.0), axis) / total
+        load_g = jax.lax.psum(load * jnp.maximum(cnt, 1.0), axis) / total
+        aux = _balance_loss(imp_g, load_g)
         xs = _dispatch_gather(dispatch, gtoks)              # (E, G·C, d)
         d = xs.shape[-1]
         xs = xs.reshape(ep, local_e, groups * cap, d)

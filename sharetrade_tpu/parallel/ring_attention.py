@@ -13,6 +13,21 @@ Built on ``shard_map`` + XLA collectives (the scaling-book recipe), with the
 same online-softmax algebra as the local Pallas flash kernel
 (sharetrade_tpu/ops/attention.py) — the kernel handles intra-block locality,
 the ring handles inter-device locality.
+
+Why the per-hop contraction is plain XLA rather than the Pallas kernel
+(measured, TPU v5e, 2026-07-30): the flash kernel returns only the
+normalized output, so ring composition through it would need per-hop
+(out, logsumexp) pairs with a custom VJP across hops; that machinery buys
+nothing at the shapes this path serves. Window mode bounds the sequence at
+window+1 tokens, so a hop block is T/S ≲ 1k rows — chained-timing both
+implementations at (8, 4, T, 64): T=256 fwd XLA 1 µs vs Pallas 2 µs,
+fwd+bwd 2 µs vs 5 µs; T=1024 fwd 1 µs vs 2 µs, fwd+bwd 2 µs vs 2 µs —
+dispatch-bound and equal within tunnel noise. The XLA hop's real limit is
+the BACKWARD's O((T/S)²) score residuals (a T=4096 50-step grad chain
+asked for a 100 GB allocation), but sequences that long ride episode mode,
+whose sp path routes through the kernel's banded streaming form
+(parallel/episode_sp.py) — so no supported window-mode configuration
+reaches the regime where the kernel would win.
 """
 
 from __future__ import annotations

@@ -101,6 +101,7 @@ class Orchestrator:
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self.restarts = 0
+        self.agent_heals = 0   # per-agent row respawns (partial_recovery)
         self.episode = 0
         self.last_error: BaseException | None = None
         self._transitions_journal = None
@@ -159,10 +160,14 @@ class Orchestrator:
                     f"series horizon ({horizon}); resume needs the same or a "
                     f"longer price series")
             self._ts = self._place(self._warm_start_replay(state))
-            # Recover which episode the cumulative step count sits in so the
-            # completion arithmetic picks up where the run left off.
-            self.episode = min(int(state.env_steps) // horizon,
-                               self.cfg.runtime.episodes - 1)
+            # Recover the episode index from the checkpoint metadata; the
+            # env_steps//horizon heuristic is the fallback for pre-metadata
+            # checkpoints (it overcounts once per-agent heals inflate the
+            # step count, which is why the index is persisted).
+            saved_episode = self.checkpoints.metadata(step).get("episode")
+            self.episode = (int(saved_episode) if saved_episode is not None
+                            else min(int(state.env_steps) // horizon,
+                                     self.cfg.runtime.episodes - 1))
             log.info("resumed from checkpoint step=%d "
                      "(env cursor %d, %d updates, episode %d)", step,
                      int(state.env_state.t[0]), int(state.updates),
@@ -278,18 +283,57 @@ class Orchestrator:
                     self._snapshot = metrics
                 self.metrics.record_many(metrics)
 
+                if (rt.partial_recovery
+                        and metrics.get("unhealthy_workers", 0) > 0):
+                    # Quarantined rows detected: respawn just those agents
+                    # (the reference's one-dead-child heal). Raising falls
+                    # through to the supervision decider -> full restore.
+                    if not self._heal_agents():
+                        raise RuntimeError(
+                            f"{int(metrics['unhealthy_workers'])} agent(s) "
+                            "non-finite and beyond row respawn")
+                if (rt.partial_recovery
+                        and not np.isfinite(metrics.get("loss", 0.0))):
+                    # Poison reached the shared loss (and so the params on
+                    # the next update): beyond any row respawn — full
+                    # checkpoint restore via the supervision path.
+                    raise RuntimeError("non-finite training loss "
+                                       "(shared state poisoned)")
+
                 updates = int(metrics.get("updates", 0))
                 if (rt.checkpoint_every_updates > 0
                         and updates // rt.checkpoint_every_updates
                         > last_ckpt_updates // rt.checkpoint_every_updates):
                     # Async: device->host DMA overlaps the next chunk.
-                    self.checkpoints.save_async(updates, self._ts)
+                    # The episode index rides the metadata: env_steps alone
+                    # can't recover it once per-agent heals inflate the step
+                    # count past horizon-per-episode.
+                    self.checkpoints.save_async(
+                        updates, self._ts, metadata={"episode": self.episode})
                     self.events.emit("checkpoint", updates=updates)
                 last_ckpt_updates = updates
 
                 # env_steps is cumulative across episodes (the epsilon ramp
-                # input), so episode N completes at (N+1) x horizon.
-                if int(metrics.get("env_steps", 0)) >= horizon * (self.episode + 1):
+                # input), so episode N completes at (N+1) x horizon. With
+                # per-agent healing, a respawned row restarts its episode
+                # mid-run and may still be training when the step count
+                # crosses the threshold — completion additionally waits for
+                # every worker's cursor to reach the horizon (the reference
+                # completes only when all 10 children report Trained,
+                # including replacements, TrainerRouterActor.scala:114,125).
+                done_steps = (int(metrics.get("env_steps", 0))
+                              >= horizon * (self.episode + 1))
+                workers = self.cfg.parallel.num_workers
+                # With partial_recovery off, a quarantined row can never be
+                # respawned: it would strand the all-trained gate forever
+                # (the learners' on-device quarantine is unconditional), so
+                # stranded rows count as excluded — the run completes
+                # without them, like a dead child nobody respawns.
+                stranded = (0.0 if rt.partial_recovery
+                            else metrics.get("unhealthy_workers", 0.0))
+                all_trained = (metrics.get("trained_workers", float(workers))
+                               + stranded >= workers)
+                if done_steps and all_trained:
                     self.episode += 1
                     if self.episode < rt.episodes:
                         # Re-arm for another pass over the history, keeping
@@ -300,7 +344,8 @@ class Orchestrator:
                         self._reset_episode()
                         continue
                     self.checkpoints.wait_pending(timeout=60)
-                    self.checkpoints.save(updates, self._ts)
+                    self.checkpoints.save(updates, self._ts,
+                                          metadata={"episode": self.episode})
                     self.lifecycle.to(Phase.TRAINED)
                     self.lifecycle.to(Phase.COMPLETED)
                     self.tracer.stop()
@@ -371,6 +416,65 @@ class Orchestrator:
             if isinstance(exc, etype):
                 return verb
         return RESTART
+
+    def _heal_agents(self) -> bool:
+        """Respawn poisoned agent ROWS in place — the reference's per-worker
+        heal (one dead child replaced while the other nine keep training,
+        TrainerRouterActor.scala:141-146) translated to vectorized agents.
+
+        The learners' on-device quarantine (base.healthy_mask) guarantees a
+        non-finite row never reached the shared parameters, so recovery is
+        local: splice a fresh env cursor + model carry into the bad rows
+        (params/optimizer/RNG/step counters untouched) and let the respawned
+        agents retrain their episode — the reference's re-fired
+        StartTraining (:116-120). Survivors lose nothing; completion waits
+        for the respawned rows (the all_trained gate).
+
+        Returns False — caller falls back to checkpoint restore — when the
+        damage exceeds a row respawn: shared params/opt non-finite (the
+        quarantine was breached), EVERY row bad (device-level corruption),
+        no bad rows found (the fault is elsewhere), or the model is an
+        episode-mode transformer whose K/V cache requires a lockstep batch
+        (a respawned row's carry would desynchronize
+        transformer_episode.apply_batch)."""
+        if self._step_override is not None or self.agent is None:
+            return False
+        if getattr(self.agent.model, "name", "") == "transformer_episode":
+            return False
+        from sharetrade_tpu.agents.base import agent_health
+        ts = self._ts
+        ok = np.asarray(jax.device_get(agent_health(ts.env_state)))
+        carry_leaves = jax.tree.leaves(ts.carry)
+        if carry_leaves:
+            b = ok.shape[0]
+            for leaf in jax.device_get(carry_leaves):
+                arr = np.asarray(leaf)
+                if arr.shape[:1] == (b,):
+                    ok &= np.isfinite(arr.reshape(b, -1)).all(axis=-1)
+        bad = ~ok
+        if not bad.any() or bad.all():
+            return False
+        shared = jax.device_get((ts.params, ts.opt_state))
+        if not all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree.leaves(shared)):
+            return False
+        fresh = self.agent.init(jax.random.PRNGKey(
+            self.cfg.seed + 7919 * (self.agent_heals + 1)))
+
+        def splice(cur, new):
+            m = bad.reshape((-1,) + (1,) * (np.asarray(cur).ndim - 1))
+            return jnp.where(m, new, cur)
+
+        self._ts = self._place(ts.replace(
+            env_state=jax.tree.map(splice, ts.env_state, fresh.env_state),
+            carry=jax.tree.map(splice, ts.carry, fresh.carry)))
+        self.agent_heals += 1
+        idx = [int(i) for i in np.flatnonzero(bad)]
+        log.warning("respawned poisoned agent row(s) %s in place "
+                    "(heal %d; params untouched)", idx, self.agent_heals)
+        self.events.emit("agents_healed", agents=idx,
+                         heals=self.agent_heals)
+        return True
 
     def _restore_or_reinit(self) -> None:
         """Restore the latest checkpoint, else restart the episode from
@@ -561,10 +665,17 @@ class Orchestrator:
         (final, _), rewards = jax.jit(
             lambda c: jax.lax.scan(body, c, None, length=horizon)
         )((env.reset(), model.init_carry()))
-        return {
+        result = {
             "eval_portfolio": float(env.portfolio_value(final)),
             "eval_reward_sum": float(jnp.sum(rewards)),
         }
+        # The greedy-eval curve lands in the event log so learning progress
+        # is auditable after the run (the reference's only observable is the
+        # final avg, ShareTradeHelper.scala:46; this is the per-policy
+        # learning signal it never records).
+        self.events.emit("evaluation", updates=int(self._ts.updates),
+                         **result)
+        return result
 
     # ------------------------------------------------------------------
 

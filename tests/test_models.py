@@ -175,6 +175,58 @@ class TestEpisodeMode:
                 np.asarray(values), np.asarray(traj.value), atol=2e-4,
                 err_msg=f"chunk {chunk} value mismatch")
 
+    def test_precomputed_trunk_matches_incremental_stepping(self):
+        """The precomputed-rollout pair (apply_rollout_trunk + head) must
+        compute the same per-step outputs AND hand off the same carry as
+        prefill + incremental cache stepping — an off-by-one in q_pos, the
+        tick series, or the ring-cache roll would silently train every
+        episode-mode run on shifted prices."""
+        _, agent, env = self._setup(num_agents=2)
+        model = agent.model
+        params = model.init(jax.random.PRNGKey(3))
+        n_agents, t_len = 2, 6
+        from sharetrade_tpu.agents.base import batched_carry, batched_reset
+
+        # Incremental: prefill at t=0 then T-1 cache steps, Hold actions.
+        state = batched_reset(env, n_agents)
+        carry = batched_carry(model, n_agents)
+        inc_logits, inc_values, obs_seq = [], [], []
+        for _ in range(t_len):
+            obs = jax.vmap(env.observe)(state)
+            outs, carry = model.apply_batch(params, obs, carry)
+            inc_logits.append(outs.logits)
+            inc_values.append(outs.value)
+            obs_seq.append(obs)
+            state, _ = jax.vmap(env.step)(
+                state, jnp.full((n_agents,), 2, jnp.int32))  # Hold
+
+        # Trunk: same episode start, ticks read off the future windows.
+        state0 = batched_reset(env, n_agents)
+        carry0 = batched_carry(model, n_agents)
+        obs0 = jax.vmap(env.observe)(state0)
+        ticks = jnp.stack(
+            [o[:, self.WINDOW - 1] for o in obs_seq[1:]]
+            + [jax.vmap(env.observe)(state)[:, self.WINDOW - 1]], axis=1)
+        hn_base, carry_tr = model.apply_rollout_trunk(
+            params, obs0, ticks, carry0)
+        for i in range(t_len):
+            outs = model.apply_rollout_head(params, hn_base[:, i], obs_seq[i])
+            np.testing.assert_allclose(
+                np.asarray(outs.logits), np.asarray(inc_logits[i]),
+                atol=3e-4, err_msg=f"step {i} logits")
+            np.testing.assert_allclose(
+                np.asarray(outs.value), np.asarray(inc_values[i]),
+                atol=3e-4, err_msg=f"step {i} value")
+
+        # Carry handoff: identical ring-layout cache, history, and cursor.
+        assert int(carry_tr["t"][0]) == int(carry["t"][0])
+        np.testing.assert_allclose(np.asarray(carry_tr["hist"]),
+                                   np.asarray(carry["hist"]), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(carry_tr["k"]),
+                                   np.asarray(carry["k"]), atol=3e-4)
+        np.testing.assert_allclose(np.asarray(carry_tr["v"]),
+                                   np.asarray(carry["v"]), atol=3e-4)
+
     def test_single_layer_no_history(self):
         # L=1: hist_len == 0 — the zero-width history path.
         from sharetrade_tpu.agents.rollout import collect_rollout, replay_forward

@@ -400,6 +400,16 @@ class TestPartitionedTrainingEndToEnd:
         cfg.model.num_layers = 2
         self._run(cfg, cpu_devices)
 
+    def test_a2a_moe_transformer_via_config(self, tmp_path, cpu_devices):
+        """The all_to_all token-dispatch variant — the pattern whose
+        communication volume scales — reachable via model.moe_dispatch."""
+        cfg = self._cfg(tmp_path, {"dp": 2, "ep": 4})
+        cfg.model.moe_experts = 4
+        cfg.model.moe_top_k = 2
+        cfg.model.moe_dispatch = "a2a"
+        cfg.model.num_layers = 2
+        self._run(cfg, cpu_devices)
+
     @pytest.mark.parametrize("kind", ["mlp", "transformer"])
     def test_tp_axis_actually_shards_params_via_config(self, tmp_path,
                                                        cpu_devices, kind):

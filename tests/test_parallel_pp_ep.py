@@ -209,6 +209,37 @@ class TestTopKMoE:
         with pytest.raises(ValueError, match="divisible"):
             moe_apply_topk_a2a(params, tokens, ep_mesh)
 
+    def test_a2a_n_valid_pads_like_group_padding(self, ep_mesh):
+        """Callers with an indivisible token count zero-pad to a multiple of
+        ep and pass n_valid: pad rows must claim no buffer slots and leave
+        the balance stats identical to the unpadded reference."""
+        params, tokens = self._params_tokens(num_experts=8, n=60, dim=16)
+        want, aux_want = moe_apply_topk(params, tokens, top_k=2,
+                                        capacity_factor=8.0, group_size=8)
+        padded = jnp.pad(tokens, ((0, 4), (0, 0)))       # 60 -> 64 = 8 shards
+        got, aux_got = moe_apply_topk_a2a(params, padded, ep_mesh, top_k=2,
+                                          capacity_factor=8.0, group_size=8,
+                                          n_valid=60)
+        np.testing.assert_allclose(np.asarray(got[:60]), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(float(aux_got), float(aux_want), rtol=1e-5)
+
+    def test_a2a_dispatch_config_validation(self):
+        """moe_dispatch='a2a' without top-k or without an ep mesh must fail
+        loudly at build time, not silently fall back to psum."""
+        from sharetrade_tpu.config import ModelConfig
+        from sharetrade_tpu.models import build_model
+        cfg = ModelConfig(kind="transformer", num_heads=2, head_dim=8,
+                          num_layers=1, moe_experts=4, moe_dispatch="a2a")
+        with pytest.raises(ValueError, match="moe_top_k"):
+            build_model(cfg, 18)
+        cfg.moe_top_k = 2
+        with pytest.raises(ValueError, match="ep"):
+            build_model(cfg, 18)       # no mesh at all
+        cfg.moe_dispatch = "bogus"
+        with pytest.raises(ValueError, match="moe_dispatch"):
+            build_model(cfg, 18)
+
     def test_gradients_flow_through_dispatch(self):
         params, tokens = self._params_tokens()
 

@@ -181,6 +181,108 @@ class TestSupervision:
         assert orch.restarts == 0  # resumed in place
 
 
+@pytest.mark.slow
+class TestPerAgentRecovery:
+    """The reference heals ONE dead child while the other nine keep training
+    (TrainerRouterActor.scala:141-146). Here: learners quarantine non-finite
+    rows on-device, the orchestrator respawns just those rows — survivors
+    keep every step of progress (no checkpoint rollback)."""
+
+    def test_one_poisoned_agent_heals_without_rollback(self, tmp_path):
+        from sharetrade_tpu.utils.logging import EventLog
+        cfg = fast_cfg(tmp_path)
+        events_path = str(tmp_path / "events.jsonl")
+        poisoned = []
+
+        def chaos(chunk_idx, metrics):
+            if chunk_idx == 1 and not poisoned:
+                poisoned.append(1)
+                ts = orch._ts
+                budget = np.asarray(jax.device_get(ts.env_state.budget)).copy()
+                budget[2] = np.nan          # one agent's wallet corrupted
+                orch._ts = ts.replace(env_state=ts.env_state.replace(
+                    budget=jnp.asarray(budget)))
+
+        orch = Orchestrator(cfg, fault_hook=chaos,
+                            event_log=EventLog(events_path))
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        # Healed in place: zero full restarts, one row respawn, agent 2.
+        assert orch.restarts == 0
+        assert orch.agent_heals == 1
+        import json
+        events = [json.loads(l) for l in open(events_path)]
+        kinds = [e["kind"] for e in events]
+        assert "agents_healed" in kinds
+        assert next(e for e in events
+                    if e["kind"] == "agents_healed")["agents"] == [2]
+        # Survivors kept their progress: nothing was restored/reinit'd, and
+        # the respawned agent retrained its episode (updates ran PAST the
+        # horizon instead of rolling back to a checkpoint).
+        assert "restored" not in kinds and "reinitialized" not in kinds
+        horizon = len(PRICES) - WINDOW
+        assert int(orch.train_state.updates) > horizon
+        snap = orch.snapshot()
+        assert snap["unhealthy_workers"] == 0
+        assert snap["trained_workers"] == cfg.parallel.num_workers
+        assert orch.get_avg().ok and np.isfinite(orch.get_avg().value)
+
+    def test_recovery_disabled_completes_without_stranded_agent(self, tmp_path):
+        """With partial_recovery=False a quarantined row can never respawn;
+        the run must still COMPLETE (the stranded row counts as excluded)
+        rather than spin forever waiting for a cursor that will never reach
+        the horizon."""
+        cfg = fast_cfg(tmp_path)
+        cfg.runtime.partial_recovery = False
+        poisoned = []
+
+        def chaos(chunk_idx, metrics):
+            if chunk_idx == 1 and not poisoned:
+                poisoned.append(1)
+                ts = orch._ts
+                budget = np.asarray(jax.device_get(ts.env_state.budget)).copy()
+                budget[1] = np.nan
+                orch._ts = ts.replace(env_state=ts.env_state.replace(
+                    budget=jnp.asarray(budget)))
+
+        orch = Orchestrator(cfg, fault_hook=chaos)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.agent_heals == 0 and orch.restarts == 0
+        snap = orch.snapshot()
+        assert snap["unhealthy_workers"] == 1       # still quarantined...
+        assert snap["trained_workers"] == cfg.parallel.num_workers - 1
+        assert np.isfinite(orch.get_avg().value)    # ...and excluded
+
+    def test_poisoned_shared_params_fall_back_to_restore(self, tmp_path):
+        """When poison breaches into the SHARED state (params), a row
+        respawn can't help: the non-finite-loss detector must route through
+        the full checkpoint-restore supervision path."""
+        cfg = fast_cfg(tmp_path)
+        poisoned = []
+
+        def chaos(chunk_idx, metrics):
+            # Poison AFTER the first checkpoint landed (chunk 1, updates 32)
+            # so the restore has a clean checkpoint to come back to.
+            if chunk_idx == 2 and not poisoned:
+                poisoned.append(1)
+                ts = orch._ts
+                params = jax.device_get(ts.params)
+                params = jax.tree.map(
+                    lambda a: np.full_like(np.asarray(a), np.nan), params)
+                orch._ts = ts.replace(params=params)
+
+        orch = Orchestrator(cfg, fault_hook=chaos)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.restarts >= 1          # full restore, not a row heal
+        assert orch.agent_heals == 0
+        assert np.isfinite(orch.get_avg().value)
+
+
 class TestFailedPhaseProtocol:
     def test_failed_run_serves_no_results(self, tmp_path):
         """A dead run must not serve its stale pre-failure snapshot as a
