@@ -159,6 +159,15 @@ def make_configs() -> dict[str, FrameworkConfig]:
             learner__unroll_len=1024, runtime__chunk_steps=1024,
             model__num_layers=2, model__num_heads=2, model__head_dim=128,
             model__dtype="bfloat16"),
+        # Wider agent batch on the precomputed-trunk rollout: the trunk is
+        # shared across agents and the sequential loop is elementwise in B,
+        # so batch width costs only the replay/update passes.
+        "ppo_tr_episode_b512_u1024_bf16": base(
+            learner__algo="ppo", model__kind="transformer",
+            model__seq_mode="episode", parallel__num_workers=512,
+            learner__unroll_len=1024, runtime__chunk_steps=1024,
+            model__num_layers=2, model__num_heads=2, model__head_dim=128,
+            model__dtype="bfloat16"),
         # The reference's ENTIRE workload as one compiled chunk: 10 workers x
         # the full 5,845-step episode (6,046 prices - 201 window,
         # env/trading.py num_steps), rollout + GAE + clipped updates, with

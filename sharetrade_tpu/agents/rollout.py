@@ -14,7 +14,8 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
-from sharetrade_tpu.agents.base import TrainState, healthy_mask
+from sharetrade_tpu.agents.base import (
+    TrainState, agent_health, healthy_mask)
 from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models.core import Model, apply_batched
 
@@ -58,9 +59,11 @@ def collect_rollout(model: Model, env: TradingEnv,
         # Horizon freeze + poisoned-row quarantine: a non-finite agent's
         # observation is sanitized to zeros (so no NaN reaches the shared
         # forward/loss) and its row is masked inactive — frozen in place
-        # until the orchestrator respawns it (base.healthy_mask).
+        # until the orchestrator respawns it. Health covers the WHOLE
+        # env-state row (share_value included), not just the observation:
+        # poison outside the obs would otherwise flow in via the reward.
         obs_raw = jax.vmap(env.observe)(env_state)
-        healthy = healthy_mask(obs_raw)
+        healthy = healthy_mask(obs_raw) & agent_health(env_state)
         active = ((env_state.t < horizon) & healthy).astype(jnp.float32)
         obs = jnp.where(healthy[:, None], obs_raw, 0.0)
         outs, new_model_carry = apply_batched(model, ts.params, obs, model_carry)
@@ -88,7 +91,7 @@ def collect_rollout(model: Model, env: TradingEnv,
 
     # Bootstrap value for the state the unroll stopped at.
     final_raw = jax.vmap(env.observe)(env_state)
-    final_fine = healthy_mask(final_raw)
+    final_fine = healthy_mask(final_raw) & agent_health(env_state)
     final_obs = jnp.where(final_fine[:, None], final_raw, 0.0)
     final_outs, _ = apply_batched(model, ts.params, final_obs, model_carry)
     bootstrap = final_outs.value * (
@@ -185,7 +188,7 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
             [jnp.broadcast_to(win_i, (num_agents, window)),
              env_state.budget[:, None], env_state.shares[:, None]],
             axis=-1)
-        healthy = healthy_mask(obs_raw)
+        healthy = healthy_mask(obs_raw) & agent_health(env_state)
         active = ((env_state.t < horizon) & healthy).astype(jnp.float32)
         obs = jnp.where(healthy[:, None], obs_raw, 0.0)
 
@@ -220,7 +223,7 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
         (windows[:-1], trade_prices, gumbel, hn_base[:unroll_len]))
 
     final_raw = jax.vmap(env.observe)(env_state)
-    final_fine = healthy_mask(final_raw)
+    final_fine = healthy_mask(final_raw) & agent_health(env_state)
     final_obs = jnp.where(final_fine[:, None], final_raw, 0.0)
     final_outs = model.apply_rollout_head(
         ts.params,

@@ -184,6 +184,11 @@ class RuntimeConfig:
     # row-respawn can't fix (poisoned params, device errors, episode-mode
     # transformers whose K/V carry requires a lockstep batch).
     partial_recovery: bool = True
+    # Row-respawn budget: past this many heals the fault is treated as
+    # systemic and escalates to the full restart path (whose max_restarts
+    # budget then bounds availability) — a recurring per-row fault must not
+    # heal->re-poison->heal forever.
+    max_agent_heals: int = 10
 
 
 @dataclass

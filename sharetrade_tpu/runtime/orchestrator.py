@@ -288,10 +288,16 @@ class Orchestrator:
                     # Quarantined rows detected: respawn just those agents
                     # (the reference's one-dead-child heal). Raising falls
                     # through to the supervision decider -> full restore.
-                    if not self._heal_agents():
+                    # A recurring fault must not heal->re-poison->heal
+                    # forever: past the heal budget it escalates to the
+                    # restart path, whose max_restarts bounds availability.
+                    if (self.agent_heals >= rt.max_agent_heals
+                            or not self._heal_agents()):
                         raise RuntimeError(
                             f"{int(metrics['unhealthy_workers'])} agent(s) "
-                            "non-finite and beyond row respawn")
+                            "non-finite and beyond row respawn "
+                            f"(heals used: {self.agent_heals}/"
+                            f"{rt.max_agent_heals})")
                 if (rt.partial_recovery
                         and not np.isfinite(metrics.get("loss", 0.0))):
                     # Poison reached the shared loss (and so the params on

@@ -127,11 +127,14 @@ def _episode_mode_flops_per_agent_step(cfg: FrameworkConfig,
                                        obs_dim: int) -> float:
     """Episode-mode transformer (models/transformer_episode.py): the unroll
     replays as ONE banded pass over S = L*(window-1)+T tokens instead of T
-    window-length forwards, and the rollout is a single incremental token
-    per step (band-width attention row). Counted per agent-step:
+    window-length forwards, and the rollout trunk is computed ONCE for a
+    representative agent and shared (agents.rollout precomputed path: the
+    price series is identical and lockstep across the batch), so its cost
+    amortizes over B agents. Counted per agent-step:
 
-        rollout:  1 token   (24*d^2 matmuls + 4*window*d attention)
-        replay:   epochs x 3 (fwd+bwd) x (S / T) tokens
+        rollout trunk: (S+1)/T tokens / B agents
+        rollout head:  1 tiny head (port + policy + value projections)
+        replay:        epochs x 3 (fwd+bwd) x (S / T) tokens
     """
     model, learner = cfg.model, cfg.learner
     w = obs_dim - 2
@@ -139,10 +142,14 @@ def _episode_mode_flops_per_agent_step(cfg: FrameworkConfig,
     per_token = (model.num_layers * (24.0 * d * d + 4.0 * w * d)
                  + 2.0 * 3 * d        # tick embed
                  + 2.0 * d * (model.num_actions + 1 + 3))  # heads + port
+    per_head = 2.0 * d * (model.num_actions + 1 + 3)
     t = max(learner.unroll_len, 1)
+    b = max(cfg.parallel.num_workers, 1)
     s = model.num_layers * (w - 1) + t
     epochs = learner.ppo_epochs if learner.algo == "ppo" else 1
-    return per_token * (1.0 + epochs * 3.0 * (s / t))
+    return (per_token * (s + 1) / t / b      # shared trunk
+            + per_head                        # per-step head
+            + per_token * epochs * 3.0 * (s / t))
 
 
 def train_flops_per_agent_step(cfg: FrameworkConfig, obs_dim: int) -> float:
