@@ -1,6 +1,7 @@
 """Benchmark: env agent-steps/sec/chip — reference shape AND the flagship.
 
-Two lines are printed (headline first):
+ONE JSON line is printed (the driver contract): the flagship headline
+object, with the reference-shape row nested under ``"reference_shape"``.
 
 1. **Flagship**: the episode-mode PPO transformer at its saturating config
    (128 agents × 1,024-step unrolls, bf16, banded flash attention,

@@ -135,6 +135,15 @@ def agent_health(env_state) -> jax.Array:
     return ok
 
 
+def quarantine_mask(obs_raw: jax.Array, env_state) -> jax.Array:
+    """THE learner-side quarantine predicate: a row is healthy iff its
+    observation AND its whole env-state row are finite. One definition so
+    every learner fences the same faults — a site that checked only the
+    observation would silently re-admit poison living outside it (e.g.
+    ``share_value``, which reaches the loss through the reward)."""
+    return healthy_mask(obs_raw) & agent_health(env_state)
+
+
 def portfolio_metrics(env: TradingEnv, env_state) -> dict[str, jax.Array]:
     """The router's aggregation: mean/std over worker portfolios
     (TrainerRouterActor.scala:137-151) plus richer distribution stats.

@@ -23,9 +23,8 @@ import optax
 from flax import struct
 
 from sharetrade_tpu.agents.base import (
-    Agent, TrainState, agent_health, batched_carry, batched_reset,
-    build_optimizer, epsilon_greedy, exploit_probability, healthy_mask,
-    portfolio_metrics,
+    Agent, TrainState, batched_carry, batched_reset, build_optimizer,
+    epsilon_greedy, exploit_probability, portfolio_metrics, quarantine_mask,
 )
 from sharetrade_tpu.config import LearnerConfig
 from sharetrade_tpu.env.core import TradingEnv
@@ -131,12 +130,11 @@ def make_dqn_agent(model: Model, env: TradingEnv,
         rng, k_act, k_sample = jax.random.split(ts.rng, 3)
         act_keys = jax.random.split(k_act, num_agents)
 
-        # Horizon freeze + poisoned-row quarantine: a non-finite agent
-        # contributes no transitions to the replay buffer and no NaNs to
-        # the shared network; the orchestrator respawns it. Health covers
-        # the whole env-state row (share_value included), not just the obs.
+        # Horizon freeze + poisoned-row quarantine (base.quarantine_mask):
+        # a non-finite agent contributes no transitions to the replay buffer
+        # and no NaNs to the shared network; the orchestrator respawns it.
         obs_raw = jax.vmap(env.observe)(ts.env_state)
-        healthy = healthy_mask(obs_raw) & agent_health(ts.env_state)
+        healthy = quarantine_mask(obs_raw, ts.env_state)
         active = (ts.env_state.t < horizon) & healthy
         obs = jnp.where(healthy[:, None], obs_raw, 0.0)
 

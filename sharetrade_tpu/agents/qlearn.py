@@ -28,11 +28,10 @@ from sharetrade_tpu.agents.base import (
     batched_carry,
     batched_reset,
     build_optimizer,
-    agent_health,
     epsilon_greedy,
     exploit_probability,
-    healthy_mask,
     portfolio_metrics,
+    quarantine_mask,
 )
 from sharetrade_tpu.config import LearnerConfig
 from sharetrade_tpu.env.core import TradingEnv
@@ -70,13 +69,11 @@ def make_qlearn_agent(model: Model, env: TradingEnv,
         act_keys = jax.random.split(k_act, num_agents)
 
         # Freeze agents whose episode is over (chunking may overrun the
-        # horizon) AND quarantine poisoned rows: a non-finite agent must not
-        # reach the shared parameters (the per-agent fault fence; the
-        # orchestrator respawns the row). Health covers the WHOLE env-state
-        # row, not just the observation — poison in a leaf outside the obs
-        # (share_value) would otherwise flow in through the reward.
+        # horizon) AND quarantine poisoned rows (base.quarantine_mask): a
+        # non-finite agent must not reach the shared parameters; the
+        # orchestrator respawns the row.
         obs_raw = jax.vmap(env.observe)(ts.env_state)
-        healthy = healthy_mask(obs_raw) & agent_health(ts.env_state)
+        healthy = quarantine_mask(obs_raw, ts.env_state)
         active = (ts.env_state.t < horizon) & healthy  # (B,) bool
         obs = jnp.where(healthy[:, None], obs_raw, 0.0)
 

@@ -14,8 +14,7 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
-from sharetrade_tpu.agents.base import (
-    TrainState, agent_health, healthy_mask)
+from sharetrade_tpu.agents.base import TrainState, quarantine_mask
 from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models.core import Model, apply_batched
 
@@ -56,14 +55,12 @@ def collect_rollout(model: Model, env: TradingEnv,
         rng, k_act = jax.random.split(rng)
         act_keys = jax.random.split(k_act, num_agents)
 
-        # Horizon freeze + poisoned-row quarantine: a non-finite agent's
-        # observation is sanitized to zeros (so no NaN reaches the shared
-        # forward/loss) and its row is masked inactive — frozen in place
-        # until the orchestrator respawns it. Health covers the WHOLE
-        # env-state row (share_value included), not just the observation:
-        # poison outside the obs would otherwise flow in via the reward.
+        # Horizon freeze + poisoned-row quarantine (base.quarantine_mask):
+        # a non-finite agent's observation is sanitized to zeros (so no NaN
+        # reaches the shared forward/loss) and its row is masked inactive —
+        # frozen until the orchestrator respawns it.
         obs_raw = jax.vmap(env.observe)(env_state)
-        healthy = healthy_mask(obs_raw) & agent_health(env_state)
+        healthy = quarantine_mask(obs_raw, env_state)
         active = ((env_state.t < horizon) & healthy).astype(jnp.float32)
         obs = jnp.where(healthy[:, None], obs_raw, 0.0)
         outs, new_model_carry = apply_batched(model, ts.params, obs, model_carry)
@@ -91,7 +88,7 @@ def collect_rollout(model: Model, env: TradingEnv,
 
     # Bootstrap value for the state the unroll stopped at.
     final_raw = jax.vmap(env.observe)(env_state)
-    final_fine = healthy_mask(final_raw) & agent_health(env_state)
+    final_fine = quarantine_mask(final_raw, env_state)
     final_obs = jnp.where(final_fine[:, None], final_raw, 0.0)
     final_outs, _ = apply_batched(model, ts.params, final_obs, model_carry)
     bootstrap = final_outs.value * (
@@ -123,7 +120,7 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
     (benchmarks/profile_flagship.py).
 
     Agents frozen mid-unroll (horizon reached, or quarantined by
-    ``healthy_mask``) read trunk rows computed for cursors they never
+    ``quarantine_mask``) read trunk rows computed for cursors they never
     reached; their outputs are masked inactive exactly as the incremental
     path masked its lockstep-advanced carry.
     """
@@ -188,7 +185,7 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
             [jnp.broadcast_to(win_i, (num_agents, window)),
              env_state.budget[:, None], env_state.shares[:, None]],
             axis=-1)
-        healthy = healthy_mask(obs_raw) & agent_health(env_state)
+        healthy = quarantine_mask(obs_raw, env_state)
         active = ((env_state.t < horizon) & healthy).astype(jnp.float32)
         obs = jnp.where(healthy[:, None], obs_raw, 0.0)
 
@@ -223,7 +220,7 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
         (windows[:-1], trade_prices, gumbel, hn_base[:unroll_len]))
 
     final_raw = jax.vmap(env.observe)(env_state)
-    final_fine = healthy_mask(final_raw) & agent_health(env_state)
+    final_fine = quarantine_mask(final_raw, env_state)
     final_obs = jnp.where(final_fine[:, None], final_raw, 0.0)
     final_outs = model.apply_rollout_head(
         ts.params,

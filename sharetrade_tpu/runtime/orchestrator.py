@@ -163,11 +163,16 @@ class Orchestrator:
             # Recover the episode index from the checkpoint metadata; the
             # env_steps//horizon heuristic is the fallback for pre-metadata
             # checkpoints (it overcounts once per-agent heals inflate the
-            # step count, which is why the index is persisted).
+            # step count, which is why the index is persisted). Clamp to
+            # episodes-1 either way: the FINAL checkpoint of a completed run
+            # is written after the episode counter increments past the last
+            # episode, and resuming it unclamped would set a completion
+            # threshold ((episode+1) x horizon) that frozen agents can never
+            # reach — an infinite chunk spin.
             saved_episode = self.checkpoints.metadata(step).get("episode")
-            self.episode = (int(saved_episode) if saved_episode is not None
-                            else min(int(state.env_steps) // horizon,
-                                     self.cfg.runtime.episodes - 1))
+            raw = (int(saved_episode) if saved_episode is not None
+                   else int(state.env_steps) // horizon)
+            self.episode = max(0, min(raw, self.cfg.runtime.episodes - 1))
             log.info("resumed from checkpoint step=%d "
                      "(env cursor %d, %d updates, episode %d)", step,
                      int(state.env_state.t[0]), int(state.updates),

@@ -228,6 +228,50 @@ class TestPerAgentRecovery:
         assert snap["trained_workers"] == cfg.parallel.num_workers
         assert orch.get_avg().ok and np.isfinite(orch.get_avg().value)
 
+    def test_heal_budget_escalates_to_restart_path(self, tmp_path):
+        """Past runtime.max_agent_heals a per-row fault is treated as
+        systemic: it must route through the supervised restart path (and
+        its max_restarts budget) instead of heal->re-poison->heal forever.
+        Budget 0 = healing disabled entirely."""
+        cfg = fast_cfg(tmp_path)
+        cfg.runtime.max_agent_heals = 0
+        poisoned = []
+
+        def chaos(chunk_idx, metrics):
+            # Poison AFTER the chunk-1 checkpoint landed so the escalated
+            # restore has a clean state to come back to.
+            if chunk_idx == 2 and not poisoned:
+                poisoned.append(1)
+                ts = orch._ts
+                budget = np.asarray(jax.device_get(ts.env_state.budget)).copy()
+                budget[2] = np.nan
+                orch._ts = ts.replace(env_state=ts.env_state.replace(
+                    budget=jnp.asarray(budget)))
+
+        orch = Orchestrator(cfg, fault_hook=chaos)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.agent_heals == 0          # never healed in place...
+        assert orch.restarts >= 1             # ...restored from checkpoint
+
+    def test_resume_completed_run_recompletes_immediately(self, tmp_path):
+        """The FINAL checkpoint of a completed run stores the episode
+        counter already incremented past the last episode; resuming it must
+        clamp the index (send_training_data resume path) — unclamped it
+        sets an unreachable (episode+1)*horizon completion threshold and
+        the chunk loop spins forever with every agent frozen."""
+        cfg = fast_cfg(tmp_path)
+        orch = run_end_to_end(cfg, PRICES)
+        avg = orch.get_avg().value
+        resumed = Orchestrator(cfg)
+        resumed.send_training_data(PRICES, resume=True)
+        assert resumed.episode == cfg.runtime.episodes - 1  # clamped
+        resumed.start_training(background=True)
+        assert resumed.wait(120), "resumed run failed to re-complete"
+        assert resumed.is_everything_done().state is ReplyState.COMPLETED
+        assert resumed.get_avg().value == pytest.approx(avg, rel=1e-6)
+
     def test_recovery_disabled_completes_without_stranded_agent(self, tmp_path):
         """With partial_recovery=False a quarantined row can never respawn;
         the run must still COMPLETE (the stranded row counts as excluded)
