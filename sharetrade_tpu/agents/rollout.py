@@ -103,6 +103,37 @@ def collect_rollout(model: Model, env: TradingEnv,
     return new_ts, traj, bootstrap, init_carry
 
 
+def _trunk_precompute(model: Model, env: TradingEnv, params, state1, carry1,
+                      t_len: int, horizon: int):
+    """Shared single-representative-agent precompute for trunk rollouts
+    (training and greedy eval): the load-bearing alignment invariant —
+    trade price at step i = the newest tick of window i+1, fed to BOTH the
+    trunk's future_ticks and the priced env step — lives here once.
+
+    ``state1``/``carry1`` are batch-of-1 pytrees. Returns
+    ``(windows (T+1, W), trade_prices (T,), hn_base (T+1, d), carry_out)``.
+    """
+    window = model.obs_dim - 2
+
+    def window_at(i):
+        shifted = state1.replace(t=jnp.minimum(state1.t + i, horizon))
+        return jax.vmap(env.observe)(shifted)[0, :window]
+
+    windows = jax.vmap(window_at)(jnp.arange(t_len + 1))       # (T+1, W)
+    obs1_raw = jax.vmap(env.observe)(state1)
+    # Sanitize ONLY the wallet features: the price window comes from the
+    # static series (always finite) and is all the trunk reads — zeroing
+    # the whole row when agent 0's wallet is poisoned would corrupt the
+    # SHARED trunk for every healthy agent.
+    obs1 = jnp.concatenate(
+        [obs1_raw[:, :window],
+         jnp.where(jnp.isfinite(obs1_raw[:, window:]),
+                   obs1_raw[:, window:], 0.0)], axis=-1)
+    hn1, carry_out = model.apply_rollout_trunk(
+        params, obs1, windows[None, 1:, -1], carry1)
+    return windows, windows[1:, -1], hn1[0], carry_out
+
+
 def _collect_rollout_precomputed(model: Model, env: TradingEnv,
                                  ts: TrainState, unroll_len: int,
                                  num_agents: int):
@@ -142,38 +173,18 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
     # Agents frozen mid-unroll keep stale cursors; their rows are masked
     # inactive, exactly as the incremental path masked its lockstep carry.
     state1 = jax.tree.map(lambda x: x[:1], ts.env_state)   # agent 0
-
-    def window_at(i):
-        shifted = state1.replace(t=jnp.minimum(state1.t + i, horizon))
-        return jax.vmap(env.observe)(shifted)[0, :window]
-
-    windows = jax.vmap(window_at)(jnp.arange(unroll_len + 1))  # (T+1, W)
-    # Trade price at step i = the price just past step i's window == the
-    # newest price of step i+1's window.
-    trade_prices = windows[1:, -1]                             # (T,)
+    carry1 = jax.tree.map(lambda x: x[:1], ts.carry)
+    windows, trade_prices, hn_base, carry1_out = _trunk_precompute(
+        model, env, ts.params, state1, carry1, unroll_len, horizon)
+    new_model_carry = jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (num_agents,) + x.shape[1:]),
+        carry1_out)
 
     rng, k_noise = jax.random.split(ts.rng)
     # Gumbel-max sampling noise for the whole unroll: argmax(logits + g)
     # IS a categorical draw, with zero in-loop RNG traffic.
     gumbel = jax.random.gumbel(
         k_noise, (unroll_len, num_agents, model.num_actions), jnp.float32)
-
-    obs1_raw = jax.vmap(env.observe)(state1)
-    # Sanitize ONLY the wallet features: the price window comes from the
-    # static series (always finite) and is all the trunk reads — zeroing
-    # the whole row when agent 0's wallet is poisoned would corrupt the
-    # SHARED trunk for every healthy agent.
-    obs1 = jnp.concatenate(
-        [obs1_raw[:, :window],
-         jnp.where(jnp.isfinite(obs1_raw[:, window:]),
-                   obs1_raw[:, window:], 0.0)], axis=-1)
-    carry1 = jax.tree.map(lambda x: x[:1], ts.carry)
-    hn1, carry1_out = model.apply_rollout_trunk(
-        ts.params, obs1, windows[None, 1:, -1], carry1)
-    hn_base = hn1[0]                                           # (T+1, d)
-    new_model_carry = jax.tree.map(
-        lambda x: jnp.broadcast_to(x, (num_agents,) + x.shape[1:]),
-        carry1_out)
 
     step_priced = env.step_priced
 
@@ -233,6 +244,40 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
     new_ts = ts.replace(env_state=env_state, carry=new_model_carry, rng=rng,
                         env_steps=ts.env_steps + steps_taken)
     return new_ts, traj, bootstrap, init_carry
+
+
+def greedy_rollout_precomputed(model: Model, env: TradingEnv, params,
+                               *, horizon: int | None = None):
+    """Greedy (argmax) single-agent episode replay through the precomputed
+    trunk — the fast ``evaluate()`` path for trunk models. Same structure
+    as :func:`_collect_rollout_precomputed` (prices are action-independent,
+    so the whole episode's trunk is one banded pass) minus sampling,
+    batching, and quarantine. Returns ``(final_env_state, rewards (T,))``.
+    """
+    horizon = env.num_steps if horizon is None else horizon
+    state1 = jax.tree.map(lambda x: x[None], env.reset())   # batch of 1
+    carry1 = jax.tree.map(lambda x: x[None], model.init_carry())
+    windows, trade_prices, hn_base, _ = _trunk_precompute(
+        model, env, params, state1, carry1, horizon, horizon)
+    step_priced = env.step_priced
+
+    def one(env_state, inputs):
+        win_i, price_i, hn_i = inputs
+        obs = jnp.concatenate(
+            [win_i[None], env_state.budget[:, None],
+             env_state.shares[:, None]], axis=-1)
+        outs = model.apply_rollout_head(params, hn_i[None], obs)
+        action = jnp.argmax(outs.logits, axis=-1).astype(jnp.int32)
+        if step_priced is not None:
+            new_state, reward = jax.vmap(
+                step_priced, in_axes=(0, 0, None))(env_state, action, price_i)
+        else:
+            new_state, reward = jax.vmap(env.step)(env_state, action)
+        return new_state, reward[0]
+
+    final, rewards = jax.lax.scan(
+        one, state1, (windows[:-1], trade_prices, hn_base[:horizon]))
+    return jax.tree.map(lambda x: x[0], final), rewards
 
 
 #: Max observation rows per folded forward call — bounds replay activation

@@ -665,17 +665,27 @@ class Orchestrator:
         horizon = env.num_steps
         params = self._ts.params
 
-        def body(carry, _):
-            state, model_carry = carry
-            obs = env.observe(state)
-            out, model_carry = model.apply(params, obs, model_carry)
-            action = jnp.argmax(out.logits).astype(jnp.int32)
-            new_state, reward = env.step(state, action)
-            return (new_state, model_carry), reward
+        if model.apply_rollout_trunk is not None:
+            # Precomputed-trunk greedy replay: the whole episode's trunk is
+            # one banded pass (prices are action-independent), vs horizon
+            # sequential one-token cache-attention steps — the same
+            # inversion the training rollout uses (agents/rollout.py).
+            from sharetrade_tpu.agents.rollout import (
+                greedy_rollout_precomputed)
+            final, rewards = jax.jit(
+                lambda p: greedy_rollout_precomputed(model, env, p))(params)
+        else:
+            def body(carry, _):
+                state, model_carry = carry
+                obs = env.observe(state)
+                out, model_carry = model.apply(params, obs, model_carry)
+                action = jnp.argmax(out.logits).astype(jnp.int32)
+                new_state, reward = env.step(state, action)
+                return (new_state, model_carry), reward
 
-        (final, _), rewards = jax.jit(
-            lambda c: jax.lax.scan(body, c, None, length=horizon)
-        )((env.reset(), model.init_carry()))
+            (final, _), rewards = jax.jit(
+                lambda c: jax.lax.scan(body, c, None, length=horizon)
+            )((env.reset(), model.init_carry()))
         result = {
             "eval_portfolio": float(env.portfolio_value(final)),
             "eval_reward_sum": float(jnp.sum(rewards)),

@@ -227,6 +227,33 @@ class TestEpisodeMode:
         np.testing.assert_allclose(np.asarray(carry_tr["v"]),
                                    np.asarray(carry["v"]), atol=3e-4)
 
+    def test_greedy_eval_trunk_matches_incremental(self):
+        """Orchestrator.evaluate()'s precomputed-trunk greedy replay must
+        reproduce the per-step incremental greedy rollout (same argmax
+        actions, same rewards, same final portfolio)."""
+        from sharetrade_tpu.agents.rollout import greedy_rollout_precomputed
+
+        _, agent, env = self._setup()
+        model = agent.model
+        params = model.init(jax.random.PRNGKey(5))
+
+        final_t, rewards_t = greedy_rollout_precomputed(model, env, params)
+
+        state, carry = env.reset(), model.init_carry()
+        rewards_i = []
+        for _ in range(env.num_steps):
+            obs = env.observe(state)
+            out, carry = model.apply(params, obs, carry)
+            action = jnp.argmax(out.logits).astype(jnp.int32)
+            state, r = env.step(state, action)
+            rewards_i.append(float(r))
+
+        np.testing.assert_allclose(np.asarray(rewards_t),
+                                   np.asarray(rewards_i), atol=1e-3)
+        np.testing.assert_allclose(float(env.portfolio_value(final_t)),
+                                   float(env.portfolio_value(state)),
+                                   rtol=1e-5)
+
     def test_single_layer_no_history(self):
         # L=1: hist_len == 0 — the zero-width history path.
         from sharetrade_tpu.agents.rollout import collect_rollout, replay_forward
