@@ -168,6 +168,16 @@ def make_configs() -> dict[str, FrameworkConfig]:
             learner__unroll_len=1024, runtime__chunk_steps=1024,
             model__num_layers=2, model__num_heads=2, model__head_dim=128,
             model__dtype="bfloat16"),
+        # Large-model tier: d_model=1024 x 4 layers (~50M params). The MXU
+        # leaves the small-matmul regime (this chip sustains ~8-15 TF/s at
+        # d=256 vs ~60% of peak at d>=2048), so MFU — not steps/s — is the
+        # row's point.
+        "ppo_tr_episode_large_d1024": base(
+            learner__algo="ppo", model__kind="transformer",
+            model__seq_mode="episode", parallel__num_workers=64,
+            learner__unroll_len=512, runtime__chunk_steps=512,
+            model__num_layers=4, model__num_heads=8, model__head_dim=128,
+            model__dtype="bfloat16"),
         # The reference's ENTIRE workload as one compiled chunk: 10 workers x
         # the full 5,845-step episode (6,046 prices - 201 window,
         # env/trading.py num_steps), rollout + GAE + clipped updates, with

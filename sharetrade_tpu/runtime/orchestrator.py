@@ -96,6 +96,7 @@ class Orchestrator:
         self.env = None  # TradingEnv once data arrives
         self._ts: TrainState | None = None
         self._step_fn = None
+        self._eval_fn = None   # cached jitted greedy-eval program
         self._snapshot: dict[str, float] = {}
         self._snapshot_lock = threading.Lock()
         self._thread: threading.Thread | None = None
@@ -147,6 +148,7 @@ class Orchestrator:
                 initial_shares=self.cfg.env.initial_shares)
         self.agent = build_agent(self.cfg, self.env, mesh=self.mesh)
         self._build_step()
+        self._eval_fn = None   # env/model changed: retrace on next evaluate
         template = self.agent.init(jax.random.PRNGKey(self.cfg.seed))
         if resume:
             state, step = self.checkpoints.restore(template)
@@ -665,27 +667,41 @@ class Orchestrator:
         horizon = env.num_steps
         params = self._ts.params
 
-        if model.apply_rollout_trunk is not None:
-            # Precomputed-trunk greedy replay: the whole episode's trunk is
-            # one banded pass (prices are action-independent), vs horizon
-            # sequential one-token cache-attention steps — the same
-            # inversion the training rollout uses (agents/rollout.py).
-            from sharetrade_tpu.agents.rollout import (
-                greedy_rollout_precomputed)
-            final, rewards = jax.jit(
-                lambda p: greedy_rollout_precomputed(model, env, p))(params)
-        else:
-            def body(carry, _):
-                state, model_carry = carry
-                obs = env.observe(state)
-                out, model_carry = model.apply(params, obs, model_carry)
-                action = jnp.argmax(out.logits).astype(jnp.int32)
-                new_state, reward = env.step(state, action)
-                return (new_state, model_carry), reward
+        # The jitted eval program is cached on the orchestrator (jit caches
+        # by function identity — a fresh lambda per call would retrace the
+        # full-episode program on every evaluate(), tens of seconds at
+        # larger models); send_training_data invalidates it. Both branches
+        # are params -> (final_env_state, rewards) so params never freeze
+        # into the cached closure.
+        if self._eval_fn is None:
+            if model.apply_rollout_trunk is not None:
+                # Precomputed-trunk greedy replay: the whole episode's
+                # trunk is one banded pass (prices are action-independent),
+                # vs horizon sequential one-token cache-attention steps —
+                # the same inversion the training rollout uses
+                # (agents/rollout.py).
+                from sharetrade_tpu.agents.rollout import (
+                    greedy_rollout_precomputed)
+                self._eval_fn = jax.jit(
+                    lambda p: greedy_rollout_precomputed(model, env, p))
+            else:
+                def greedy_scan(p):
+                    def body(carry, _):
+                        state, model_carry = carry
+                        obs = env.observe(state)
+                        out, model_carry = model.apply(p, obs, model_carry)
+                        action = jnp.argmax(out.logits).astype(jnp.int32)
+                        new_state, reward = env.step(state, action)
+                        return (new_state, model_carry), reward
 
-            (final, _), rewards = jax.jit(
-                lambda c: jax.lax.scan(body, c, None, length=horizon)
-            )((env.reset(), model.init_carry()))
+                    (final, _), rewards = jax.lax.scan(
+                        body, (env.reset(), model.init_carry()), None,
+                        length=horizon)
+                    return final, rewards
+
+                self._eval_fn = jax.jit(greedy_scan)
+
+        final, rewards = self._eval_fn(params)
         result = {
             "eval_portfolio": float(env.portfolio_value(final)),
             "eval_reward_sum": float(jnp.sum(rewards)),
