@@ -17,7 +17,8 @@ from sharetrade_tpu.agents.base import (
     portfolio_metrics,
 )
 from sharetrade_tpu.agents.rollout import (
-    collect_rollout, discounted_returns, replay_forward,
+    collect_rollout, discounted_returns, normalize_advantages_masked,
+    replay_forward,
 )
 from sharetrade_tpu.config import LearnerConfig
 from sharetrade_tpu.env.core import TradingEnv
@@ -55,6 +56,8 @@ def make_a2c_agent(model: Model, env: TradingEnv,
             logp = jnp.take_along_axis(
                 log_probs, traj.action[..., None], axis=-1)[..., 0]
             adv = jax.lax.stop_gradient(returns - values) * weight
+            if cfg.normalize_advantages:
+                adv = normalize_advantages_masked(adv, weight, denom)
             policy_loss = -jnp.sum(logp * adv) / denom
             value_loss = jnp.sum(jnp.square(values - returns) * weight) / denom
             entropy = -jnp.sum(

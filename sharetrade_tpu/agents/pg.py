@@ -16,7 +16,8 @@ from sharetrade_tpu.agents.base import (
     portfolio_metrics,
 )
 from sharetrade_tpu.agents.rollout import (
-    collect_rollout, discounted_returns, replay_forward,
+    collect_rollout, discounted_returns, normalize_advantages_masked,
+    replay_forward,
 )
 from sharetrade_tpu.config import LearnerConfig
 from sharetrade_tpu.env.core import TradingEnv
@@ -48,6 +49,8 @@ def make_pg_agent(model: Model, env: TradingEnv,
         denom = jnp.maximum(jnp.sum(weight), 1.0)
         baseline = jnp.sum(returns * weight) / denom
         adv = (returns - baseline) * weight
+        if cfg.normalize_advantages:
+            adv = normalize_advantages_masked(adv, weight, denom)
 
         def loss_fn(params):
             logits, _, aux = replay_forward(model, params, traj, init_carry,

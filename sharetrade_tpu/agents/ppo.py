@@ -21,7 +21,8 @@ from sharetrade_tpu.agents.base import (
     portfolio_metrics,
 )
 from sharetrade_tpu.agents.rollout import (
-    collect_rollout, gae_advantages, replay_forward,
+    collect_rollout, gae_advantages, normalize_advantages_masked,
+    replay_forward,
 )
 from sharetrade_tpu.config import LearnerConfig
 from sharetrade_tpu.env.core import TradingEnv
@@ -65,10 +66,10 @@ def make_ppo_agent(model: Model, env: TradingEnv,
         weight = traj_mb.active
         denom = jnp.maximum(jnp.sum(weight), 1.0)
 
-        # Advantage normalization over the minibatch's active steps.
-        adv_mean = jnp.sum(adv_mb * weight) / denom
-        adv_var = jnp.sum(jnp.square(adv_mb - adv_mean) * weight) / denom
-        adv = (adv_mb - adv_mean) * jax.lax.rsqrt(adv_var + 1e-8)
+        # Advantage normalization over the minibatch's active steps (the
+        # shared masked normalizer; its re-masking is idempotent under the
+        # loss terms' own * weight factors).
+        adv = normalize_advantages_masked(adv_mb, weight, denom)
 
         ratio = jnp.exp(logp - traj_mb.logp)
         clipped = jnp.clip(ratio, 1.0 - cfg.clip_eps, 1.0 + cfg.clip_eps)

@@ -363,6 +363,19 @@ def replay_forward(model: Model, params: Any, traj: StepData, init_carry,
     return logits, values, jnp.mean(aux)  # (T, B, A), (T, B), scalar
 
 
+def normalize_advantages_masked(adv: jax.Array, weight: jax.Array,
+                                denom: jax.Array) -> jax.Array:
+    """Zero-mean unit-variance advantages over the ACTIVE steps, re-masked —
+    THE normalization every policy-gradient learner shares (PPO always, PG/
+    A2C via ``learner.normalize_advantages``), so the epsilon and masking
+    convention cannot drift between estimators. ``weight`` is the binary
+    active mask; ``denom`` its (clamped) sum. Idempotent under the losses'
+    own later ``* weight`` factors."""
+    mean = jnp.sum(adv * weight) / denom
+    var = jnp.sum(jnp.square(adv - mean) * weight) / denom
+    return (adv - mean) * jax.lax.rsqrt(var + 1e-8) * weight
+
+
 def discounted_returns(rewards: jax.Array, active: jax.Array,
                        bootstrap: jax.Array, gamma: float) -> jax.Array:
     """Returns-to-go R_t = r_t + γ R_{t+1}, seeded with the bootstrap value;

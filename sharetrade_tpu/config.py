@@ -126,6 +126,14 @@ class LearnerConfig:
     # Weight on the model's auxiliary loss (ModelOut.aux — the MoE balance
     # regularizer); inert (aux = 0) for dense models.
     aux_loss_coef: float = 0.01
+    # Normalize advantages to zero mean / unit variance over the unroll's
+    # active steps before the policy-gradient term (PG and A2C; PPO always
+    # normalizes per minibatch, its standard form). Off by default — raw
+    # advantages are the textbook PG/A2C estimators and the parity-test
+    # numerics — but strongly recommended for training stability: the raw
+    # advantage scale tracks the portfolio's reward scale, which wanders
+    # over decades of prices.
+    normalize_advantages: bool = False
     # PPO/A2C:
     entropy_coef: float = 0.01
     value_coef: float = 0.5

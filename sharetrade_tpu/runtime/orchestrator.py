@@ -653,16 +653,6 @@ class Orchestrator:
         scan on the current params; training state is untouched."""
         if self.agent is None or self._ts is None:
             raise RuntimeError("no training data / state")
-        # Evaluate the exact network that was trained (the agent carries its
-        # model) — rebuilding from config here would silently evaluate a
-        # different architecture whenever a custom model was injected.
-        model = self.agent.model
-        if model is None:
-            from sharetrade_tpu.models import build_model
-            from sharetrade_tpu.agents import _HEADS  # registry head mapping
-            model = build_model(self.cfg.model, self.env.obs_dim,
-                                head=_HEADS[self.cfg.learner.algo],
-                                num_actions=self.env.num_actions)
         env = self.env
         horizon = env.num_steps
         params = self._ts.params
@@ -674,6 +664,17 @@ class Orchestrator:
         # are params -> (final_env_state, rewards) so params never freeze
         # into the cached closure.
         if self._eval_fn is None:
+            # Evaluate the exact network that was trained (the agent carries
+            # its model) — rebuilding from config here would silently
+            # evaluate a different architecture whenever a custom model was
+            # injected. Resolved only on a cache miss.
+            model = self.agent.model
+            if model is None:
+                from sharetrade_tpu.models import build_model
+                from sharetrade_tpu.agents import _HEADS  # registry heads
+                model = build_model(self.cfg.model, self.env.obs_dim,
+                                    head=_HEADS[self.cfg.learner.algo],
+                                    num_actions=self.env.num_actions)
             if model.apply_rollout_trunk is not None:
                 # Precomputed-trunk greedy replay: the whole episode's
                 # trunk is one banded pass (prices are action-independent),

@@ -321,6 +321,25 @@ def test_every_algorithm_trains_a_chunk(algo):
     assert int(ts3.env_steps) >= int(ts2.env_steps)
 
 
+@pytest.mark.parametrize("algo", ["pg", "a2c"])
+def test_normalized_advantages_reachable_and_change_training(algo):
+    """learner.normalize_advantages must actually alter the PG/A2C update
+    (zero-mean unit-variance advantages over active steps) — not silently
+    no-op — while the default-off path preserves the textbook estimator."""
+    outs = {}
+    for norm in (False, True):
+        cfg = tiny_config(algo, normalize_advantages=norm, gamma=0.9)
+        agent = build_agent(cfg, tiny_env())
+        ts = agent.init(jax.random.PRNGKey(0))
+        ts2, metrics = jax.jit(agent.step)(ts)
+        assert np.isfinite(float(metrics["loss"])), (algo, norm)
+        outs[norm] = jax.device_get(ts2.params)
+    diffs = [float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+             for a, b in zip(jax.tree.leaves(outs[False]),
+                             jax.tree.leaves(outs[True]))]
+    assert max(diffs) > 0, f"{algo}: normalization changed nothing"
+
+
 def test_value_based_algos_reject_recurrent_models():
     cfg = tiny_config("dqn")
     cfg.model.kind = "lstm"
