@@ -14,7 +14,8 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
-from sharetrade_tpu.agents.base import TrainState, quarantine_mask
+from sharetrade_tpu.agents.base import (
+    TrainState, agent_health, quarantine_mask)
 from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models.core import Model, apply_batched
 
@@ -28,6 +29,19 @@ class StepData(NamedTuple):
     value: jax.Array    # (B,) critic estimate at obs
     reward: jax.Array   # (B,)
     active: jax.Array   # (B,) f32 1.0 while the episode is running
+
+
+def supports_precomputed_trunk(model: Model, env: TradingEnv) -> bool:
+    """THE dispatch predicate for the precomputed-rollout fast path, shared
+    by training (collect_rollout) and greedy eval (Orchestrator.evaluate).
+    The path hard-codes the single-asset trading layout — obs =
+    [window | budget, shares] with a SCALAR wallet and a priced step — so a
+    trunk-capable model alone is not enough: a one-asset portfolio env has
+    num_assets == 1 but a (1,)-vector shares leaf (env/portfolio.py), which
+    only the ``step_priced is not None`` check (set solely by
+    make_trading_env) excludes."""
+    return (model.apply_rollout_trunk is not None
+            and env.num_assets == 1 and env.step_priced is not None)
 
 
 def collect_rollout(model: Model, env: TradingEnv,
@@ -44,7 +58,9 @@ def collect_rollout(model: Model, env: TradingEnv,
     the unroll's entire trunk runs as ONE pass up front and the sequential
     env loop applies only the tiny state-dependent head per step.
     """
-    if model.apply_rollout_trunk is not None:
+    # Envs outside the single-asset trading layout would be fed malformed
+    # observations by the fast path; they use the generic per-step loop.
+    if supports_precomputed_trunk(model, env):
         return _collect_rollout_precomputed(
             model, env, ts, unroll_len, num_agents)
     horizon = env.num_steps
@@ -164,16 +180,22 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
     # TPU and a threefry split ~120 us, vs ~0.1 us for elementwise math;
     # as single ops out here they cost milliseconds total) ---------------
     #
-    # Agent-invariance: every agent replays the SAME price series in
-    # LOCKSTEP (batched_reset broadcasts one reset state; the episode-mode
-    # trunk models are excluded from per-agent row respawn precisely to
-    # keep this, orchestrator._heal_agents), so the price windows AND the
-    # whole trunk are computed for ONE representative agent and broadcast —
-    # the trunk's cost and the window gather drop by a factor of B.
-    # Agents frozen mid-unroll keep stale cursors; their rows are masked
-    # inactive, exactly as the incremental path masked its lockstep carry.
-    state1 = jax.tree.map(lambda x: x[:1], ts.env_state)   # agent 0
-    carry1 = jax.tree.map(lambda x: x[:1], ts.carry)
+    # Agent-invariance: every HEALTHY agent replays the SAME price series
+    # in LOCKSTEP (batched_reset broadcasts one reset state, and any
+    # per-agent respawn must keep healthy rows lockstep —
+    # orchestrator._heal_agents), so the price windows AND the whole trunk
+    # are computed for ONE representative agent and broadcast — the trunk's
+    # cost and the window gather drop by a factor of B. The representative
+    # must be a healthy row: a quarantined row's cursor freezes while the
+    # broadcast carry['t'] keeps advancing, so electing it would feed every
+    # healthy agent windows from a stale cursor with desynced RoPE
+    # positions. argmax picks the first healthy row (row 0 if none exist —
+    # then every row is inactive and the chunk is a masked no-op anyway).
+    rep = jnp.argmax(agent_health(ts.env_state)).astype(jnp.int32)
+    take_rep = lambda x: jax.lax.dynamic_index_in_dim(x, rep, 0,
+                                                      keepdims=True)
+    state1 = jax.tree.map(take_rep, ts.env_state)
+    carry1 = jax.tree.map(take_rep, ts.carry)
     windows, trade_prices, hn_base, carry1_out = _trunk_precompute(
         model, env, ts.params, state1, carry1, unroll_len, horizon)
     new_model_carry = jax.tree.map(
@@ -210,11 +232,9 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
         logp = jnp.sum(
             log_probs * jax.nn.one_hot(actions, log_probs.shape[-1]), axis=-1)
 
-        if step_priced is not None:
-            stepped, rewards = jax.vmap(
-                step_priced, in_axes=(0, 0, None))(env_state, actions, price_i)
-        else:
-            stepped, rewards = jax.vmap(env.step)(env_state, actions)
+        # step_priced is guaranteed by supports_precomputed_trunk.
+        stepped, rewards = jax.vmap(
+            step_priced, in_axes=(0, 0, None))(env_state, actions, price_i)
         mask = active.astype(bool)
         new_env = jax.tree.map(
             lambda new, old: jnp.where(
@@ -268,11 +288,8 @@ def greedy_rollout_precomputed(model: Model, env: TradingEnv, params,
              env_state.shares[:, None]], axis=-1)
         outs = model.apply_rollout_head(params, hn_i[None], obs)
         action = jnp.argmax(outs.logits, axis=-1).astype(jnp.int32)
-        if step_priced is not None:
-            new_state, reward = jax.vmap(
-                step_priced, in_axes=(0, 0, None))(env_state, action, price_i)
-        else:
-            new_state, reward = jax.vmap(env.step)(env_state, action)
+        new_state, reward = jax.vmap(
+            step_priced, in_axes=(0, 0, None))(env_state, action, price_i)
         return new_state, reward[0]
 
     final, rewards = jax.lax.scan(

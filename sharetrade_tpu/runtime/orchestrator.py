@@ -290,6 +290,7 @@ class Orchestrator:
                     self._snapshot = metrics
                 self.metrics.record_many(metrics)
 
+                workers = self.cfg.parallel.num_workers
                 if (rt.partial_recovery
                         and metrics.get("unhealthy_workers", 0) > 0):
                     # Quarantined rows detected: respawn just those agents
@@ -336,7 +337,6 @@ class Orchestrator:
                 # including replacements, TrainerRouterActor.scala:114,125).
                 done_steps = (int(metrics.get("env_steps", 0))
                               >= horizon * (self.episode + 1))
-                workers = self.cfg.parallel.num_workers
                 # With partial_recovery off, a quarantined row can never be
                 # respawned: it would strand the all-trained gate forever
                 # (the learners' on-device quarantine is unconditional), so
@@ -368,6 +368,19 @@ class Orchestrator:
                                      **timer.summary())
                     log.info("training completed at %d env steps", horizon)
                     return
+                if (not rt.partial_recovery
+                        and metrics.get("unhealthy_workers", 0) >= workers):
+                    # Every row non-finite with healing disabled AND the run
+                    # not complete: the unconditional on-device quarantine
+                    # freezes every cursor, so no further progress is
+                    # possible — route through the supervision path instead
+                    # of spinning chunks forever. (Checked AFTER the
+                    # completion gate: a run whose last chunk both finishes
+                    # the episode and poisons every row still completes via
+                    # the stranded-rows-excluded path above.)
+                    raise RuntimeError(
+                        "all agent rows non-finite (partial_recovery off); "
+                        "no further progress is possible")
             except Exception as exc:  # supervision decider
                 self.last_error = exc
                 verb = self._decide(exc)
@@ -452,7 +465,12 @@ class Orchestrator:
         transformer_episode.apply_batch)."""
         if self._step_override is not None or self.agent is None:
             return False
-        if getattr(self.agent.model, "name", "") == "transformer_episode":
+        if getattr(self.agent.model, "apply_rollout_trunk", None) is not None:
+            # Trunk-rollout models share one representative agent's windows
+            # and carry across the batch (agents/rollout.py agent-invariance)
+            # — a row respawned to a fresh cursor would be healthy-but-
+            # desynced and could be elected representative. Gated on the
+            # capability the invariant depends on, not the model name.
             return False
         from sharetrade_tpu.agents.base import agent_health
         ts = self._ts
@@ -675,7 +693,9 @@ class Orchestrator:
                 model = build_model(self.cfg.model, self.env.obs_dim,
                                     head=_HEADS[self.cfg.learner.algo],
                                     num_actions=self.env.num_actions)
-            if model.apply_rollout_trunk is not None:
+            from sharetrade_tpu.agents.rollout import (
+                supports_precomputed_trunk)
+            if supports_precomputed_trunk(model, env):
                 # Precomputed-trunk greedy replay: the whole episode's
                 # trunk is one banded pass (prices are action-independent),
                 # vs horizon sequential one-token cache-attention steps —

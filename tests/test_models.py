@@ -227,6 +227,37 @@ class TestEpisodeMode:
         np.testing.assert_allclose(np.asarray(carry_tr["v"]),
                                    np.asarray(carry["v"]), atol=3e-4)
 
+    def test_quarantined_representative_row_does_not_corrupt_trunk(self):
+        """The shared-trunk rollout elects a HEALTHY representative row: a
+        quarantined row's cursor freezes while the broadcast carry keeps
+        advancing, so electing it (the old fixed row 0) would feed every
+        healthy agent windows from a stale cursor with desynced RoPE
+        positions. Poison row 0, roll two more chunks, and compare the
+        healthy rows' trajectories against an unpoisoned twin."""
+        from sharetrade_tpu.agents.rollout import collect_rollout
+
+        _, agent, env = self._setup(num_agents=3)
+        model = agent.model
+        ts = agent.init(jax.random.PRNGKey(0))
+        ts, *_ = collect_rollout(model, env, ts, 8, 3)   # chunk A: healthy
+        twin = ts
+
+        budget = np.asarray(ts.env_state.budget).copy()
+        budget[0] = np.nan                               # row 0 poisoned
+        ts = ts.replace(env_state=ts.env_state.replace(
+            budget=jnp.asarray(budget)))
+
+        for _ in range(2):                               # chunks B, C
+            ts, traj_p, _, _ = collect_rollout(model, env, ts, 8, 3)
+            twin, traj_t, _, _ = collect_rollout(model, env, twin, 8, 3)
+            np.testing.assert_allclose(
+                np.asarray(traj_p.obs[:, 1:]), np.asarray(traj_t.obs[:, 1:]),
+                atol=1e-5, err_msg="healthy rows fed stale-cursor windows")
+            np.testing.assert_array_equal(np.asarray(traj_p.action[:, 1:]),
+                                          np.asarray(traj_t.action[:, 1:]))
+        np.testing.assert_array_equal(np.asarray(ts.env_state.t[1:]),
+                                      np.asarray(twin.env_state.t[1:]))
+
     def test_greedy_eval_trunk_matches_incremental(self):
         """Orchestrator.evaluate()'s precomputed-trunk greedy replay must
         reproduce the per-step incremental greedy rollout (same argmax

@@ -120,6 +120,39 @@ class TestPortfolioTraining:
         assert np.isfinite(float(metrics["portfolio_mean"]))
 
 
+class TestRolloutDispatch:
+    def test_trunk_capable_model_on_multiasset_env_uses_generic_path(
+            self, monkeypatch):
+        """The precomputed-rollout fast path hard-codes the single-asset
+        obs layout (window | budget | shares); a trunk-capable model over a
+        multi-asset env must fall back to the generic per-step loop instead
+        of assembling malformed observations."""
+        from sharetrade_tpu.agents import rollout as rmod
+        from sharetrade_tpu.agents.base import (
+            TrainState, batched_carry, batched_reset)
+        from sharetrade_tpu.models.transformer_episode import (
+            episode_transformer_policy)
+
+        env = two_asset_env()
+        model = episode_transformer_policy(
+            env.obs_dim, env.num_actions, num_layers=1, num_heads=2,
+            head_dim=8)
+        assert model.apply_rollout_trunk is not None
+        monkeypatch.setattr(
+            rmod, "_collect_rollout_precomputed",
+            lambda *a, **k: pytest.fail(
+                "precomputed fast path taken for a multi-asset env"))
+        k = jax.random.PRNGKey(0)
+        ts = TrainState(
+            params=model.init(k), opt_state=None,
+            carry=batched_carry(model, 2), env_state=batched_reset(env, 2),
+            rng=jax.random.PRNGKey(1), env_steps=jnp.int32(0),
+            updates=jnp.int32(0))
+        _, traj, _, _ = rmod.collect_rollout(model, env, ts, 3, 2)
+        assert traj.obs.shape == (3, 2, env.obs_dim)
+        assert np.isfinite(np.asarray(traj.obs)).all()
+
+
 class TestAlignSeries:
     def test_inner_join_on_dates(self):
         a = from_rows("A", [("2020-01-01", 1.0), ("2020-01-02", 2.0),

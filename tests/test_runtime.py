@@ -300,6 +300,34 @@ class TestPerAgentRecovery:
         assert snap["trained_workers"] == cfg.parallel.num_workers - 1
         assert np.isfinite(orch.get_avg().value)    # ...and excluded
 
+    def test_all_rows_poisoned_without_recovery_routes_to_restart(self, tmp_path):
+        """With partial_recovery=False and EVERY row non-finite the run can
+        make no progress (the unconditional quarantine freezes every
+        cursor); it must raise into the supervision path — restore from
+        checkpoint and complete — instead of spinning chunks forever."""
+        cfg = fast_cfg(tmp_path)
+        cfg.runtime.partial_recovery = False
+        poisoned = []
+
+        def chaos(chunk_idx, metrics):
+            # Poison AFTER the chunk-1 checkpoint landed so the restore
+            # has a clean state to come back to.
+            if chunk_idx == 2 and not poisoned:
+                poisoned.append(1)
+                ts = orch._ts
+                budget = np.asarray(jax.device_get(ts.env_state.budget)).copy()
+                budget[:] = np.nan
+                orch._ts = ts.replace(env_state=ts.env_state.replace(
+                    budget=jnp.asarray(budget)))
+
+        orch = Orchestrator(cfg, fault_hook=chaos)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=True)
+        assert orch.wait(180), \
+            "all-stranded run neither completed nor failed (infinite spin)"
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.restarts >= 1 and orch.agent_heals == 0
+
     def test_poisoned_shared_params_fall_back_to_restore(self, tmp_path):
         """When poison breaches into the SHARED state (params), a row
         respawn can't help: the non-finite-loss detector must route through
