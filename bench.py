@@ -39,14 +39,14 @@ from sharetrade_tpu.utils.flops import mfu
 REFERENCE_CEILING_STEPS_PER_S = 58_450 / 1_005.0  # ≈58.2, derivation above
 
 
-def bench_flagship() -> dict:
-    """Episode-mode PPO transformer, saturating config (BASELINE.md's
-    b128 × u1024 bf16 row): chunks repeat on fresh inits whenever the next
-    chunk would outrun the horizon, so every timed step is live. The config
-    is the CANONICAL one from benchmarks/run_all.py so this headline and
-    the ladder row can never silently measure different workloads."""
+def bench_episode_config(config_name: str, metric: str, *,
+                         reps: int = 2) -> dict:
+    """Time one of the canonical episode-mode PPO configs from
+    benchmarks/run_all.py (so bench.py and the ladder can never silently
+    measure different workloads): chunks repeat on fresh inits whenever the
+    next chunk would outrun the horizon, so every timed step is live."""
     from benchmarks.run_all import make_configs
-    cfg = make_configs()["ppo_tr_episode_b128_u1024_bf16"]
+    cfg = make_configs()[config_name]
 
     series = synthetic_price_series(length=6046)
     env_params = trading.env_from_prices(
@@ -62,7 +62,7 @@ def bench_flagship() -> dict:
     ts, _ = step(ts)                # compile + warm chunk
     jax.block_until_ready(ts.params)
 
-    reps, timed_chunks = 2, 0
+    timed_chunks = 0
     t0 = time.perf_counter()
     for rep in range(reps):
         ts = agent.init(jax.random.PRNGKey(rep + 1))
@@ -76,12 +76,29 @@ def bench_flagship() -> dict:
                    * cfg.parallel.num_workers)
     rate = agent_steps / elapsed
     return {
-        "metric": "flagship_episode_ppo_agent_steps_per_sec_per_chip",
+        "metric": metric,
         "value": round(rate, 2),
         "unit": "agent-steps/s",
         "vs_baseline": round(rate / REFERENCE_CEILING_STEPS_PER_S, 2),
         "mfu": round(mfu(rate, cfg, env_params.window + 2), 6),
     }
+
+
+def bench_flagship() -> dict:
+    """The flagship: BASELINE.md's b128 × u1024 bf16 episode row."""
+    return bench_episode_config(
+        "ppo_tr_episode_b128_u1024_bf16",
+        "flagship_episode_ppo_agent_steps_per_sec_per_chip")
+
+
+def bench_large_model() -> dict:
+    """The MFU tier: d_model=1024 (L4 × H8 × Dh128), b64 × u512 bf16 — the
+    row whose measured ~41% MFU pins the d=256 flagship's ~14-18% as this
+    chip's small-matmul regime, re-measured every round instead of frozen
+    in BASELINE.md (round-3 verdict action #8)."""
+    return bench_episode_config(
+        "ppo_tr_episode_large_d1024",
+        "large_d1024_episode_ppo_agent_steps_per_sec_per_chip")
 
 
 def bench_reference_shape() -> dict:
@@ -133,9 +150,11 @@ def bench_reference_shape() -> dict:
 
 def main() -> None:
     # ONE JSON line (the driver contract): the flagship headline, with the
-    # reference-shape row nested so both workloads stay recorded.
+    # reference-shape and large-model rows nested so all three workloads
+    # stay recorded every round.
     result = bench_flagship()
     result["reference_shape"] = bench_reference_shape()
+    result["large_model"] = bench_large_model()
     print(json.dumps(result), flush=True)
 
 

@@ -328,6 +328,16 @@ def replay_forward(model: Model, params: Any, traj: StepData, init_carry,
     recomputes from stored observations — the FLOPs-for-HBM trade that
     makes large agent batches fit.
     """
+    if model.apply_unroll_shared is not None:
+        # Shared-trunk replay: the banded pass runs ONCE for a
+        # representative row and only the portfolio head runs per agent —
+        # valid because every learner in this framework keeps the agent
+        # batch lockstep over one shared price series (models/core.py
+        # apply_unroll_shared; the factor-B update-phase redundancy).
+        fwd = model.apply_unroll_shared
+        if remat:
+            fwd = jax.checkpoint(fwd)
+        return fwd(params, traj.obs, init_carry)
     if model.apply_unroll is not None:
         # The model replays a whole trajectory natively (episode-mode
         # transformer: one banded pass over the unroll's tick sequence

@@ -19,6 +19,25 @@ from sharetrade_tpu.models.transformer import transformer_policy
 _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
 
 
+def _validate_moe_dispatch(cfg: ModelConfig, ep_mesh) -> None:
+    """MoE dispatch validation shared by the window and episode branches."""
+    if cfg.moe_dispatch not in ("psum", "a2a"):
+        raise ValueError(
+            f"unknown model.moe_dispatch {cfg.moe_dispatch!r} "
+            "(expected 'psum' or 'a2a')")
+    if cfg.moe_dispatch == "a2a" and cfg.moe_experts:
+        if not cfg.moe_top_k:
+            raise ValueError(
+                "model.moe_dispatch='a2a' is a top-k dispatch pattern; "
+                "set model.moe_top_k>0 (the dense-mask top-1 scheme has "
+                "no capacity buffers to all_to_all)")
+        if ep_mesh is None:
+            raise ValueError(
+                "model.moe_dispatch='a2a' needs a mesh with an 'ep' "
+                "axis (set parallel.mesh_shape, e.g. "
+                "{\"dp\": 2, \"ep\": 4})")
+
+
 def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
                 parity: bool = False, num_actions: int | None = None,
                 mesh=None) -> Model:
@@ -62,13 +81,12 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
         use_pallas = (False if mesh is not None
                       and mesh.devices.flat[0].platform != "tpu" else None)
         if cfg.seq_mode == "episode":
-            if (cfg.attention not in ("flash", "ring") or cfg.pipeline_blocks
-                    or cfg.moe_experts):
+            if cfg.attention not in ("flash", "ring"):
                 raise ValueError(
                     "model.seq_mode='episode' supports attention='flash' "
-                    "(local banded) or 'ring' (sp halo exchange) — no "
-                    "ulysses/pipeline_blocks/moe; drop those options or use "
-                    "seq_mode='window'")
+                    "(local banded) or 'ring' (the sp halo exchange — "
+                    "episode mode's sequence-parallel scheme); ulysses is "
+                    "window-mode only")
             episode_attention = None
             if cfg.attention == "ring":
                 if mesh is None or "sp" not in mesh.axis_names:
@@ -76,17 +94,38 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
                         "model.attention='ring' needs a mesh with an 'sp' "
                         "axis (set parallel.mesh_shape, e.g. "
                         "{\"dp\": 2, \"sp\": 4})")
+                if cfg.pipeline_blocks:
+                    raise ValueError(
+                        "model.attention='ring' + model.pipeline_blocks is "
+                        "unsupported (no sp attention inside a pipeline "
+                        "stage); pick one partitioning")
                 from sharetrade_tpu.parallel.episode_sp import (
                     halo_banded_attention_sharded)
                 episode_attention = halo_banded_attention_sharded(
                     mesh, seq_axis="sp", batch_axis=batch_axis,
                     use_pallas=use_pallas)
+            ep_pp_mesh = None
+            if cfg.pipeline_blocks:
+                if mesh is None or "pp" not in mesh.axis_names:
+                    raise ValueError(
+                        "model.pipeline_blocks needs a mesh with a 'pp' "
+                        "axis (set parallel.mesh_shape, e.g. "
+                        "{\"dp\": 2, \"pp\": 4})")
+                ep_pp_mesh = mesh
+            ep_mesh = (mesh if cfg.moe_experts and mesh is not None
+                       and "ep" in mesh.axis_names else None)
+            _validate_moe_dispatch(cfg, ep_mesh)
             from sharetrade_tpu.models.transformer_episode import (
                 episode_transformer_policy)
             return episode_transformer_policy(
                 obs_dim, actions, num_layers=cfg.num_layers,
                 num_heads=cfg.num_heads, head_dim=cfg.head_dim, dtype=dtype,
-                use_pallas=use_pallas, attention_fn=episode_attention)
+                use_pallas=use_pallas, attention_fn=episode_attention,
+                pp_mesh=ep_pp_mesh, pp_batch_axis=batch_axis,
+                moe_experts=cfg.moe_experts, ep_mesh=ep_mesh,
+                moe_top_k=cfg.moe_top_k,
+                moe_capacity_factor=cfg.moe_capacity_factor,
+                moe_dispatch=cfg.moe_dispatch)
         if cfg.attention in ("ring", "ulysses"):
             if mesh is None or "sp" not in mesh.axis_names:
                 raise ValueError(
@@ -122,21 +161,7 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
         # reachability doesn't depend on the mesh).
         ep_mesh = (mesh if cfg.moe_experts and mesh is not None
                    and "ep" in mesh.axis_names else None)
-        if cfg.moe_dispatch not in ("psum", "a2a"):
-            raise ValueError(
-                f"unknown model.moe_dispatch {cfg.moe_dispatch!r} "
-                "(expected 'psum' or 'a2a')")
-        if cfg.moe_dispatch == "a2a" and cfg.moe_experts:
-            if not cfg.moe_top_k:
-                raise ValueError(
-                    "model.moe_dispatch='a2a' is a top-k dispatch pattern; "
-                    "set model.moe_top_k>0 (the dense-mask top-1 scheme has "
-                    "no capacity buffers to all_to_all)")
-            if ep_mesh is None:
-                raise ValueError(
-                    "model.moe_dispatch='a2a' needs a mesh with an 'ep' "
-                    "axis (set parallel.mesh_shape, e.g. "
-                    "{\"dp\": 2, \"ep\": 4})")
+        _validate_moe_dispatch(cfg, ep_mesh)
         return transformer_policy(
             obs_dim, actions, num_layers=cfg.num_layers,
             num_heads=cfg.num_heads, head_dim=cfg.head_dim, dtype=dtype,

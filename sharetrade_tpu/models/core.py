@@ -79,6 +79,21 @@ class Model:
                                   tuple[jax.Array, Any]] | None = None
     apply_rollout_head: Callable[[Any, jax.Array, jax.Array],
                                  ModelOut] | None = None
+    # Optional SHARED-TRUNK training replay: same signature and output as
+    # apply_unroll, but exploiting the same agent-invariance as the
+    # precomputed-rollout pair — every healthy agent's stored price series
+    # is identical (lockstep batch over one shared series; quarantined rows
+    # are zero-sanitized and loss-masked), so the banded trunk runs ONCE
+    # for a representative row and only the portfolio head runs per agent.
+    # Removes the factor-B trunk redundancy of apply_unroll from the PPO/
+    # PG/A2C update phase (B=128 at the flagship shape — the update was the
+    # measured 70% of the post-round-3 chunk). Gradients are exact, not
+    # approximate: B identical trunk paths, each pulled back by its agent's
+    # head cotangent, equal one shared path pulled back by their sum.
+    # Provided only by models whose learners guarantee the lockstep
+    # invariant (see agents/rollout.py agent-invariance notes).
+    apply_unroll_shared: Callable[[Any, jax.Array, Any],
+                                  tuple[jax.Array, jax.Array, jax.Array]] | None = None
 
 
 def apply_batched(model: Model, params: Any, obs_batch: jax.Array,

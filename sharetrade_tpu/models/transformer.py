@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from sharetrade_tpu.models.core import (
     Model, ModelOut, dense, dense_init, portfolio_features,
     tick_window_features)
+from sharetrade_tpu.models.ffn import ffn_apply
 from sharetrade_tpu.ops.attention import flash_attention
 
 
@@ -123,43 +124,12 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
             bsz, t, d_model).astype(dtype)
         x = x + dense(blk["proj"], attn)
         h = _layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
-        if moe_experts:
-            from sharetrade_tpu.parallel import moe as moe_lib
-            flat = h.reshape(-1, d_model)
-            if moe_top_k:      # capacity-bucketed top-k dispatch
-                if ep_mesh is not None and moe_dispatch == "a2a":
-                    # Token-sharded all_to_all dispatch: pad the token count
-                    # to a multiple of ep (pad rows are marked invalid — no
-                    # buffer slots, no balance-stat contribution) and slice
-                    # the real rows back out.
-                    ep = ep_mesh.shape[ep_axis]
-                    n = flat.shape[0]
-                    pad = (-n) % ep
-                    y, aux = moe_lib.moe_apply_topk_a2a(
-                        blk["moe"],
-                        jnp.pad(flat, ((0, pad), (0, 0))) if pad else flat,
-                        ep_mesh, axis=ep_axis, top_k=moe_top_k,
-                        capacity_factor=moe_capacity_factor,
-                        n_valid=n if pad else None)
-                    y = y[:n] if pad else y
-                elif ep_mesh is not None:
-                    y, aux = moe_lib.moe_apply_topk_sharded(
-                        blk["moe"], flat, ep_mesh, axis=ep_axis,
-                        top_k=moe_top_k, capacity_factor=moe_capacity_factor,
-                        batch_axis=pp_batch_axis)
-                else:
-                    y, aux = moe_lib.moe_apply_topk(
-                        blk["moe"], flat, top_k=moe_top_k,
-                        capacity_factor=moe_capacity_factor)
-            elif ep_mesh is not None:
-                y, aux = moe_lib.moe_apply_sharded(
-                    blk["moe"], flat, ep_mesh, axis=ep_axis,
-                    batch_axis=pp_batch_axis)
-            else:
-                y, aux = moe_lib.moe_apply(blk["moe"], flat)
-            return x + y.reshape(h.shape), aux
-        out = x + dense(blk["mlp_out"], jax.nn.gelu(dense(blk["mlp_in"], h)))
-        return out, jnp.float32(0.0)
+        y, aux = ffn_apply(
+            blk, h, moe_experts=moe_experts, ep_mesh=ep_mesh,
+            ep_axis=ep_axis, moe_top_k=moe_top_k,
+            moe_capacity_factor=moe_capacity_factor,
+            moe_dispatch=moe_dispatch, batch_axis=pp_batch_axis)
+        return x + y, aux
 
     def tokenize(obs):
         """(B, obs_dim) -> (B, seq, 3): shared tick features plus a final

@@ -53,6 +53,7 @@ import jax.numpy as jnp
 
 from sharetrade_tpu.models.core import (
     Model, ModelOut, dense, dense_init, portfolio_features)
+from sharetrade_tpu.models.ffn import ffn_apply
 from sharetrade_tpu.models.transformer import _layer_norm
 from sharetrade_tpu.ops.attention import flash_attention
 
@@ -86,7 +87,13 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                                head_dim: int = 64, mlp_ratio: int = 4,
                                dtype=jnp.float32,
                                use_pallas: bool | None = None,
-                               attention_fn=None) -> Model:
+                               attention_fn=None,
+                               pp_mesh=None, pp_axis: str = "pp",
+                               pp_batch_axis: str | None = None,
+                               moe_experts: int = 0, ep_mesh=None,
+                               ep_axis: str = "ep", moe_top_k: int = 0,
+                               moe_capacity_factor: float = 1.25,
+                               moe_dispatch: str = "psum") -> Model:
     """Build the episode-mode policy (``ModelConfig.seq_mode="episode"``).
 
     ``attention_fn(q, k, v, window) -> out`` overrides the local banded
@@ -96,6 +103,17 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
     the incremental path is a 1-token cache attention and the episode-start
     prefill pins the local kernel (its L*(window-1)+1 rows are too short to
     shard), so only the replay span constrains the sp size.
+
+    ``moe_experts`` routes every block's FFN through the shared MoE
+    dispatch (models/ffn.py): dense-mask top-1, capacity top-k, ep-sharded
+    psum, or token-sharded all_to_all — the same variants window mode
+    composes with. ``pp_mesh`` pipelines the banded blocks over its
+    ``pp_axis`` (GPipe, parallel/pipeline.py; blocks stored stacked so
+    stage i's slice shards onto pp-device i). Microbatches cut the agent
+    batch; the batch-of-1 trunk/shared-replay passes run single-microbatch
+    (a full pipeline bubble — pp on this path partitions layer memory, not
+    time). pp + MoE is rejected (nested shard_maps), as is pp + a non-local
+    attention override.
     """
     if head_dim % 2:
         raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
@@ -109,6 +127,24 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
 
     if attention_fn is None:
         attention_fn = local_attention
+    if pp_mesh is not None:
+        if pp_mesh.shape[pp_axis] != num_layers:
+            raise ValueError(
+                f"pipeline_blocks needs num_layers == pp size "
+                f"({num_layers} != {pp_mesh.shape[pp_axis]})")
+        if moe_experts:
+            raise ValueError("pipeline_blocks + moe_experts is unsupported "
+                             "(nested shard_maps); pick one partitioning")
+        if attention_fn is not local_attention:
+            raise ValueError("pipeline_blocks requires the local banded "
+                             "attention (no sp override inside a stage)")
+
+    def block_ffn(blk, h):
+        return ffn_apply(
+            blk, h, moe_experts=moe_experts, ep_mesh=ep_mesh,
+            ep_axis=ep_axis, moe_top_k=moe_top_k,
+            moe_capacity_factor=moe_capacity_factor,
+            moe_dispatch=moe_dispatch)
 
     def init(key):
         keys = jax.random.split(key, 5 + 6 * num_layers)
@@ -124,7 +160,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         }
         for i in range(num_layers):
             k = keys[5 + 6 * i: 5 + 6 * (i + 1)]
-            params["blocks"].append({
+            block = {
                 "ln1": {"scale": jnp.ones((d_model,), dtype),
                         "bias": jnp.zeros((d_model,), dtype)},
                 "qkv": dense_init(k[0], d_model, 3 * d_model, dtype=dtype),
@@ -132,13 +168,57 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                                    scale=0.02 / max(num_layers, 1), dtype=dtype),
                 "ln2": {"scale": jnp.ones((d_model,), dtype),
                         "bias": jnp.zeros((d_model,), dtype)},
-                "mlp_in": dense_init(k[2], d_model, mlp_ratio * d_model,
-                                     dtype=dtype),
-                "mlp_out": dense_init(k[3], mlp_ratio * d_model, d_model,
-                                      scale=0.02 / max(num_layers, 1),
-                                      dtype=dtype),
-            })
+            }
+            if moe_experts:
+                from sharetrade_tpu.parallel.moe import init_moe_params
+                block["moe"] = init_moe_params(
+                    k[2], moe_experts, d_model, mlp_ratio * d_model,
+                    dtype=dtype)
+            else:
+                block["mlp_in"] = dense_init(
+                    k[2], d_model, mlp_ratio * d_model, dtype=dtype)
+                block["mlp_out"] = dense_init(
+                    k[3], mlp_ratio * d_model, d_model,
+                    scale=0.02 / max(num_layers, 1), dtype=dtype)
+            params["blocks"].append(block)
+        if pp_mesh is not None:
+            # Stacked layout (leading dim = stages) so stage i's slice
+            # lands on pp-device i through the pipeline shard_map.
+            params["blocks"] = jax.tree.map(
+                lambda *leaves: jnp.stack(leaves), *params["blocks"])
         return params
+
+    def blocks_of(params):
+        """Per-layer block list regardless of storage layout (list, or
+        stacked (S, ...) leaves under pp — indexing the stacked leaves
+        outside the pipeline shard_map lets XLA gather the slice, which
+        only the small incremental/one-token paths do)."""
+        if pp_mesh is None:
+            return params["blocks"]
+        return [jax.tree.map(lambda x: x[i], params["blocks"])
+                for i in range(num_layers)]
+
+    def block_apply(blk, x, positions, *, attn, kv_offset):
+        """One banded pre-LN block over (B, S, d). Returns
+        ``(x, (k_tail, v_tail), aux)`` — the rotated K/V of the cached
+        window (always computed; a few window-length rows) and the FFN's
+        MoE balance loss."""
+        bsz, s_len = x.shape[0], x.shape[1]
+        h = _layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
+        qkv = dense(blk["qkv"], h).reshape(
+            bsz, s_len, 3, num_heads, head_dim)
+        q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
+        q = _rope(q, positions)
+        k = _rope(k, positions)
+        x_attn = attn(q, k, v, window)
+        lo = s_len - window - kv_offset
+        kv_tail = (k[:, :, lo:lo + window], v[:, :, lo:lo + window])
+        x_attn = x_attn.transpose(0, 2, 1, 3).reshape(
+            bsz, s_len, d_model).astype(dtype)
+        x = x + dense(blk["proj"], x_attn)
+        h = _layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
+        y, aux = block_ffn(blk, h)
+        return x + y, kv_tail, aux
 
     def forward(params, series, positions, port_feats, *, want_kv=False,
                 attn=None, kv_offset=0):
@@ -146,40 +226,101 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
 
         ``port_feats`` (B, S, 3) is zero except at query positions. Returns
         (logits (B, S, A), values (B, S), per-layer rotated (k, v) lists
-        when ``want_kv``, post-final_ln hidden (B, S, d)). ``kv_offset``
-        shifts the cached window ``offset`` ticks back from the series end
-        (the precomputed-rollout trunk's last tick belongs to the bootstrap
-        position, one step past where the cache should stop). ``attn``
-        overrides the attention implementation (the prefill pins the LOCAL
-        kernel: its sequence is the fixed L*(window-1)+1 rows, too short to
-        shard).
+        when ``want_kv``, post-final_ln hidden (B, S, d), aux scalar).
+        ``kv_offset`` shifts the cached window ``offset`` ticks back from
+        the series end (the precomputed-rollout trunk's last tick belongs
+        to the bootstrap position, one step past where the cache should
+        stop). ``attn`` overrides the attention implementation (the prefill
+        pins the LOCAL kernel: its sequence is the fixed L*(window-1)+1
+        rows, too short to shard).
         """
-        attn = attn or attention_fn
         bsz, s_len = series.shape
         x = dense(params["embed"], _tick_features(series).astype(dtype))
-        kv = []
-        for blk in params["blocks"]:
-            h = _layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
-            qkv = dense(blk["qkv"], h).reshape(
-                bsz, s_len, 3, num_heads, head_dim)
-            q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
-            q = _rope(q, positions)
-            k = _rope(k, positions)
-            x_attn = attn(q, k, v, window)
-            if want_kv:
-                lo = s_len - window - kv_offset
-                kv.append((k[:, :, lo:lo + window], v[:, :, lo:lo + window]))
-            x_attn = x_attn.transpose(0, 2, 1, 3).reshape(
-                bsz, s_len, d_model).astype(dtype)
-            x = x + dense(blk["proj"], x_attn)
-            h = _layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
-            x = x + dense(blk["mlp_out"], jax.nn.gelu(dense(blk["mlp_in"], h)))
+        if pp_mesh is not None:   # overrides rejected at build: always local
+            x, kv, aux = _forward_blocks_pipelined(
+                params, x, positions, kv_offset)
+        else:
+            attn = attn or attention_fn
+            kv, aux = [], jnp.float32(0.0)
+            for blk in blocks_of(params):
+                x, kv_tail, blk_aux = block_apply(
+                    blk, x, positions, attn=attn, kv_offset=kv_offset)
+                kv.append(kv_tail)
+                aux = aux + blk_aux
         hn = _layer_norm(x, params["final_ln"]["scale"],
                          params["final_ln"]["bias"])
         hn_port = hn + dense(params["port"], port_feats.astype(dtype))
         logits = dense(params["policy"], hn_port).astype(jnp.float32)
         values = dense(params["value"], hn_port).astype(jnp.float32)[..., 0]
-        return logits, values, kv, hn
+        return logits, values, (kv if want_kv else []), hn, aux
+
+    def _forward_blocks_pipelined(params, x, positions, kv_offset):
+        """The block stack as a GPipe pipeline over ``pp_axis``.
+
+        Positions ride the pipeline state as one extra f32 channel (every
+        stage applies RoPE at the same absolute indices; a pipeline stage
+        receives exactly one state array). K/V tails and the per-block aux
+        escape as pipeline side outputs (pipeline_apply side_template).
+        Microbatches cut the agent batch when it divides by the stage
+        count; the batch-of-1 trunk/shared-replay passes run m=1 (full
+        bubble — correctness, not throughput, on those passes).
+        """
+        from jax.sharding import PartitionSpec as P
+        from sharetrade_tpu.parallel.pipeline import pipeline_apply
+        bsz, s_len = x.shape[0], x.shape[1]
+        stages = num_layers
+        m = stages if bsz % stages == 0 else 1
+        mb_b = bsz // m
+        state = jnp.concatenate(
+            [x.astype(jnp.float32),
+             positions[..., None].astype(jnp.float32)], axis=-1)
+        mb = state.reshape((m, mb_b) + state.shape[1:])
+        b_axis = pp_batch_axis
+        if b_axis is not None and mb_b % pp_mesh.shape[b_axis]:
+            b_axis = None       # odd microbatch: replicate
+
+        def stage_fn(blk, st):
+            xb = st[..., :d_model].astype(dtype)
+            pos = st[..., d_model].astype(jnp.int32)
+            xb, (k_t, v_t), aux = block_apply(
+                blk, xb, pos, attn=local_attention, kv_offset=kv_offset)
+            if b_axis is not None:
+                # The K/V sides carry their own (sharded) rows; the scalar
+                # aux must be made uniform across the batch axis to honor
+                # its replicated side spec.
+                aux = jax.lax.pmean(aux, b_axis)
+            out = jnp.concatenate(
+                [xb.astype(jnp.float32), st[..., d_model:]], axis=-1)
+            return out, {"k": k_t, "v": v_t, "aux": aux}
+
+        # Side templates use the per-device LOCAL batch shape; the K/V
+        # sides declare the batch axis in their specs so each dp shard
+        # contributes its own rows (a replicated spec would silently hand
+        # one shard's K/V to every agent).
+        b_shard = 1 if b_axis is None else pp_mesh.shape[b_axis]
+        side_template = {
+            "k": jnp.zeros((mb_b // b_shard, num_heads, window, head_dim),
+                           dtype),
+            "v": jnp.zeros((mb_b // b_shard, num_heads, window, head_dim),
+                           dtype),
+            "aux": jnp.float32(0.0),
+        }
+        side_specs = {"k": P(None, None, b_axis),
+                      "v": P(None, None, b_axis), "aux": P()}
+        mb_out, sides = pipeline_apply(
+            stage_fn, params["blocks"], mb, pp_mesh, axis=pp_axis,
+            mb_spec=P(None, b_axis), side_template=side_template,
+            side_specs=side_specs)
+        x = mb_out[..., :d_model].reshape(bsz, s_len, d_model).astype(dtype)
+        # sides: leaves (S_stages, M, ...). Reassemble per-layer K/V over
+        # the microbatched agent axis; aux sums over stages (each stage's
+        # aux is identical across its microbatches' mean contributions, so
+        # sum over M then divide by M keeps the per-token mean semantics).
+        kv = [(sides["k"][l].reshape(bsz, num_heads, window, head_dim),
+               sides["v"][l].reshape(bsz, num_heads, window, head_dim))
+              for l in range(num_layers)]
+        aux = jnp.sum(sides["aux"]) / m
+        return x, kv, aux
 
     _port_feats = portfolio_features  # shared head-side normalization
 
@@ -196,8 +337,9 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         port = jnp.zeros(series.shape + (3,), jnp.float32)
         port = port.at[:, -1, :].set(
             _port_feats(obs[:, window], obs[:, window + 1], win[:, -1]))
-        logits, values, kv, _hn = forward(params, series, positions, port,
-                                          want_kv=True, attn=local_attention)
+        logits, values, kv, _hn, aux = forward(
+            params, series, positions, port, want_kv=True,
+            attn=local_attention)
         cache_k = jnp.stack([k for k, _ in kv], axis=1)  # (B, L, H, W, Dh)
         cache_v = jnp.stack([v for _, v in kv], axis=1)
         carry = {
@@ -206,7 +348,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
             "t": jnp.ones((bsz,), jnp.int32),
         }
         return ModelOut(logits=logits[:, -1], value=values[:, -1],
-                        aux=jnp.float32(0.0)), carry
+                        aux=aux), carry
 
     def _incremental(params, obs, carry):
         """One-token step against the CIRCULAR K/V cache.
@@ -236,7 +378,8 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         slot = jnp.mod(carry["t"][0] - 1, window).astype(jnp.int32)
 
         k_cache, v_cache = carry["k"], carry["v"]     # (B, L, H, W, Dh)
-        for li, blk in enumerate(params["blocks"]):
+        aux = jnp.float32(0.0)
+        for li, blk in enumerate(blocks_of(params)):
             h = _layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
             qkv = dense(blk["qkv"], h).reshape(bsz, 1, 3, num_heads, head_dim)
             q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
@@ -256,7 +399,9 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                 bsz, 1, d_model).astype(dtype)
             x = x + dense(blk["proj"], attn)
             h = _layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
-            x = x + dense(blk["mlp_out"], jax.nn.gelu(dense(blk["mlp_in"], h)))
+            y, blk_aux = block_ffn(blk, h)
+            x = x + y
+            aux = aux + blk_aux
         hn = _layer_norm(x[:, 0], params["final_ln"]["scale"],
                          params["final_ln"]["bias"])
         hn = hn + dense(params["port"], _port_feats(
@@ -269,8 +414,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
             hist = jnp.concatenate([hist[:, 1:], obs[:, :1]], axis=1)
         carry = {"k": k_cache, "v": v_cache,
                  "hist": hist, "t": carry["t"] + 1}
-        return ModelOut(logits=logits, value=values,
-                        aux=jnp.float32(0.0)), carry
+        return ModelOut(logits=logits, value=values, aux=aux), carry
 
     def apply_batch(params, obs, carry):
         """Batched rollout step.
@@ -328,9 +472,54 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         feats = _port_feats(obs[:, :, window], obs[:, :, window + 1], anchor)
         port = jnp.zeros((bsz, s_len, 3), jnp.float32)
         port = port.at[:, q_pos, :].set(feats.swapaxes(0, 1))
-        logits, values, _kv, _hn = forward(params, series, positions, port)
+        logits, values, _kv, _hn, aux = forward(
+            params, series, positions, port)
         return (logits[:, q_pos].swapaxes(0, 1),
-                values[:, q_pos].swapaxes(0, 1), jnp.float32(0.0))
+                values[:, q_pos].swapaxes(0, 1), aux)
+
+    def apply_unroll_shared(params, obs, carry):
+        """Training replay with the trunk's factor-B agent redundancy
+        removed: every healthy agent's price series is IDENTICAL (the
+        lockstep-batch agent-invariance of agents/rollout.py), so the
+        banded pass of ``apply_unroll`` runs ONCE for a representative row
+        and only the portfolio-feature head runs per agent. Same signature
+        and outputs as ``apply_unroll``; gradients are exact (B identical
+        trunk paths each pulled back by one agent's head cotangent equal
+        one shared path pulled back by their sum).
+
+        The representative must be a live row: a quarantined agent's stored
+        observation is zero-sanitized (prices are strictly positive), so
+        argmax over "window has a real price" elects the first healthy row
+        — electing a zeroed row would corrupt every agent's replay.
+        """
+        t_len, bsz = obs.shape[0], obs.shape[1]
+        rep = jnp.argmax(obs[0, :, window - 1] > 0).astype(jnp.int32)
+        obs1 = jax.lax.dynamic_index_in_dim(obs, rep, 1, keepdims=True)
+        carry1 = jax.tree.map(
+            lambda x: jax.lax.dynamic_index_in_dim(x, rep, 0, keepdims=True),
+            carry)
+        first_win = obs1[0, :, :window]                 # (1, W)
+        newer = obs1[1:, :, window - 1].T               # (1, T-1)
+        t0 = carry1["t"].astype(jnp.int32)              # (1,)
+        hist = jnp.where((t0 == 0)[:, None], first_win[:, :1],
+                         carry1["hist"])
+        series = jnp.concatenate([hist, first_win, newer], axis=1)
+        s_len = hist_len + window + t_len - 1
+        positions = (t0[:, None] - hist_len
+                     + jnp.arange(s_len, dtype=jnp.int32)[None, :])
+        port = jnp.zeros((1, s_len, 3), jnp.float32)
+        _logits, _values, _kv, hn, aux = forward(
+            params, series, positions, port)
+        q_pos = hist_len + window - 1 + jnp.arange(t_len)
+        hn_q = hn[0, q_pos]                             # (T, d)
+        # Per-agent head: the only part of the forward the wallet touches.
+        anchor = obs[:, :, window - 1]                  # (T, B)
+        feats = _port_feats(obs[:, :, window], obs[:, :, window + 1], anchor)
+        hn_port = (hn_q[:, None, :]
+                   + dense(params["port"], feats.astype(dtype)))
+        logits = dense(params["policy"], hn_port).astype(jnp.float32)
+        values = dense(params["value"], hn_port).astype(jnp.float32)[..., 0]
+        return logits, values, aux
 
     def apply_rollout_trunk(params, obs, future_ticks, carry):
         """Whole-unroll trunk in ONE banded pass (the precomputed-rollout
@@ -358,8 +547,8 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         positions = (t0[:, None] - hist_len
                      + jnp.arange(s_len, dtype=jnp.int32)[None, :])
         port = jnp.zeros((bsz, s_len, 3), jnp.float32)
-        _logits, _values, kv, hn = forward(params, series, positions, port,
-                                           want_kv=True, kv_offset=1)
+        _logits, _values, kv, hn, _aux = forward(
+            params, series, positions, port, want_kv=True, kv_offset=1)
         q_pos = hist_len + window - 1 + jnp.arange(t_len + 1)
         hn_base = hn[:, q_pos]
         # Carry after T steps. The cached window (kv_offset=1) is ticks
@@ -397,6 +586,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
 
     return Model(init=init, apply=apply, apply_batch=apply_batch,
                  apply_unroll=apply_unroll, init_carry=init_carry,
+                 apply_unroll_shared=apply_unroll_shared,
                  apply_rollout_trunk=apply_rollout_trunk,
                  apply_rollout_head=apply_rollout_head,
                  obs_dim=obs_dim, num_actions=num_actions,

@@ -22,11 +22,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def pipeline_apply(stage_fn, stage_params, microbatches, mesh: Mesh,
-                   *, axis: str = "pp", mb_spec: P = P()):
+                   *, axis: str = "pp", mb_spec: P = P(),
+                   side_template=None, side_specs=None):
     """Run ``microbatches`` through ``num_stages`` pipelined stages.
 
     - ``stage_fn(params, x) -> x``: one stage's forward (same signature for
-      every stage; heterogeneous stacks encode choice inside params).
+      every stage; heterogeneous stacks encode choice inside params). With
+      ``side_template``, ``stage_fn(params, x) -> (x, side)`` — ``side`` is
+      a per-(stage, microbatch) pytree matching the template's
+      shapes/dtypes (e.g. a block's K/V cache tail, its MoE balance loss).
     - ``stage_params``: pytree whose leaves have leading dim ``num_stages``
       (stage i's slice lives on pp-device i).
     - ``microbatches``: array of shape (M, ...) — M microbatches.
@@ -35,7 +39,19 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh: Mesh,
       dp-sharded in a dp x pp mesh); must not mention ``axis`` itself —
       every pipeline stage needs the ticks it owns.
 
-    Returns the (M, ...) outputs with the same ``mb_spec`` sharding.
+    Returns the (M, ...) outputs with the same ``mb_spec`` sharding; with
+    ``side_template`` returns ``(out, sides)`` where each side leaf gains
+    leading dims (num_stages, M) (each stage computes its row; a one-hot
+    psum assembles the full stack) — how per-layer byproducts (K/V caches,
+    aux losses) escape a schedule whose stage activations never leave
+    their device. When ``mb_spec`` shards a batch axis, any side leaf
+    carrying per-row data must declare that axis in ``side_specs`` (a
+    side-shaped pytree of PartitionSpecs over the ASSEMBLED (S, M, ...)
+    layout; default all-replicated) — a replicated spec on a sharded-batch
+    side would silently return one shard's rows for everybody. Per-leaf
+    template shapes are the LOCAL shard shapes in that case, and any
+    scalar side (an aux loss) must be made batch-axis-uniform inside
+    ``stage_fn`` (e.g. ``lax.pmean``) to honor its replicated spec.
     """
     num_stages = mesh.shape[axis]
     num_micro = microbatches.shape[0]
@@ -52,6 +68,9 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh: Mesh,
 
         state = jnp.zeros(mb_local.shape[1:], mb_local.dtype)
         out = jnp.zeros(mb_local.shape, mb_local.dtype)
+        sides = jax.tree.map(
+            lambda t: jnp.zeros((num_micro,) + t.shape, t.dtype),
+            side_template)
 
         for t in range(num_micro + num_stages - 1):
             # Stage 0 ingests microbatch t on ticks 0..M-1.
@@ -60,7 +79,18 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh: Mesh,
                               jnp.where(t < num_micro,
                                         mb_local[feed_idx], state),
                               state)
-            state = stage_fn(params_here, state)
+            if side_template is None:
+                state = stage_fn(params_here, state)
+            else:
+                state, side = stage_fn(params_here, state)
+                # This stage processes microbatch (t - stage) at tick t;
+                # record its side there (ticks outside [stage, stage+M)
+                # carry fill/garbage state and are masked off).
+                mb_idx = jnp.clip(t - stage, 0, num_micro - 1)
+                live = (t >= stage) & (t - stage < num_micro)
+                sides = jax.tree.map(
+                    lambda acc, s: acc.at[mb_idx].set(
+                        jnp.where(live, s, acc[mb_idx])), sides, side)
             # Last stage emits microbatch t-(S-1) on ticks S-1..M+S-2.
             emit = t - (num_stages - 1)
             if emit >= 0:
@@ -72,15 +102,28 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh: Mesh,
 
         # Only the last stage holds real outputs; replicate them ring-wide.
         out = jnp.where(stage == num_stages - 1, out, jnp.zeros_like(out))
-        return jax.lax.psum(out, axis)
+        out = jax.lax.psum(out, axis)
+        if side_template is None:
+            return out
+        # Assemble the (S, M, ...) side stack: each stage contributes its
+        # own row, zero elsewhere, and a psum over the ring fills the rest.
+        onehot = (jnp.arange(num_stages) == stage)
+        sides = jax.tree.map(
+            lambda s: jax.lax.psum(
+                jnp.where(onehot.reshape((num_stages,) + (1,) * s.ndim),
+                          s[None], 0), axis), sides)
+        return out, sides
 
     stage_spec = jax.tree.map(lambda _: P(axis), stage_params)
+    if side_template is not None and side_specs is None:
+        side_specs = jax.tree.map(lambda _: P(), side_template)
+    out_specs = mb_spec if side_template is None else (mb_spec, side_specs)
     # check_vma=False: stage_fn may invoke a pallas_call (the flash kernel),
     # whose out_shapes don't carry varying-mesh-axes metadata; the schedule
     # is stage-local by construction so the check adds nothing here.
     return jax.shard_map(
         local_fn, mesh=mesh,
-        in_specs=(stage_spec, mb_spec), out_specs=mb_spec,
+        in_specs=(stage_spec, mb_spec), out_specs=out_specs,
         check_vma=False,
     )(stage_params, microbatches)
 

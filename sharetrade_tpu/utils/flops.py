@@ -125,16 +125,24 @@ def forward_equivalents_per_agent_step(cfg: LearnerConfig,
 
 def _episode_mode_flops_per_agent_step(cfg: FrameworkConfig,
                                        obs_dim: int) -> float:
-    """Episode-mode transformer (models/transformer_episode.py): the unroll
-    replays as ONE banded pass over S = L*(window-1)+T tokens instead of T
-    window-length forwards, and the rollout trunk is computed ONCE for a
-    representative agent and shared (agents.rollout precomputed path: the
-    price series is identical and lockstep across the batch), so its cost
-    amortizes over B agents. Counted per agent-step:
+    """Episode-mode transformer (models/transformer_episode.py), counting
+    FLOPs actually EXECUTED. Both halves of the chunk exploit the same
+    agent-invariance (every lockstep agent replays one shared price series),
+    so the banded trunk runs for ONE representative row and amortizes over
+    the B agents in BOTH places:
 
-        rollout trunk: (S+1)/T tokens / B agents
-        rollout head:  1 tiny head (port + policy + value projections)
-        replay:        epochs x 3 (fwd+bwd) x (S / T) tokens
+        rollout trunk:  (S+1)/T tokens / B agents (agents/rollout.py
+                        precomputed path)
+        rollout head:   1 tiny head (port + policy + value projections)
+        replay trunk:   epochs x minibatches x 3 (fwd+bwd) x S/T tokens / B
+                        (apply_unroll_shared: one trunk per minibatch PASS,
+                        not per agent — each pass re-runs it because the
+                        params just changed)
+        replay heads:   epochs x 3 per agent-step
+
+    MFU computed from this is hardware utilization of the executed matmuls;
+    the pre-round-4 convention counted the per-agent replay trunks the
+    shared path no longer runs, which would overstate MFU by ~B/minibatches.
     """
     model, learner = cfg.model, cfg.learner
     w = obs_dim - 2
@@ -146,10 +154,19 @@ def _episode_mode_flops_per_agent_step(cfg: FrameworkConfig,
     t = max(learner.unroll_len, 1)
     b = max(cfg.parallel.num_workers, 1)
     s = model.num_layers * (w - 1) + t
-    epochs = learner.ppo_epochs if learner.algo == "ppo" else 1
-    return (per_token * (s + 1) / t / b      # shared trunk
-            + per_head                        # per-step head
-            + per_token * epochs * 3.0 * (s / t))
+    if learner.algo == "ppo":
+        epochs = learner.ppo_epochs
+        # Mirror ppo.py's divisor fallback: the actual minibatch count is
+        # the largest divisor of the agent count not exceeding the request.
+        requested = max(1, min(learner.ppo_minibatches, b))
+        mb_count = max(d for d in range(1, requested + 1) if b % d == 0)
+        passes = epochs * mb_count
+    else:
+        epochs, passes = 1, 1
+    return (per_token * (s + 1) / t / b           # rollout trunk (shared)
+            + per_head                             # per-step rollout head
+            + per_token * passes * 3.0 * s / t / b  # replay trunks (shared)
+            + per_head * epochs * 3.0)             # per-agent replay heads
 
 
 def train_flops_per_agent_step(cfg: FrameworkConfig, obs_dim: int) -> float:

@@ -125,7 +125,8 @@ class TestEpisodeMode:
 
     WINDOW = 16                  # ticks; obs_dim = WINDOW + 2
 
-    def _setup(self, num_layers=2, unroll=8, num_agents=3, algo="ppo"):
+    def _setup(self, num_layers=2, unroll=8, num_agents=3, algo="ppo",
+               **model_kw):
         from sharetrade_tpu.agents import build_agent
         from sharetrade_tpu.config import FrameworkConfig
         from sharetrade_tpu.env import trading
@@ -137,6 +138,8 @@ class TestEpisodeMode:
         cfg.model.num_layers = num_layers
         cfg.model.num_heads = 2
         cfg.model.head_dim = 16
+        for k, v in model_kw.items():
+            setattr(cfg.model, k, v)
         cfg.env.window = self.WINDOW
         cfg.parallel.num_workers = num_agents
         cfg.learner.unroll_len = unroll
@@ -226,6 +229,73 @@ class TestEpisodeMode:
                                    np.asarray(carry["k"]), atol=3e-4)
         np.testing.assert_allclose(np.asarray(carry_tr["v"]),
                                    np.asarray(carry["v"]), atol=3e-4)
+
+    def test_shared_trunk_replay_matches_per_agent_unroll(self):
+        """apply_unroll_shared (trunk once, per-agent heads) must produce
+        the same logits/values AND the same parameter gradients as the
+        per-agent apply_unroll — the linearity argument (B identical trunk
+        paths pulled back by per-agent cotangents == one shared path pulled
+        back by their sum) checked numerically, with distinct per-agent
+        loss weights so the cotangents genuinely differ."""
+        from sharetrade_tpu.agents.rollout import collect_rollout
+
+        _, agent, env = self._setup(num_agents=3)
+        model = agent.model
+        ts = agent.init(jax.random.PRNGKey(0))
+        w_agent = jnp.asarray([0.3, 1.7, 0.9])
+
+        for chunk in range(2):   # prefill chunk AND a carry-crossing chunk
+            init_carry = ts.carry
+            ts, traj, _, _ = collect_rollout(model, env, ts, 8, 3)
+
+            l_sh, v_sh, _ = model.apply_unroll_shared(
+                ts.params, traj.obs, init_carry)
+            l_pa, v_pa, _ = model.apply_unroll(ts.params, traj.obs, init_carry)
+            np.testing.assert_allclose(np.asarray(l_sh), np.asarray(l_pa),
+                                       atol=3e-4, err_msg=f"chunk {chunk}")
+            np.testing.assert_allclose(np.asarray(v_sh), np.asarray(v_pa),
+                                       atol=3e-4, err_msg=f"chunk {chunk}")
+
+            def loss(params, fwd):
+                logits, values, _ = fwd(params, traj.obs, init_carry)
+                lp = jax.nn.log_softmax(logits)
+                return (jnp.sum(lp[..., 0] * w_agent[None, :])
+                        + jnp.sum(jnp.square(values) * w_agent[None, :]))
+
+            g_sh = jax.grad(loss)(ts.params, model.apply_unroll_shared)
+            g_pa = jax.grad(loss)(ts.params, model.apply_unroll)
+            for p_sh, p_pa in zip(jax.tree.leaves(g_sh),
+                                  jax.tree.leaves(g_pa)):
+                np.testing.assert_allclose(
+                    np.asarray(p_sh), np.asarray(p_pa),
+                    rtol=1e-5, atol=5e-3,
+                    err_msg=f"gradient mismatch (chunk {chunk})")
+
+    def test_shared_trunk_replay_skips_zeroed_quarantine_rows(self):
+        """A quarantined row's stored obs is all-zero; the shared replay
+        must elect a live representative (not the zeroed row) and stay
+        finite everywhere."""
+        from sharetrade_tpu.agents.rollout import collect_rollout
+
+        _, agent, env = self._setup(num_agents=3)
+        model = agent.model
+        ts = agent.init(jax.random.PRNGKey(0))
+        init_carry = ts.carry
+        ts, traj, _, _ = collect_rollout(model, env, ts, 8, 3)
+        zeroed = traj._replace(
+            obs=traj.obs.at[:, 0].set(0.0),
+            active=traj.active.at[:, 0].set(0.0))
+
+        l_sh, v_sh, _ = model.apply_unroll_shared(
+            ts.params, zeroed.obs, init_carry)
+        l_pa, v_pa, _ = model.apply_unroll(ts.params, traj.obs, init_carry)
+        assert np.isfinite(np.asarray(l_sh)).all()
+        assert np.isfinite(np.asarray(v_sh)).all()
+        # Healthy rows replay exactly as if the zeroed row were absent.
+        np.testing.assert_allclose(np.asarray(l_sh[:, 1:]),
+                                   np.asarray(l_pa[:, 1:]), atol=3e-4)
+        np.testing.assert_allclose(np.asarray(v_sh[:, 1:]),
+                                   np.asarray(v_pa[:, 1:]), atol=3e-4)
 
     def test_quarantined_representative_row_does_not_corrupt_trunk(self):
         """The shared-trunk rollout elects a HEALTHY representative row: a
@@ -326,11 +396,127 @@ class TestEpisodeMode:
         assert not np.allclose(np.asarray(out1.logits),
                                np.asarray(out2.logits))
 
-    def test_episode_mode_rejects_partitioned_options(self):
-        from sharetrade_tpu.config import ModelConfig as MC
-        cfg = MC(kind="transformer", seq_mode="episode", moe_experts=2)
-        with pytest.raises(ValueError, match="episode"):
-            build_model(cfg, 18)
+    def test_episode_moe_rollout_replay_parity_and_training(self):
+        """Episode mode composes with MoE: the FFN routes through the
+        shared dispatch (models/ffn.py). Dense-mask top-1 is per-token
+        exact, so rollout (precomputed trunk + heads), banded replay, AND
+        the incremental prefill must all agree; a jitted PPO chunk trains
+        with finite loss and a live aux term."""
+        from sharetrade_tpu.agents.rollout import (
+            collect_rollout, replay_forward)
+
+        _, agent, env = self._setup(moe_experts=4)
+        model = agent.model
+        ts = agent.init(jax.random.PRNGKey(0))
+        assert "moe" in model.init(
+            jax.random.PRNGKey(1))["blocks"][0]   # FFN is actually MoE
+
+        for chunk in range(2):
+            init_carry = ts.carry
+            ts, traj, _, _ = collect_rollout(model, env, ts, 8, 3)
+            logits, values, aux = replay_forward(
+                model, ts.params, traj, init_carry)
+            logp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits), traj.action[..., None],
+                axis=-1)[..., 0]
+            np.testing.assert_allclose(
+                np.asarray(logp), np.asarray(traj.logp), atol=3e-4,
+                err_msg=f"moe chunk {chunk} logp")
+            assert float(aux) > 0.0   # balance loss is live
+
+        ts2 = agent.init(jax.random.PRNGKey(2))
+        ts2, metrics = jax.jit(agent.step)(ts2)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_episode_pipeline_matches_unpartitioned(self, cpu_devices):
+        """Episode × pp: the pipelined banded forward (positions riding the
+        state, K/V + aux escaping as pipeline sides) must reproduce the
+        unpartitioned model — logits/values of the replay AND the trunk's
+        carry handoff — for both a multi-microbatch agent batch and the
+        batch-of-1 trunk pass."""
+        from jax.sharding import Mesh
+        from sharetrade_tpu.agents.rollout import collect_rollout
+        from sharetrade_tpu.models.transformer_episode import (
+            episode_transformer_policy)
+        from sharetrade_tpu.parallel.pipeline import stack_stage_params
+
+        mesh = Mesh(np.array(cpu_devices[:2]).reshape(2), ("pp",))
+        obs_dim = self.WINDOW + 2
+        base = episode_transformer_policy(
+            obs_dim, 3, num_layers=2, num_heads=2, head_dim=16,
+            use_pallas=False)
+        piped = episode_transformer_policy(
+            obs_dim, 3, num_layers=2, num_heads=2, head_dim=16,
+            use_pallas=False, pp_mesh=mesh)
+        params = base.init(jax.random.PRNGKey(3))
+        params_pp = dict(params)
+        params_pp["blocks"] = stack_stage_params(params["blocks"])
+
+        _, agent, env = self._setup(num_agents=4)
+        ts = agent.init(jax.random.PRNGKey(0))
+        init_carry = ts.carry
+        ts, traj, _, _ = collect_rollout(base, env, ts, 6, 4)
+
+        l_b, v_b, _ = base.apply_unroll(params, traj.obs, init_carry)
+        l_p, v_p, _ = piped.apply_unroll(params_pp, traj.obs, init_carry)
+        np.testing.assert_allclose(np.asarray(l_p), np.asarray(l_b),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(v_p), np.asarray(v_b),
+                                   rtol=2e-4, atol=2e-4)
+
+        # Trunk pass (B=1, single microbatch) + carry handoff via sides.
+        state1 = jax.tree.map(lambda x: x[:1], ts.env_state)
+        carry1 = jax.tree.map(lambda x: x[:1], ts.carry)
+        obs1 = jax.vmap(env.observe)(state1)
+        ticks = jnp.broadcast_to(
+            jnp.linspace(11.0, 12.0, 6, dtype=jnp.float32)[None], (1, 6))
+        hn_b, carry_b = base.apply_rollout_trunk(params, obs1, ticks, carry1)
+        hn_p, carry_p = piped.apply_rollout_trunk(
+            params_pp, obs1, ticks, carry1)
+        np.testing.assert_allclose(np.asarray(hn_p), np.asarray(hn_b),
+                                   rtol=2e-4, atol=2e-4)
+        for key in ("k", "v", "hist"):
+            np.testing.assert_allclose(
+                np.asarray(carry_p[key]), np.asarray(carry_b[key]),
+                rtol=2e-4, atol=2e-4, err_msg=f"carry[{key}]")
+        assert int(carry_p["t"][0]) == int(carry_b["t"][0])
+
+        # dp × pp: microbatches dp-sharded, so the K/V pipeline sides must
+        # carry EACH shard's own rows (a replicated side spec would hand
+        # one shard's cache to every agent). Rows are made deliberately
+        # distinct — the lockstep env's identical rows would mask that.
+        mesh2 = Mesh(np.array(cpu_devices[:4]).reshape(2, 2), ("dp", "pp"))
+        piped2 = episode_transformer_policy(
+            obs_dim, 3, num_layers=2, num_heads=2, head_dim=16,
+            use_pallas=False, pp_mesh=mesh2, pp_batch_axis="dp")
+        t_len, bsz = 6, 4
+        base_win = jnp.linspace(10.0, 12.0, self.WINDOW)
+        rows = jnp.stack([base_win * (1.0 + 0.2 * b) for b in range(bsz)])
+        obs_rows = jnp.concatenate(
+            [rows, jnp.full((bsz, 1), 20.0), jnp.zeros((bsz, 1))], axis=-1)
+        obs_t = jnp.broadcast_to(obs_rows, (t_len, bsz, obs_dim))
+        carry4 = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (bsz,) + x.shape),
+            base.init_carry())
+        l_b4, v_b4, _ = base.apply_unroll(params, obs_t, carry4)
+        l_p4, v_p4, _ = piped2.apply_unroll(params_pp, obs_t, carry4)
+        np.testing.assert_allclose(np.asarray(l_p4), np.asarray(l_b4),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg="dp-sharded pipelined replay")
+        ticks4 = jnp.stack(
+            [jnp.linspace(11.0, 12.0, t_len) * (1.0 + 0.2 * b)
+             for b in range(bsz)])
+        hn_b4, carry_b4 = base.apply_rollout_trunk(
+            params, obs_rows, ticks4, carry4)
+        hn_p4, carry_p4 = piped2.apply_rollout_trunk(
+            params_pp, obs_rows, ticks4, carry4)
+        np.testing.assert_allclose(np.asarray(hn_p4), np.asarray(hn_b4),
+                                   rtol=2e-4, atol=2e-4)
+        for key in ("k", "v"):
+            np.testing.assert_allclose(
+                np.asarray(carry_p4[key]), np.asarray(carry_b4[key]),
+                rtol=2e-4, atol=2e-4,
+                err_msg=f"dp-sharded K/V side carry[{key}]")
 
     def test_episode_mode_rejects_non_transformer_kinds(self):
         from sharetrade_tpu.config import ModelConfig as MC
