@@ -70,6 +70,67 @@ class CheckpointManager:
         self._prune()
         return final
 
+    def save_tagged(self, tag: str, train_state: Any,
+                    metadata: dict[str, Any] | None = None) -> str:
+        """Save under a NAME instead of a step — e.g. the best-greedy-eval
+        policy (``runtime.keep_best_eval``). Tagged checkpoints live in
+        ``<dir>/tag_<tag>`` outside the ``ckpt_`` namespace, so retention
+        pruning never collects them and ``latest_step`` resume never picks
+        them by accident; same atomic tmp+rename write protocol."""
+        host_state = jax.device_get(train_state)
+        payload = serialization.to_bytes(host_state)
+        meta = {"tag": tag, "saved_at": time.time(), **(metadata or {})}
+        tmp = os.path.join(self.directory, f"tmp-{tag}-{os.getpid()}")
+        final = os.path.join(self.directory, f"tag_{tag}")
+        os.makedirs(tmp, exist_ok=True)
+        with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
+            f.write(payload)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        if os.path.isdir(final):
+            # Unlike step saves, overwriting a tag is the ROUTINE path
+            # (every best-eval improvement), so the old copy is renamed
+            # aside — never deleted — until the swap lands: a crash at any
+            # point leaves either the old or the new checkpoint readable
+            # (restore_tagged falls back to the .old dir).
+            old = final + ".old"
+            shutil.rmtree(old, ignore_errors=True)
+            os.replace(final, old)
+            os.replace(tmp, final)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.replace(tmp, final)
+        log.info("saved tagged checkpoint %r (%d bytes)", tag, len(payload))
+        return final
+
+    def restore_tagged(self, template: Any, tag: str) -> tuple[Any, dict]:
+        """Restore a tagged checkpoint; returns ``(state, metadata)``."""
+        path = os.path.join(self.directory, f"tag_{tag}")
+        if not os.path.isdir(path):
+            # Crash window fallback: save_tagged renames the previous copy
+            # aside before swapping the new one in.
+            if os.path.isdir(path + ".old"):
+                path = path + ".old"
+            else:
+                raise FileNotFoundError(
+                    f"no {tag!r}-tagged checkpoint under {self.directory}")
+        with open(os.path.join(path, "state.msgpack"), "rb") as f:
+            payload = f.read()
+        state = serialization.from_bytes(jax.device_get(template), payload)
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        log.info("restored tagged checkpoint %r", tag)
+        return state, meta
+
+    def tagged_metadata(self, tag: str) -> dict[str, Any] | None:
+        """Metadata of a tagged checkpoint, or None if absent."""
+        for name in (f"tag_{tag}", f"tag_{tag}.old"):
+            path = os.path.join(self.directory, name, "meta.json")
+            if os.path.isfile(path):
+                with open(path) as f:
+                    return json.load(f)
+        return None
+
     def save_async(self, step: int, train_state: Any,
                    metadata: dict[str, Any] | None = None) -> None:
         """Minimal-stall save: all device→host DMAs are primed at once

@@ -105,6 +105,14 @@ def cmd_train(args) -> int:
         }
         if args.eval:
             result.update(orch.evaluate())
+        if args.eval_best:
+            try:
+                best = orch.evaluate_best()
+            except FileNotFoundError:
+                log.warning("--eval-best: no retained best checkpoint "
+                            "(enable runtime.keep_best_eval and run --eval)")
+            else:
+                result.update({f"best_{k}": v for k, v in best.items()})
         print(json.dumps(result))
         return 0
     finally:
@@ -150,6 +158,9 @@ def main(argv=None) -> int:
                            help="restore the latest checkpoint and continue")
             p.add_argument("--eval", action="store_true",
                            help="greedy-policy evaluation after training")
+            p.add_argument("--eval-best", action="store_true",
+                           help="also evaluate the retained best-eval "
+                                "checkpoint (runtime.keep_best_eval)")
         p.set_defaults(fn=fn)
 
     args = parser.parse_args(argv)

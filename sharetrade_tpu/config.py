@@ -37,6 +37,13 @@ class DataConfig:
     # bounded queue; falls back to synchronous appends when the native
     # library isn't built.
     async_transition_writer: bool = True
+    # Auto-compact the price-event journal once this many fetch events have
+    # accumulated since the last compaction (counting events replayed at
+    # recovery, so a bloated journal shrinks on the first fetch after a
+    # restart) — the reference's config-driven per-actor
+    # ``compaction-intervals`` (application.conf:7-14). 0 disables;
+    # explicit ``PriceDataService.compact()`` always remains available.
+    price_compact_every_events: int = 64
 
 
 @dataclass
@@ -197,6 +204,13 @@ class RuntimeConfig:
     # budget then bounds availability) — a recurring per-row fault must not
     # heal->re-poison->heal forever.
     max_agent_heals: int = 10
+    # Retain the best-greedy-eval policy as a tagged checkpoint
+    # (<checkpoint_dir>/tag_best) every time evaluate() improves on the
+    # best seen: on-policy training can discover a strategy and then
+    # collapse (entropy -> all-Hold), so without this the final checkpoint
+    # a user ships can be the collapsed one. Evaluate the retained policy
+    # with Orchestrator.evaluate_best() / ``cli train --eval-best``.
+    keep_best_eval: bool = True
 
 
 @dataclass

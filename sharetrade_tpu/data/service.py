@@ -140,6 +140,12 @@ class PriceDataService:
                                     prefer_native=cfg.use_native_journal)
         self._journal = journal
         self._cache: dict[str, PriceSeries] = {}
+        # Auto-compaction (reference application.conf:7-14 compaction
+        # intervals): every N appended fetch events the log collapses to
+        # one snapshot per symbol, so a long-lived service's journal stays
+        # bounded without anyone remembering to call compact().
+        self._compact_every = cfg.price_compact_every_events
+        self._events_since_compact = 0
         self._recover()
 
     # ---- public protocol (the RequestStockPrice equivalent) ----
@@ -157,6 +163,7 @@ class PriceDataService:
             fetched = self._provider(symbol, None, None)
             self._persist(symbol, fetched)
             self._merge(symbol, fetched)
+            self._maybe_compact()
         else:
             log.debug("cache hit for %s", symbol)
         return StockDataResponse(symbol, self._cache[symbol].range(start, end))
@@ -166,6 +173,7 @@ class PriceDataService:
         fetched = self._provider(symbol, None, None)
         self._persist(symbol, fetched)
         self._merge(symbol, fetched)
+        self._maybe_compact()
         return StockDataResponse(symbol, self._cache[symbol])
 
     def cached_symbols(self) -> list[str]:
@@ -180,6 +188,7 @@ class PriceDataService:
                    "series": self._cache[s].to_dict()}
                   for s in self.cached_symbols()]
         self._journal.compact(events)
+        self._events_since_compact = len(events)
 
     def close(self) -> None:
         self._journal.close()
@@ -189,6 +198,18 @@ class PriceDataService:
     def _persist(self, symbol: str, series: PriceSeries) -> None:
         self._journal.append({"type": "prices_fetched", "symbol": symbol,
                               "series": series.to_dict()})
+        self._events_since_compact += 1
+
+    def _maybe_compact(self) -> None:
+        """Threshold check, called AFTER the fetch is merged into the
+        cache: compact() snapshots the cache, so compacting from inside
+        _persist (pre-merge) would rewrite the journal without the very
+        event that crossed the threshold — losing it across restarts."""
+        if (self._compact_every > 0
+                and self._events_since_compact > self._compact_every):
+            log.info("auto-compacting price journal after %d events",
+                     self._events_since_compact)
+            self.compact()
 
     def _merge(self, symbol: str, fetched: PriceSeries) -> None:
         if symbol in self._cache:
@@ -203,6 +224,10 @@ class PriceDataService:
                 series = PriceSeries.from_dict(event["series"])
                 self._merge(event["symbol"], series)
                 count += 1
+        # The counter tracks events currently IN the journal (replay sees
+        # them all), so a journal bloated by a previous un-compacted run
+        # crosses the threshold on the first fetch after restart.
+        self._events_since_compact = count
         if count:
             log.info("recovered %d fetch events for %s", count, self.cached_symbols())
 

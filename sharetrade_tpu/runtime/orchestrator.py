@@ -103,6 +103,7 @@ class Orchestrator:
         self._stop = threading.Event()
         self.restarts = 0
         self.agent_heals = 0   # per-agent row respawns (partial_recovery)
+        self._best_eval: float | None = None  # lazily seeded from tag_best
         self.episode = 0
         self.last_error: BaseException | None = None
         self._transitions_journal = None
@@ -456,25 +457,31 @@ class Orchestrator:
         StartTraining (:116-120). Survivors lose nothing; completion waits
         for the respawned rows (the all_trained gate).
 
+        Trunk-rollout models (the episode-mode transformer) share one
+        representative agent's price windows and carry across the batch
+        (agents/rollout.py agent-invariance), so their respawned rows CANNOT
+        restart at cursor 0 — a healthy-but-desynced row could be elected
+        representative and corrupt every agent's windows. Instead they
+        rejoin AT the survivors' cursor: a fresh wallet spliced in at the
+        representative's env cursor, with the representative's carry (the
+        trunk/K-V cache is action-independent, so every lockstep row's carry
+        is identical — the respawned row's "recomputed" carry already exists
+        on a healthy neighbor). The respawned agent trades the remainder of
+        the episode; survivors lose nothing; lockstep is preserved. This is
+        the round-3 exemption removed — previously one poisoned flagship row
+        rolled the WHOLE run back to the last checkpoint.
+
         Returns False — caller falls back to checkpoint restore — when the
         damage exceeds a row respawn: shared params/opt non-finite (the
         quarantine was breached), EVERY row bad (device-level corruption),
-        no bad rows found (the fault is elsewhere), or the model is an
-        episode-mode transformer whose K/V cache requires a lockstep batch
-        (a respawned row's carry would desynchronize
-        transformer_episode.apply_batch)."""
+        or no bad rows found (the fault is elsewhere)."""
         if self._step_override is not None or self.agent is None:
-            return False
-        if getattr(self.agent.model, "apply_rollout_trunk", None) is not None:
-            # Trunk-rollout models share one representative agent's windows
-            # and carry across the batch (agents/rollout.py agent-invariance)
-            # — a row respawned to a fresh cursor would be healthy-but-
-            # desynced and could be elected representative. Gated on the
-            # capability the invariant depends on, not the model name.
             return False
         from sharetrade_tpu.agents.base import agent_health
         ts = self._ts
-        ok = np.asarray(jax.device_get(agent_health(ts.env_state)))
+        # Writable copy: device_get can return read-only arrays and the
+        # carry loop below &='s into this in place.
+        ok = np.array(jax.device_get(agent_health(ts.env_state)))
         carry_leaves = jax.tree.leaves(ts.carry)
         if carry_leaves:
             b = ok.shape[0]
@@ -496,9 +503,21 @@ class Orchestrator:
             m = bad.reshape((-1,) + (1,) * (np.asarray(cur).ndim - 1))
             return jnp.where(m, new, cur)
 
+        fresh_env, fresh_carry = fresh.env_state, fresh.carry
+        if getattr(self.agent.model, "apply_rollout_trunk", None) is not None:
+            # Lockstep rejoin (see docstring): fresh wallet at the
+            # representative healthy row's cursor, carry copied from it.
+            rep = int(np.flatnonzero(ok)[0])
+            fresh_env = fresh_env.replace(
+                t=jnp.broadcast_to(ts.env_state.t[rep],
+                                   fresh_env.t.shape))
+            fresh_carry = jax.tree.map(
+                lambda c: jnp.broadcast_to(c[rep:rep + 1],
+                                           c.shape).astype(c.dtype),
+                ts.carry)
         self._ts = self._place(ts.replace(
-            env_state=jax.tree.map(splice, ts.env_state, fresh.env_state),
-            carry=jax.tree.map(splice, ts.carry, fresh.carry)))
+            env_state=jax.tree.map(splice, ts.env_state, fresh_env),
+            carry=jax.tree.map(splice, ts.carry, fresh_carry)))
         self.agent_heals += 1
         idx = [int(i) for i in np.flatnonzero(bad)]
         log.warning("respawned poisoned agent row(s) %s in place "
@@ -668,12 +687,55 @@ class Orchestrator:
         no exploration, no updates — the measurement the reference never
         separates from training (its portfolio avg mixes ~10% random actions
         even at full epsilon, QDecisionPolicyActor.scala:58-62). Runs one
-        scan on the current params; training state is untouched."""
+        scan on the current params; training state is untouched.
+
+        With ``runtime.keep_best_eval`` the evaluated state is retained as
+        the ``best`` tagged checkpoint whenever it improves on the best
+        eval seen (across resumes — the tag's own metadata seeds the bar):
+        on-policy training can find the strategy and then collapse, and
+        without retention the collapsed policy is what a user ships."""
         if self.agent is None or self._ts is None:
             raise RuntimeError("no training data / state")
+        result = self._evaluate_params(self._ts.params)
+        # The greedy-eval curve lands in the event log so learning progress
+        # is auditable after the run (the reference's only observable is the
+        # final avg, ShareTradeHelper.scala:46; this is the per-policy
+        # learning signal it never records).
+        self.events.emit("evaluation", updates=int(self._ts.updates),
+                         **result)
+        if self.cfg.runtime.keep_best_eval:
+            if self._best_eval is None:
+                prior = self.checkpoints.tagged_metadata("best")
+                self._best_eval = (float(prior["eval_portfolio"])
+                                   if prior else float("-inf"))
+            if result["eval_portfolio"] > self._best_eval:
+                self._best_eval = result["eval_portfolio"]
+                self.checkpoints.save_tagged(
+                    "best", self._ts,
+                    metadata={"eval_portfolio": result["eval_portfolio"],
+                              "updates": int(self._ts.updates)})
+                self.events.emit("best_eval_retained",
+                                 eval_portfolio=result["eval_portfolio"],
+                                 updates=int(self._ts.updates))
+        return result
+
+    def evaluate_best(self) -> dict[str, float]:
+        """Greedy evaluation of the RETAINED best policy (the ``best``
+        tagged checkpoint written by :meth:`evaluate` under
+        ``runtime.keep_best_eval``) — what a user should ship when the live
+        policy has collapsed past its discovery peak. Training state is
+        untouched; raises FileNotFoundError when nothing was retained."""
+        if self.agent is None or self._ts is None:
+            raise RuntimeError("no training data / state")
+        template = self.agent.init(jax.random.PRNGKey(self.cfg.seed))
+        state, meta = self.checkpoints.restore_tagged(template, "best")
+        result = self._evaluate_params(self._place(state).params)
+        result["eval_updates"] = float(meta.get("updates", -1))
+        return result
+
+    def _evaluate_params(self, params) -> dict[str, float]:
         env = self.env
         horizon = env.num_steps
-        params = self._ts.params
 
         # The jitted eval program is cached on the orchestrator (jit caches
         # by function identity — a fresh lambda per call would retrace the
@@ -723,17 +785,10 @@ class Orchestrator:
                 self._eval_fn = jax.jit(greedy_scan)
 
         final, rewards = self._eval_fn(params)
-        result = {
+        return {
             "eval_portfolio": float(env.portfolio_value(final)),
             "eval_reward_sum": float(jnp.sum(rewards)),
         }
-        # The greedy-eval curve lands in the event log so learning progress
-        # is auditable after the run (the reference's only observable is the
-        # final avg, ShareTradeHelper.scala:46; this is the per-policy
-        # learning signal it never records).
-        self.events.emit("evaluation", updates=int(self._ts.updates),
-                         **result)
-        return result
 
     # ------------------------------------------------------------------
 

@@ -132,6 +132,72 @@ def test_service_range_filtering(tmp_journal_path):
     svc.close()
 
 
+def test_service_auto_compacts_on_event_threshold(tmp_path):
+    """A long-lived service's price journal must stay bounded WITHOUT anyone
+    calling compact() — the reference's config-driven compaction intervals
+    (application.conf:7-14). Refresh the same symbols far past the
+    threshold and assert the journal never exceeds threshold+symbols."""
+    from sharetrade_tpu.config import DataConfig
+
+    cfg = DataConfig(price_compact_every_events=5,
+                     journal_dir=str(tmp_path))
+    journal = Journal(str(tmp_path / "events.journal"))
+    svc = PriceDataService(journal=journal,
+                           provider=synthetic_provider(length=50),
+                           config=cfg)
+    svc.request("AAA")
+    svc.request("BBB")
+    for _ in range(20):                       # 40 more fetch events
+        svc.refresh("AAA")
+        svc.refresh("BBB")
+        assert len(journal) <= 5 + 2, "journal grew without bound"
+    svc.close()
+    # Recovery from the auto-compacted journal reproduces the cache.
+    j2 = Journal(str(tmp_path / "events.journal"))
+    svc2 = PriceDataService(journal=j2, provider=synthetic_provider(length=50))
+    assert svc2.cached_symbols() == ["AAA", "BBB"]
+    svc2.close()
+
+
+def test_service_auto_compaction_disabled_by_zero(tmp_path):
+    from sharetrade_tpu.config import DataConfig
+
+    cfg = DataConfig(price_compact_every_events=0,
+                     journal_dir=str(tmp_path))
+    journal = Journal(str(tmp_path / "events.journal"))
+    svc = PriceDataService(journal=journal,
+                           provider=synthetic_provider(length=50),
+                           config=cfg)
+    svc.request("AAA")
+    for _ in range(10):
+        svc.refresh("AAA")
+    assert len(journal) == 11                 # untouched: opt-out honored
+    svc.close()
+
+
+def test_service_bloated_journal_compacts_after_restart(tmp_path):
+    """Events replayed at recovery count toward the threshold, so a journal
+    bloated by a previous (auto-compaction-off) run shrinks on the first
+    fetch after a restart with compaction on."""
+    from sharetrade_tpu.config import DataConfig
+
+    path = str(tmp_path / "events.journal")
+    off = DataConfig(price_compact_every_events=0, journal_dir=str(tmp_path))
+    svc = PriceDataService(journal=Journal(path),
+                           provider=synthetic_provider(length=50), config=off)
+    for _ in range(9):
+        svc.refresh("AAA")
+    svc.close()
+
+    on = DataConfig(price_compact_every_events=4, journal_dir=str(tmp_path))
+    j2 = Journal(path)
+    svc2 = PriceDataService(journal=j2,
+                            provider=synthetic_provider(length=50), config=on)
+    svc2.refresh("AAA")                       # 10 > 4: compacts
+    assert len(j2) == 1                       # one snapshot per symbol
+    svc2.close()
+
+
 # ---- compaction (reference: LevelDB compaction intervals, application.conf:7-14) ----
 
 def test_journal_compact_collapses_and_survives(tmp_journal_path):

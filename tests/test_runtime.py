@@ -300,6 +300,64 @@ class TestPerAgentRecovery:
         assert snap["trained_workers"] == cfg.parallel.num_workers - 1
         assert np.isfinite(orch.get_avg().value)    # ...and excluded
 
+    def test_episode_model_row_heals_at_survivors_cursor(self, tmp_path):
+        """Round-3 exemption removed: a poisoned row of a trunk-rollout
+        (episode transformer) run heals IN PLACE — fresh wallet rejoining
+        at the survivors' cursor with the representative's carry — instead
+        of rolling the whole run back to the last checkpoint. Survivors'
+        cursors never rewind and no restore happens."""
+        from sharetrade_tpu.utils.logging import EventLog
+        cfg = fast_cfg(tmp_path, algo="ppo")
+        cfg.model.kind = "transformer"
+        cfg.model.seq_mode = "episode"
+        cfg.model.num_layers = 2
+        cfg.model.num_heads = 2
+        cfg.model.head_dim = 16
+        cfg.learner.unroll_len = cfg.runtime.chunk_steps
+        events_path = str(tmp_path / "events.jsonl")
+        poisoned = []
+        cursor_before_heal = []
+
+        def chaos(chunk_idx, metrics):
+            if chunk_idx == 1 and not poisoned:
+                poisoned.append(1)
+                ts = orch._ts
+                cursor_before_heal.append(int(np.asarray(ts.env_state.t[0])))
+                budget = np.asarray(jax.device_get(ts.env_state.budget)).copy()
+                budget[2] = np.nan
+                orch._ts = ts.replace(env_state=ts.env_state.replace(
+                    budget=jnp.asarray(budget)))
+            elif chunk_idx >= 3 and len(cursor_before_heal) == 1:
+                # First chunk AFTER the heal (the detection chunk's hook
+                # runs before _heal_agents): the healed row must sit at the
+                # survivors' (advanced) cursor — lockstep preserved,
+                # nobody rolled back.
+                ts = orch._ts
+                t = np.asarray(jax.device_get(ts.env_state.t))
+                horizon = len(PRICES) - WINDOW
+                assert (t == min(t[0], horizon)).all(), \
+                    f"lockstep broken after heal: cursors {t}"
+                assert t[0] > cursor_before_heal[0], "survivors rolled back"
+                cursor_before_heal.append(int(t[2]))
+
+        orch = Orchestrator(cfg, fault_hook=chaos,
+                            event_log=EventLog(events_path))
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.agent_heals == 1 and orch.restarts == 0
+        import json
+        events = [json.loads(l) for l in open(events_path)]
+        kinds = [e["kind"] for e in events]
+        assert "agents_healed" in kinds
+        assert next(e for e in events
+                    if e["kind"] == "agents_healed")["agents"] == [2]
+        assert "restored" not in kinds and "reinitialized" not in kinds
+        snap = orch.snapshot()
+        assert snap["unhealthy_workers"] == 0
+        assert snap["trained_workers"] == cfg.parallel.num_workers
+        assert np.isfinite(orch.get_avg().value)
+
     def test_all_rows_poisoned_without_recovery_routes_to_restart(self, tmp_path):
         """With partial_recovery=False and EVERY row non-finite the run can
         make no progress (the unconditional quarantine freezes every
