@@ -40,7 +40,7 @@ def _validate_moe_dispatch(cfg: ModelConfig, ep_mesh) -> None:
 
 def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
                 parity: bool = False, num_actions: int | None = None,
-                mesh=None) -> Model:
+                mesh=None, num_assets: int = 1) -> Model:
     """Construct the policy network for ``cfg.kind``.
 
     ``head="q"`` selects the Q-value head (valid for MLP only — the reference
@@ -50,7 +50,9 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
     ``num_actions`` overrides the config (multi-asset envs widen the head).
     ``mesh`` enables the partitioned transformer paths: ``cfg.attention=
     "ring"`` rings attention over its sp axis; ``cfg.pipeline_blocks``
-    pipelines the blocks over its pp axis.
+    pipelines the blocks over its pp axis. ``num_assets`` > 1 selects the
+    window transformer's per-asset-block tokenization over the portfolio
+    observation layout (episode mode stays single-asset — PARITY.md).
     """
     dtype = _DTYPES[cfg.dtype]
     actions = cfg.num_actions if num_actions is None else num_actions
@@ -68,6 +70,14 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
     if cfg.kind == "lstm":
         return lstm_policy(obs_dim, cfg.hidden_dim, actions, dtype=dtype)
     if cfg.kind == "tcn":
+        if num_assets > 1:
+            # Same loud boundary the episode transformer gets: a TCN built
+            # over the portfolio layout would silently convolve asset-1
+            # prices, the budget, and the share counts as one window.
+            raise ValueError(
+                "model.kind='tcn' is single-asset (PARITY.md); use the "
+                "window transformer, mlp, or lstm for multi-asset "
+                "portfolios")
         from sharetrade_tpu.models.tcn import tcn_policy
         return tcn_policy(obs_dim, actions, channels=cfg.hidden_dim,
                           dtype=dtype)
@@ -81,6 +91,12 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
         use_pallas = (False if mesh is not None
                       and mesh.devices.flat[0].platform != "tpu" else None)
         if cfg.seq_mode == "episode":
+            if num_assets > 1:
+                raise ValueError(
+                    "model.seq_mode='episode' is single-asset: its shared-"
+                    "trunk design amortizes ONE tick stream across the "
+                    "agent batch (see PARITY.md); use seq_mode='window' "
+                    "for multi-asset portfolios")
             if cfg.attention not in ("flash", "ring"):
                 raise ValueError(
                     "model.seq_mode='episode' supports attention='flash' "
@@ -170,5 +186,5 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
             moe_experts=cfg.moe_experts, ep_mesh=ep_mesh,
             moe_top_k=cfg.moe_top_k,
             moe_capacity_factor=cfg.moe_capacity_factor,
-            moe_dispatch=cfg.moe_dispatch)
+            moe_dispatch=cfg.moe_dispatch, num_assets=num_assets)
     raise ValueError(f"unknown model kind {cfg.kind!r}")

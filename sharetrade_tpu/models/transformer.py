@@ -40,7 +40,8 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                        moe_experts: int = 0, ep_mesh=None,
                        ep_axis: str = "ep", moe_top_k: int = 0,
                        moe_capacity_factor: float = 1.25,
-                       moe_dispatch: str = "psum") -> Model:
+                       moe_dispatch: str = "psum",
+                       num_assets: int = 1) -> Model:
     """``attention_fn(q, k, v) -> out`` overrides the local flash kernel —
     the sequence-parallel hook (e.g. ``ring_attention_sharded`` binds a mesh
     so attention rings over the sp axis, parallel/ring_attention.py).
@@ -50,9 +51,23 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
     per stage, so ``num_layers`` must equal the pp size. Blocks are then
     stored stacked (leading dim = num_layers) so stage i's slice shards onto
     pp-device i. ``pp_batch_axis`` names the mesh axis the agent batch is
-    sharded over (usually "dp") so microbatches keep that sharding."""
-    window = obs_dim - 2           # price ticks; final token holds the portfolio
-    seq_len = window + 1
+    sharded over (usually "dp") so microbatches keep that sharding.
+
+    ``num_assets`` > 1 tokenizes the multi-asset portfolio observation
+    (env/portfolio.py: A windows ++ budget ++ A share counts) as A
+    per-asset blocks of [window tick tokens | portfolio token], each block
+    tagged with a learned asset embedding; positions tile per block and the
+    policy/value summary averages the A portfolio-token outputs. At A=1
+    this degenerates EXACTLY to the single-asset layout (same parameters,
+    same sequence), so checkpoints stay compatible."""
+    if num_assets < 1:
+        raise ValueError(f"num_assets must be >= 1, got {num_assets}")
+    window = (obs_dim - 1 - num_assets) // num_assets
+    if num_assets * window + 1 + num_assets != obs_dim:
+        raise ValueError(
+            f"obs_dim={obs_dim} does not match the {num_assets}-asset "
+            f"portfolio layout (A*window + 1 + A)")
+    seq_len = num_assets * (window + 1)
     d_model = num_heads * head_dim
     if attention_fn is None:
         attention_fn = lambda q, k, v: flash_attention(  # noqa: E731
@@ -66,18 +81,24 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                          "(nested shard_maps); pick one partitioning")
 
     def init(key):
-        keys = jax.random.split(key, 4 + 6 * num_layers)
+        keys = jax.random.split(key, 5 + 6 * num_layers)
         params = {
             "embed": dense_init(keys[0], 3, d_model, dtype=dtype),
-            "pos": jax.random.normal(keys[1], (seq_len, d_model), dtype) * 0.02,
+            # Within-block positions, tiled per asset block at apply time
+            # (A=1: exactly the old full-sequence table).
+            "pos": jax.random.normal(
+                keys[1], (window + 1, d_model), dtype) * 0.02,
             "policy": dense_init(keys[2], d_model, num_actions, scale=0.01, dtype=dtype),
             "value": dense_init(keys[3], d_model, 1, dtype=dtype),
             "blocks": [],
             "final_ln": {"scale": jnp.ones((d_model,), dtype),
                          "bias": jnp.zeros((d_model,), dtype)},
         }
+        if num_assets > 1:
+            params["asset"] = jax.random.normal(
+                keys[4], (num_assets, d_model), dtype) * 0.02
         for i in range(num_layers):
-            k = keys[4 + 6 * i: 4 + 6 * (i + 1)]
+            k = keys[5 + 6 * i: 5 + 6 * (i + 1)]
             block = {
                 "ln1": {"scale": jnp.ones((d_model,), dtype),
                         "bias": jnp.zeros((d_model,), dtype)},
@@ -132,12 +153,22 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         return x + y, aux
 
     def tokenize(obs):
-        """(B, obs_dim) -> (B, seq, 3): shared tick features plus a final
-        portfolio token (its flag channel is the tick features' zero one)."""
-        tick_tokens = tick_window_features(obs, window)          # (B, window, 3)
-        portfolio_token = portfolio_features(
-            obs[:, window], obs[:, window + 1], obs[:, window - 1])  # (B, 3)
-        return jnp.concatenate([tick_tokens, portfolio_token[:, None, :]], axis=1)
+        """(B, obs_dim) -> (B, seq, 3): per-asset blocks of shared tick
+        features plus that asset's portfolio token (budget, its shares,
+        its window anchor — the flag channel is the tick features' zero
+        one). A=1 reproduces the single-asset layout exactly."""
+        b = obs.shape[0]
+        windows = obs[:, :num_assets * window].reshape(b, num_assets, window)
+        budget = obs[:, num_assets * window]
+        shares = obs[:, num_assets * window + 1:]                # (B, A)
+        ticks = tick_window_features(
+            windows.reshape(b * num_assets, window), window
+        ).reshape(b, num_assets, window, 3)
+        port = portfolio_features(
+            jnp.broadcast_to(budget[:, None], shares.shape), shares,
+            windows[:, :, -1])                                   # (B, A, 3)
+        blocks = jnp.concatenate([ticks, port[:, :, None, :]], axis=2)
+        return blocks.reshape(b, seq_len, 3)
 
     def apply_batch(params, obs, carry):
         """Native batched forward: the whole agent batch rides one flash
@@ -145,7 +176,10 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         (the round-1 pathology: per-agent vmapped kernel invocations)."""
         bsz = obs.shape[0]
         tokens = tokenize(obs).astype(dtype)
-        x = dense(params["embed"], tokens) + params["pos"]       # (B, seq, d)
+        pos = jnp.tile(params["pos"], (num_assets, 1))           # (seq, d)
+        x = dense(params["embed"], tokens) + pos                 # (B, seq, d)
+        if num_assets > 1:
+            x = x + jnp.repeat(params["asset"], window + 1, axis=0)
         aux = jnp.float32(0.0)
         if pp_mesh is None:
             for blk in params["blocks"]:
@@ -168,7 +202,11 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                 lambda blk, t: block_apply(blk, t)[0], params["blocks"], mb,
                 pp_mesh, axis=pp_axis, mb_spec=P(None, b_axis))
             x = mb.reshape((bsz,) + mb.shape[2:])
-        summary = _layer_norm(x[:, -1], params["final_ln"]["scale"],
+        # Summary = mean over the A portfolio tokens' outputs (A=1: the
+        # final token, the original readout).
+        port_idx = (jnp.arange(num_assets) + 1) * (window + 1) - 1
+        summary = _layer_norm(jnp.mean(x[:, port_idx], axis=1),
+                              params["final_ln"]["scale"],
                               params["final_ln"]["bias"])
         logits = dense(params["policy"], summary).astype(jnp.float32)
         value = dense(params["value"], summary).astype(jnp.float32)[:, 0]

@@ -754,7 +754,8 @@ class Orchestrator:
                 from sharetrade_tpu.agents import _HEADS  # registry heads
                 model = build_model(self.cfg.model, self.env.obs_dim,
                                     head=_HEADS[self.cfg.learner.algo],
-                                    num_actions=self.env.num_actions)
+                                    num_actions=self.env.num_actions,
+                                    num_assets=self.env.num_assets)
             from sharetrade_tpu.agents.rollout import (
                 supports_precomputed_trunk)
             if supports_precomputed_trunk(model, env):

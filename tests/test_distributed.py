@@ -91,7 +91,7 @@ class TestTwoProcessSmoke:
 
     NPROC = 2
 
-    def _spawn(self, pid: int, port: int) -> subprocess.Popen:
+    def _spawn(self, pid: int, port: int, model: str) -> subprocess.Popen:
         env = dict(os.environ)
         # Scrub the site hook's trigger so the child's jax never registers
         # the axon TPU plugin (two processes cannot share the one chip), and
@@ -102,15 +102,21 @@ class TestTwoProcessSmoke:
         env["XLA_FLAGS"] = ""
         return subprocess.Popen(
             [sys.executable, WORKER, f"127.0.0.1:{port}",
-             str(self.NPROC), str(pid)],
+             str(self.NPROC), str(pid), model],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env, cwd=REPO_ROOT)
 
-    def test_sharded_training_across_processes(self):
+    @pytest.mark.parametrize("model", ["mlp", "transformer_episode"])
+    def test_sharded_training_across_processes(self, model):
+        """Both the MLP family and the flagship episode transformer cross
+        the process boundary: for the latter the representative-row trunk
+        broadcast and the shared-trunk replay's collectives run over a dp
+        mesh spanning two OS processes, which single-process meshes never
+        exercise."""
         with socket.socket() as s:  # reserve a free coordinator port
             s.bind(("127.0.0.1", 0))
             port = s.getsockname()[1]
-        procs = [self._spawn(pid, port) for pid in range(self.NPROC)]
+        procs = [self._spawn(pid, port, model) for pid in range(self.NPROC)]
         outs = []
         try:
             for p in procs:

@@ -23,6 +23,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> int:
     coordinator, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    model_kind = sys.argv[4] if len(sys.argv) > 4 else "mlp"
 
     from sharetrade_tpu.parallel import build_mesh, init_distributed
 
@@ -50,6 +51,17 @@ def main() -> int:
     cfg.parallel.mesh_shape = {"dp": len(devices)}
     cfg.learner.unroll_len = 8
     cfg.runtime.chunk_steps = 8
+    if model_kind == "transformer_episode":
+        # The flagship model class crossing the process boundary: the
+        # precomputed-trunk rollout's representative-row broadcast and the
+        # shared-trunk replay run over a dp mesh that SPANS processes.
+        cfg.model.kind = "transformer"
+        cfg.model.seq_mode = "episode"
+        cfg.model.num_layers = 2
+        cfg.model.num_heads = 2
+        cfg.model.head_dim = 16
+    elif model_kind != "mlp":
+        raise ValueError(f"unknown smoke model kind {model_kind!r}")
 
     mesh = build_mesh(cfg.parallel, devices=devices)
     env_params = trading.env_from_prices(
