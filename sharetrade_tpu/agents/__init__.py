@@ -62,17 +62,9 @@ def build_agent(cfg: FrameworkConfig, env: TradingEnv | trading.EnvParams,
         raise ValueError(
             f"learner.algo={algo!r} requires model.kind='mlp' "
             f"(got {cfg.model.kind!r}); use a2c/ppo for {cfg.model.kind} policies")
-    if env.num_assets > 1 and (
-            cfg.model.kind == "tcn"
-            or (cfg.model.kind == "transformer"
-                and cfg.model.seq_mode == "episode")):
-        # Documented boundaries (PARITY.md): TCN convolves one window;
-        # episode mode's shared-trunk design amortizes ONE tick stream.
-        # The WINDOW transformer tokenizes portfolios per asset block.
-        raise ValueError(
-            f"{cfg.model.kind}/{cfg.model.seq_mode} is single-asset "
-            "(PARITY.md); use model.kind='transformer' seq_mode='window', "
-            "mlp, or lstm for multi-asset portfolios")
+    # Multi-asset model-family boundaries (TCN, episode transformer —
+    # PARITY.md) are enforced by build_model, the single authority every
+    # construction path funnels through.
     if model is None:
         model = build_model(cfg.model, env.obs_dim, head=_HEADS[algo],
                             num_actions=env.num_actions, mesh=mesh,

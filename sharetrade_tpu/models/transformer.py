@@ -81,7 +81,12 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                          "(nested shard_maps); pick one partitioning")
 
     def init(key):
-        keys = jax.random.split(key, 5 + 6 * num_layers)
+        # The asset embedding (A>1 only) draws from an extra TRAILING key:
+        # split(key, n) is prefix-stable in n, so single-asset configs
+        # reproduce the exact same weights per seed as before the
+        # multi-asset feature existed.
+        keys = jax.random.split(
+            key, 4 + 6 * num_layers + (1 if num_assets > 1 else 0))
         params = {
             "embed": dense_init(keys[0], 3, d_model, dtype=dtype),
             # Within-block positions, tiled per asset block at apply time
@@ -96,9 +101,9 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         }
         if num_assets > 1:
             params["asset"] = jax.random.normal(
-                keys[4], (num_assets, d_model), dtype) * 0.02
+                keys[-1], (num_assets, d_model), dtype) * 0.02
         for i in range(num_layers):
-            k = keys[5 + 6 * i: 5 + 6 * (i + 1)]
+            k = keys[4 + 6 * i: 4 + 6 * (i + 1)]
             block = {
                 "ln1": {"scale": jnp.ones((d_model,), dtype),
                         "bias": jnp.zeros((d_model,), dtype)},

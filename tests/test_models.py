@@ -230,6 +230,7 @@ class TestEpisodeMode:
         np.testing.assert_allclose(np.asarray(carry_tr["v"]),
                                    np.asarray(carry["v"]), atol=3e-4)
 
+    @pytest.mark.slow
     def test_shared_trunk_replay_matches_per_agent_unroll(self):
         """apply_unroll_shared (trunk once, per-agent heads) must produce
         the same logits/values AND the same parameter gradients as the
@@ -271,6 +272,7 @@ class TestEpisodeMode:
                     rtol=1e-5, atol=5e-3,
                     err_msg=f"gradient mismatch (chunk {chunk})")
 
+    @pytest.mark.slow
     def test_shared_trunk_replay_skips_zeroed_quarantine_rows(self):
         """A quarantined row's stored obs is all-zero; the shared replay
         must elect a live representative (not the zeroed row) and stay
@@ -396,6 +398,7 @@ class TestEpisodeMode:
         assert not np.allclose(np.asarray(out1.logits),
                                np.asarray(out2.logits))
 
+    @pytest.mark.slow
     def test_episode_moe_rollout_replay_parity_and_training(self):
         """Episode mode composes with MoE: the FFN routes through the
         shared dispatch (models/ffn.py). Dense-mask top-1 is per-token
@@ -428,6 +431,7 @@ class TestEpisodeMode:
         ts2, metrics = jax.jit(agent.step)(ts2)
         assert np.isfinite(float(metrics["loss"]))
 
+    @pytest.mark.slow
     def test_episode_pipeline_matches_unpartitioned(self, cpu_devices):
         """Episode × pp: the pipelined banded forward (positions riding the
         state, K/V + aux escaping as pipeline sides) must reproduce the
