@@ -204,6 +204,12 @@ class RuntimeConfig:
     # budget then bounds availability) — a recurring per-row fault must not
     # heal->re-poison->heal forever.
     max_agent_heals: int = 10
+    # Periodic greedy evaluation DURING training: every this many updates
+    # the orchestrator runs evaluate() between chunks (one argmax episode
+    # replay; the jitted program is cached), feeding the event-log learning
+    # curve and the best-eval retention below without the caller having to
+    # evaluate manually. 0 (default) = only explicit evaluate() calls.
+    eval_every_updates: int = 0
     # Retain the best-greedy-eval policy as a tagged checkpoint
     # (<checkpoint_dir>/tag_best) every time evaluate() improves on the
     # best seen: on-policy training can discover a strategy and then

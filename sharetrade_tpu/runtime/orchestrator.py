@@ -104,6 +104,7 @@ class Orchestrator:
         self.restarts = 0
         self.agent_heals = 0   # per-agent row respawns (partial_recovery)
         self._best_eval: float | None = None  # lazily seeded from tag_best
+        self._best_eval_lock = threading.Lock()
         self.episode = 0
         self.last_error: BaseException | None = None
         self._transitions_journal = None
@@ -316,6 +317,21 @@ class Orchestrator:
                                        "(shared state poisoned)")
 
                 updates = int(metrics.get("updates", 0))
+                if (rt.eval_every_updates > 0
+                        and updates // rt.eval_every_updates
+                        > last_ckpt_updates // rt.eval_every_updates):
+                    # Periodic greedy eval between chunks: feeds the
+                    # event-log learning curve and (keep_best_eval) the
+                    # retained-best checkpoint during long unattended runs.
+                    # Contained: an eval/retention failure (e.g. disk full
+                    # in save_tagged) is an observability loss, not a
+                    # training fault — it must not consume a restart or
+                    # roll the healthy run back to a checkpoint.
+                    try:
+                        self.evaluate()
+                    except Exception:
+                        log.exception("periodic evaluation failed; "
+                                      "training continues")
                 if (rt.checkpoint_every_updates > 0
                         and updates // rt.checkpoint_every_updates
                         > last_ckpt_updates // rt.checkpoint_every_updates):
@@ -704,19 +720,25 @@ class Orchestrator:
         self.events.emit("evaluation", updates=int(self._ts.updates),
                          **result)
         if self.cfg.runtime.keep_best_eval:
-            if self._best_eval is None:
-                prior = self.checkpoints.tagged_metadata("best")
-                self._best_eval = (float(prior["eval_portfolio"])
-                                   if prior else float("-inf"))
-            if result["eval_portfolio"] > self._best_eval:
-                self._best_eval = result["eval_portfolio"]
-                self.checkpoints.save_tagged(
-                    "best", self._ts,
-                    metadata={"eval_portfolio": result["eval_portfolio"],
-                              "updates": int(self._ts.updates)})
-                self.events.emit("best_eval_retained",
-                                 eval_portfolio=result["eval_portfolio"],
-                                 updates=int(self._ts.updates))
+            # Locked check-then-act: the training thread's periodic eval
+            # (runtime.eval_every_updates) and a caller thread's explicit
+            # evaluate() can race here, and an unguarded compare would let
+            # a worse policy overwrite a better tag_best.
+            with self._best_eval_lock:
+                if self._best_eval is None:
+                    prior = self.checkpoints.tagged_metadata("best")
+                    self._best_eval = (float(prior["eval_portfolio"])
+                                       if prior else float("-inf"))
+                if result["eval_portfolio"] > self._best_eval:
+                    self._best_eval = result["eval_portfolio"]
+                    self.checkpoints.save_tagged(
+                        "best", self._ts,
+                        metadata={"eval_portfolio": result["eval_portfolio"],
+                                  "updates": int(self._ts.updates)})
+                    self.events.emit(
+                        "best_eval_retained",
+                        eval_portfolio=result["eval_portfolio"],
+                        updates=int(self._ts.updates))
         return result
 
     def evaluate_best(self) -> dict[str, float]:

@@ -638,6 +638,31 @@ def _journaled_rows(cfg) -> int:
 
 
 @pytest.mark.slow
+class TestPeriodicEval:
+    def test_periodic_eval_retains_best_during_training(self, tmp_path):
+        """runtime.eval_every_updates fires greedy evals between chunks
+        unattended, so the event-log learning curve and the keep_best_eval
+        retention work during long runs where nobody calls evaluate()."""
+        import json
+        from sharetrade_tpu.utils.logging import EventLog
+        cfg = fast_cfg(tmp_path)
+        cfg.runtime.eval_every_updates = 32
+        events_path = str(tmp_path / "events.jsonl")
+        orch = Orchestrator(cfg, event_log=EventLog(events_path))
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        events = [json.loads(l) for l in open(events_path)]
+        evals = [e for e in events if e["kind"] == "evaluation"]
+        assert len(evals) >= 2, "cadence evals did not fire"
+        assert "best_eval_retained" in {e["kind"] for e in events}
+        best = orch.evaluate_best()   # retained with no explicit evaluate()
+        assert np.isfinite(best["eval_portfolio"])
+        assert best["eval_portfolio"] == pytest.approx(
+            max(e["eval_portfolio"] for e in evals))
+
+
+@pytest.mark.slow
 class TestInitialise:
     def test_retrain_keeps_params(self, tmp_path):
         orch = run_end_to_end(fast_cfg(tmp_path), PRICES)
