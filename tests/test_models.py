@@ -267,9 +267,12 @@ class TestEpisodeMode:
             g_pa = jax.grad(loss)(ts.params, model.apply_unroll)
             for p_sh, p_pa in zip(jax.tree.leaves(g_sh),
                                   jax.tree.leaves(g_pa)):
+                # rtol accommodates backend reduction-order noise (TPU
+                # measured ~3e-7, CPU ~5e-5 relative); a genuinely wrong
+                # gradient path diverges by O(1) relative.
                 np.testing.assert_allclose(
                     np.asarray(p_sh), np.asarray(p_pa),
-                    rtol=1e-5, atol=5e-3,
+                    rtol=1e-4, atol=5e-3,
                     err_msg=f"gradient mismatch (chunk {chunk})")
 
     @pytest.mark.slow
