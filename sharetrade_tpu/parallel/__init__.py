@@ -4,14 +4,6 @@ Replaces the reference's Akka Router + mailbox parameter server (SURVEY.md
 §2.2-2.3) with jax.sharding meshes and XLA collectives over ICI/DCN.
 """
 
-from sharetrade_tpu.parallel.collectives import (  # noqa: F401
-    all_gather,
-    all_reduce_mean,
-    all_reduce_sum,
-    broadcast_from,
-    reduce_scatter,
-    ring_shift,
-)
 from sharetrade_tpu.parallel.mesh import AXIS_ORDER, build_mesh, init_distributed  # noqa: F401
 from sharetrade_tpu.parallel.moe import (  # noqa: F401
     init_moe_params,
