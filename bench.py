@@ -160,7 +160,30 @@ def bench_reference_shape() -> dict:
     }
 
 
+def _await_devices(timeout_s: float = 180.0) -> None:
+    """Fail LOUDLY if device discovery hangs (a dead TPU tunnel blocks
+    ``jax.devices()`` forever — observed in round 4: connection refused on
+    the remote-compile endpoint with the client waiting indefinitely).
+    One JSON error line + non-zero exit beats a silent harness timeout."""
+    import os
+    import threading
+
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(timeout_s):
+            print(json.dumps({
+                "error": f"device discovery exceeded {timeout_s:.0f}s "
+                         "(TPU tunnel down?)"}), flush=True)
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    jax.devices()
+    done.set()
+
+
 def main() -> None:
+    _await_devices()
     # ONE JSON line (the driver contract): the flagship headline, with the
     # reference-shape and large-model rows nested so all three workloads
     # stay recorded every round.
