@@ -160,13 +160,51 @@ def bench_reference_shape() -> dict:
     }
 
 
-def _await_devices(timeout_s: float = 180.0) -> None:
-    """Fail LOUDLY if device discovery hangs (a dead TPU tunnel blocks
-    ``jax.devices()`` forever — observed in round 4: connection refused on
-    the remote-compile endpoint with the client waiting indefinitely).
-    One JSON error line + non-zero exit beats a silent harness timeout."""
+def _await_devices(attempts: int = 3, timeout_s: float = 180.0,
+                   backoff_s: float = 30.0) -> None:
+    """Fail LOUDLY — but not eagerly — when device discovery hangs (a dead
+    TPU tunnel blocks ``jax.devices()`` forever: round 4 saw connection
+    refused on the remote-compile endpoint with the client waiting
+    indefinitely, and its single 180 s watchdog zeroed the round's only
+    perf artifact on what may have been a flapping tunnel).
+
+    A hung in-process discovery cannot be cancelled (backend init is a
+    process-global singleton), so each retry probes discovery in a fresh
+    subprocess with a hard timeout; only after a probe succeeds does this
+    process touch ``jax.devices()``, with a watchdog as backstop. Exhausted
+    retries print ONE JSON error line and exit 3."""
     import os
+    import subprocess
+    import sys
     import threading
+    import time as _time
+
+    probe = "import jax; jax.devices()"
+    probe_errs = []
+    for attempt in range(attempts):
+        try:
+            subprocess.run(
+                [sys.executable, "-c", probe], timeout=timeout_s, check=True,
+                capture_output=True)
+            break
+        except subprocess.TimeoutExpired:
+            probe_errs.append(f"probe {attempt + 1}: hung >{timeout_s:.0f}s")
+            if attempt + 1 < attempts:
+                _time.sleep(backoff_s * (attempt + 1))
+        except subprocess.CalledProcessError as e:
+            # Deterministic failure (broken env, import error): keep the
+            # stderr tail for diagnosis and don't waste the hang backoff.
+            tail = (e.stderr or b"")[-400:].decode("utf-8", "replace")
+            probe_errs.append(f"probe {attempt + 1}: rc={e.returncode}: "
+                              + " ".join(tail.split()))
+            if attempt + 1 < attempts:
+                _time.sleep(5)
+    else:
+        print(json.dumps({
+            "error": f"device discovery failed {attempts} probes "
+                     "(TPU tunnel down, or broken jax env?)",
+            "probes": probe_errs}), flush=True)
+        raise SystemExit(3)
 
     done = threading.Event()
 
@@ -174,7 +212,7 @@ def _await_devices(timeout_s: float = 180.0) -> None:
         if not done.wait(timeout_s):
             print(json.dumps({
                 "error": f"device discovery exceeded {timeout_s:.0f}s "
-                         "(TPU tunnel down?)"}), flush=True)
+                         "in-process after a successful probe"}), flush=True)
             os._exit(3)
 
     threading.Thread(target=watchdog, daemon=True).start()

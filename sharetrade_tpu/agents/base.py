@@ -135,6 +135,22 @@ def agent_health(env_state) -> jax.Array:
     return ok
 
 
+def election_health(env_state, carry) -> jax.Array:
+    """(B,) bool: THE row-health predicate shared by representative
+    election (agents/rollout.py) and the per-row heal
+    (runtime/orchestrator.py): every env-state leaf row finite AND every
+    batched model-carry leaf row finite. A row with a finite wallet but a
+    non-finite carry (NaN K/V cache) must never be elected representative —
+    its carry would broadcast into every agent's shared trunk, escalating a
+    one-row fault to a whole-batch poisoning."""
+    ok = agent_health(env_state)
+    b = ok.shape[0]
+    for leaf in jax.tree.leaves(carry):
+        if leaf.ndim >= 1 and leaf.shape[0] == b:
+            ok &= jnp.all(jnp.isfinite(leaf.reshape(b, -1)), axis=-1)
+    return ok
+
+
 def quarantine_mask(obs_raw: jax.Array, env_state) -> jax.Array:
     """THE learner-side quarantine predicate: a row is healthy iff its
     observation AND its whole env-state row are finite. One definition so

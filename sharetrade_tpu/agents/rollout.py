@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from sharetrade_tpu.agents.base import (
-    TrainState, agent_health, quarantine_mask)
+    TrainState, election_health, quarantine_mask)
 from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models.core import Model, apply_batched
 
@@ -186,12 +186,19 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
     # orchestrator._heal_agents), so the price windows AND the whole trunk
     # are computed for ONE representative agent and broadcast — the trunk's
     # cost and the window gather drop by a factor of B. The representative
-    # must be a healthy row: a quarantined row's cursor freezes while the
-    # broadcast carry['t'] keeps advancing, so electing it would feed every
-    # healthy agent windows from a stale cursor with desynced RoPE
-    # positions. argmax picks the first healthy row (row 0 if none exist —
-    # then every row is inactive and the chunk is a masked no-op anyway).
-    rep = jnp.argmax(agent_health(ts.env_state)).astype(jnp.int32)
+    # must be a healthy row BY THE SAME PREDICATE the heal uses
+    # (election_health: env state AND model carry finite): a quarantined
+    # row's cursor freezes while the broadcast carry['t'] keeps advancing,
+    # so electing it would feed every healthy agent windows from a stale
+    # cursor with desynced RoPE positions — and a finite-wallet row with a
+    # NaN carry would broadcast the NaN K/V cache into the shared trunk.
+    # argmax picks the first healthy row. Fallback when NONE exists: row 0.
+    # If every row failed on env state, all rows are also quarantine-masked
+    # and the chunk is a masked no-op; if every row failed only on its
+    # carry, the broadcast NaN trunk makes the chunk's loss non-finite and
+    # the orchestrator's detector escalates to restore — correct when the
+    # whole batch is beyond a row-level heal.
+    rep = jnp.argmax(election_health(ts.env_state, ts.carry)).astype(jnp.int32)
     take_rep = lambda x: jax.lax.dynamic_index_in_dim(x, rep, 0,
                                                       keepdims=True)
     state1 = jax.tree.map(take_rep, ts.env_state)

@@ -487,13 +487,32 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         trunk paths each pulled back by one agent's head cotangent equal
         one shared path pulled back by their sum).
 
-        The representative must be a live row: a quarantined agent's stored
-        observation is zero-sanitized (prices are strictly positive), so
-        argmax over "window has a real price" elects the first healthy row
-        — electing a zeroed row would corrupt every agent's replay.
+        The representative must be a live row at EVERY step of the unroll:
+        a quarantined agent's stored observation is zero-sanitized (prices
+        are strictly positive), and a row quarantined MID-unroll — the
+        normal fault timing — has real early steps but a zeroed tail, so
+        electing on step 0 alone could pick a row whose tail feeds
+        eps-clamped garbage into every healthy agent's trunk. Electing the
+        row with the MOST healthy steps (anchor price real) dominates both
+        edge cases: a fully-healthy row wins outright (count T), and when
+        every row is partially quarantined the longest-healthy row
+        corrupts the fewest unmasked steps — an all-steps predicate would
+        instead fall back to row 0, which could be a fully-zeroed row.
+        Rows whose unroll-start carry is non-finite are excluded outright
+        (the rollout election's carry term, agents/base.election_health):
+        a NaN carry['hist']/['t'] would poison the ONE shared banded pass
+        for every agent. If every carry is poisoned, row 0 wins and the
+        non-finite loss escalates to the orchestrator's restore — correct
+        when the whole batch is beyond a row-level heal.
         """
         t_len, bsz = obs.shape[0], obs.shape[1]
-        rep = jnp.argmax(obs[0, :, window - 1] > 0).astype(jnp.int32)
+        counts = jnp.sum(obs[:, :, window - 1] > 0, axis=0)
+        carry_ok = jnp.ones((bsz,), bool)
+        for leaf in jax.tree.leaves(carry):
+            if leaf.ndim >= 1 and leaf.shape[0] == bsz:
+                carry_ok &= jnp.all(
+                    jnp.isfinite(leaf.reshape(bsz, -1)), axis=-1)
+        rep = jnp.argmax(jnp.where(carry_ok, counts, -1)).astype(jnp.int32)
         obs1 = jax.lax.dynamic_index_in_dim(obs, rep, 1, keepdims=True)
         carry1 = jax.tree.map(
             lambda x: jax.lax.dynamic_index_in_dim(x, rep, 0, keepdims=True),

@@ -493,18 +493,13 @@ class Orchestrator:
         or no bad rows found (the fault is elsewhere)."""
         if self._step_override is not None or self.agent is None:
             return False
-        from sharetrade_tpu.agents.base import agent_health
+        from sharetrade_tpu.agents.base import election_health
         ts = self._ts
-        # Writable copy: device_get can return read-only arrays and the
-        # carry loop below &='s into this in place.
-        ok = np.array(jax.device_get(agent_health(ts.env_state)))
-        carry_leaves = jax.tree.leaves(ts.carry)
-        if carry_leaves:
-            b = ok.shape[0]
-            for leaf in jax.device_get(carry_leaves):
-                arr = np.asarray(leaf)
-                if arr.shape[:1] == (b,):
-                    ok &= np.isfinite(arr.reshape(b, -1)).all(axis=-1)
+        # THE shared row-health predicate (also used to elect the shared-
+        # trunk representative in agents/rollout.py): env state AND model
+        # carry finite, per row.
+        ok = np.asarray(jax.device_get(election_health(ts.env_state,
+                                                       ts.carry)))
         bad = ~ok
         if not bad.any() or bad.all():
             return False

@@ -302,6 +302,37 @@ class TestEpisodeMode:
         np.testing.assert_allclose(np.asarray(v_sh[:, 1:]),
                                    np.asarray(v_pa[:, 1:]), atol=3e-4)
 
+    @pytest.mark.slow
+    def test_shared_trunk_replay_skips_mid_unroll_quarantined_row(self):
+        """The NORMAL fault timing: a row quarantined mid-unroll has real
+        early-step obs but a zero-sanitized tail. Electing on step 0 alone
+        would pick it (row 0 wins argmax) and eps-clamp its zeroed tail
+        into finite garbage inside every healthy agent's trunk; the
+        election must scan the WHOLE trajectory and skip it."""
+        from sharetrade_tpu.agents.rollout import collect_rollout
+
+        _, agent, env = self._setup(num_agents=3)
+        model = agent.model
+        ts = agent.init(jax.random.PRNGKey(0))
+        init_carry = ts.carry
+        ts, traj, _, _ = collect_rollout(model, env, ts, 8, 3)
+        # Row 0 healthy through step 3, zeroed from step 4 onward.
+        zeroed = traj._replace(
+            obs=traj.obs.at[4:, 0].set(0.0),
+            active=traj.active.at[4:, 0].set(0.0))
+
+        l_sh, v_sh, _ = model.apply_unroll_shared(
+            ts.params, zeroed.obs, init_carry)
+        l_pa, v_pa, _ = model.apply_unroll(ts.params, traj.obs, init_carry)
+        assert np.isfinite(np.asarray(l_sh)).all()
+        assert np.isfinite(np.asarray(v_sh)).all()
+        # Healthy rows replay exactly as if the poisoned row were absent —
+        # fails if the zero-tailed row 0 was elected representative.
+        np.testing.assert_allclose(np.asarray(l_sh[:, 1:]),
+                                   np.asarray(l_pa[:, 1:]), atol=3e-4)
+        np.testing.assert_allclose(np.asarray(v_sh[:, 1:]),
+                                   np.asarray(v_pa[:, 1:]), atol=3e-4)
+
     def test_quarantined_representative_row_does_not_corrupt_trunk(self):
         """The shared-trunk rollout elects a HEALTHY representative row: a
         quarantined row's cursor freezes while the broadcast carry keeps
@@ -332,6 +363,44 @@ class TestEpisodeMode:
                                           np.asarray(traj_t.action[:, 1:]))
         np.testing.assert_array_equal(np.asarray(ts.env_state.t[1:]),
                                       np.asarray(twin.env_state.t[1:]))
+
+    def test_nan_carry_row_not_elected_representative(self):
+        """election_health ANDs model-carry finiteness into the election:
+        a row with a finite wallet but a NaN carry (K/V cache) must not be
+        elected — its carry would broadcast into the shared trunk and
+        poison every agent's windows, escalating a one-row fault to a
+        full-batch corruption."""
+        from sharetrade_tpu.agents.rollout import collect_rollout
+
+        _, agent, env = self._setup(num_agents=3)
+        model = agent.model
+        ts = agent.init(jax.random.PRNGKey(0))
+        ts, *_ = collect_rollout(model, env, ts, 8, 3)   # chunk A: healthy
+        twin = ts
+
+        k = np.asarray(ts.carry["k"]).copy()
+        k[0] = np.nan                                    # row 0 carry poisoned
+        ts = ts.replace(carry={**ts.carry, "k": jnp.asarray(k)})
+
+        poisoned_carry = ts.carry
+        ts, traj_p, _, _ = collect_rollout(model, env, ts, 8, 3)
+        twin, traj_t, _, _ = collect_rollout(model, env, twin, 8, 3)
+        assert np.isfinite(np.asarray(traj_p.obs)).all(), \
+            "NaN carry broadcast into the shared trunk"
+        np.testing.assert_allclose(
+            np.asarray(traj_p.obs[:, 1:]), np.asarray(traj_t.obs[:, 1:]),
+            atol=1e-5, err_msg="healthy rows corrupted by NaN-carry rep")
+        np.testing.assert_array_equal(np.asarray(traj_p.action[:, 1:]),
+                                      np.asarray(traj_t.action[:, 1:]))
+
+        # Replay-side election must skip the NaN-carry row too: every
+        # row's stored obs is healthy, so an obs-only election would tie
+        # at count T and elect poisoned row 0 into the ONE shared pass.
+        l_sh, v_sh, _ = model.apply_unroll_shared(
+            ts.params, traj_t.obs, poisoned_carry)
+        assert np.isfinite(np.asarray(l_sh[:, 1:])).all(), \
+            "replay elected the NaN-carry representative"
+        assert np.isfinite(np.asarray(v_sh[:, 1:])).all()
 
     def test_greedy_eval_trunk_matches_incremental(self):
         """Orchestrator.evaluate()'s precomputed-trunk greedy replay must
