@@ -1,0 +1,98 @@
+"""Orchestrator vs raw-loop throughput on the SAME config and chip.
+
+Round-4 verdict weak #3: the production Orchestrator paid a per-chunk
+``float(np.asarray(v))`` device round-trip that bench.py's raw dispatch
+loop deliberately avoids, so real training runs could not approach the
+advertised BENCH throughput on dispatch-bound configs. The sampled-metrics
+hot loop (``runtime.metrics_every_chunks``) removes that sync; this tool
+measures the residual gap end-to-end.
+
+Method: run the config through the full Orchestrator (supervision, event
+log, checkpointing — everything a real run carries) for ``--episodes``
+passes over the fixture-shaped series, timestamping episode boundaries via
+the event log. Episode 1 absorbs compilation; throughput is computed over
+episodes 2..N from the event timestamps. The raw-loop number for the same
+config comes from bench.bench_episode_config (the driver's measurement).
+
+Usage (from a scratch cwd — the data layer writes journal/ + checkpoints/):
+    python /root/repo/benchmarks/orchestrator_throughput.py \
+        [--config ppo_tr_episode_b128_u1024_bf16] [--episodes 4] [--skip-raw]
+
+Prints ONE JSON line: orchestrator agent-steps/s, raw-loop agent-steps/s,
+and their ratio (BASELINE.md records it; the target is >= 0.85).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="ppo_tr_episode_b128_u1024_bf16")
+    ap.add_argument("--episodes", type=int, default=4)
+    ap.add_argument("--length", type=int, default=6046,
+                    help="price series length (shrink for smoke tests)")
+    ap.add_argument("--skip-raw", action="store_true",
+                    help="skip the raw-loop comparison row")
+    args = ap.parse_args()
+
+    from bench import bench_episode_config
+    from benchmarks.run_all import make_configs
+    from sharetrade_tpu.data.synthetic import synthetic_price_series
+    from sharetrade_tpu.runtime import Orchestrator, ReplyState
+    from sharetrade_tpu.utils.logging import EventLog
+
+    cfg = make_configs()[args.config]
+    cfg.runtime.episodes = args.episodes
+    series = synthetic_price_series(length=args.length)
+
+    workdir = tempfile.mkdtemp(prefix="orch_bench_")
+    os.chdir(workdir)
+    cfg.runtime.checkpoint_dir = os.path.join(workdir, "ckpts")
+    events_path = os.path.join(workdir, "events.jsonl")
+
+    orch = Orchestrator(cfg, event_log=EventLog(events_path))
+    orch.send_training_data(series.prices)
+    orch.start_training(background=False)
+    assert orch.is_everything_done().state is ReplyState.COMPLETED, \
+        f"run did not complete (restarts={orch.restarts})"
+
+    events = [json.loads(line) for line in open(events_path)]
+    marks = [e["ts"] for e in events if e["kind"] == "episode_completed"]
+    if len(marks) < 2:
+        # Fewer than 3 episodes: fall back to the completion timestamp
+        # (includes the final synchronous checkpoint save).
+        marks += [e["ts"] for e in events if e["kind"] == "training_completed"]
+    if len(marks) < 2:
+        raise SystemExit("need >= 2 episodes to exclude the compile episode")
+    horizon = orch.env.num_steps
+    warm_episodes = len(marks) - 1          # episode 1 absorbs compilation
+    agent_steps = warm_episodes * horizon * cfg.parallel.num_workers
+    elapsed = marks[-1] - marks[0]
+    orch_rate = agent_steps / elapsed
+
+    out = {
+        "metric": f"orchestrator_{args.config}_agent_steps_per_sec",
+        "value": round(orch_rate, 2),
+        "unit": "agent-steps/s",
+        "warm_episodes": warm_episodes,
+        "metrics_every_chunks": cfg.runtime.metrics_every_chunks,
+        "restarts": orch.restarts,
+    }
+    if not args.skip_raw:
+        raw = bench_episode_config(
+            args.config, f"raw_{args.config}_agent_steps_per_sec", reps=2)
+        out["raw_loop"] = raw["value"]
+        out["orchestrator_over_raw"] = round(orch_rate / raw["value"], 3)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -143,12 +143,9 @@ def election_health(env_state, carry) -> jax.Array:
     non-finite carry (NaN K/V cache) must never be elected representative —
     its carry would broadcast into every agent's shared trunk, escalating a
     one-row fault to a whole-batch poisoning."""
+    from sharetrade_tpu.models.core import rows_finite
     ok = agent_health(env_state)
-    b = ok.shape[0]
-    for leaf in jax.tree.leaves(carry):
-        if leaf.ndim >= 1 and leaf.shape[0] == b:
-            ok &= jnp.all(jnp.isfinite(leaf.reshape(b, -1)), axis=-1)
-    return ok
+    return ok & rows_finite(carry, ok.shape[0])
 
 
 def quarantine_mask(obs_raw: jax.Array, env_state) -> jax.Array:

@@ -204,6 +204,22 @@ class RuntimeConfig:
     # budget then bounds availability) — a recurring per-row fault must not
     # heal->re-poison->heal forever.
     max_agent_heals: int = 10
+    # Metrics/fault sampling cadence: materialize chunk metrics on the host
+    # every this many chunks (1 = every chunk). Each materialization is a
+    # device round-trip that serializes the dispatch pipeline (~0.1 s on a
+    # tunneled chip — the gap between Orchestrator and bench.py throughput);
+    # between samples, chunks dispatch back-to-back. Consequences, all
+    # bounded by this knob: fault DETECTION latency (non-finite rows /
+    # loss) is at most metrics_every_chunks chunks — the on-device
+    # quarantine still fences poison from the shared params every chunk,
+    # so only healing is delayed, not containment; GetAvg/GetStd snapshots
+    # can be up to this many chunks stale; eval/checkpoint cadences
+    # quantize to sampled chunks. Completion is NEVER missed: the loop
+    # tracks a host-side upper bound on env_steps and samples every chunk
+    # once it nears the episode threshold. Chunks that emit replay
+    # transitions (DQN journaling) and runs with a fault_hook installed
+    # sample every chunk regardless (durability / test-seam semantics).
+    metrics_every_chunks: int = 10
     # Periodic greedy evaluation DURING training: every this many updates
     # the orchestrator runs evaluate() between chunks (one argmax episode
     # replay; the jitted program is cached), feeding the event-log learning

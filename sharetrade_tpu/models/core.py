@@ -109,6 +109,22 @@ def apply_batched(model: Model, params: Any, obs_batch: jax.Array,
 _EPS = 1e-6
 
 
+def rows_finite(tree: Any, batch: int) -> jax.Array:
+    """(batch,) bool: True where every batched leaf row of ``tree`` is
+    finite. THE row-finiteness predicate behind the fault-quarantine
+    story — shared by the heal/election predicate
+    (agents/base.election_health) and the shared-trunk replay's
+    representative election (models/transformer_episode.apply_unroll_shared)
+    so the two can never silently diverge. Leaves whose leading dim is not
+    ``batch`` (unbatched scalars/tables) are ignored; integer leaves pass
+    trivially (isfinite is all-True on ints)."""
+    ok = jnp.ones((batch,), bool)
+    for leaf in jax.tree.leaves(tree):
+        if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == batch:
+            ok &= jnp.all(jnp.isfinite(leaf.reshape(batch, -1)), axis=-1)
+    return ok
+
+
 def tick_window_features(obs: jax.Array, window: int) -> jax.Array:
     """(B, obs_dim) observations -> (B, window, 3) scale-invariant per-tick
     features: price relative to the window's last price, log-return, and a

@@ -52,7 +52,7 @@ import jax
 import jax.numpy as jnp
 
 from sharetrade_tpu.models.core import (
-    Model, ModelOut, dense, dense_init, portfolio_features)
+    Model, ModelOut, dense, dense_init, portfolio_features, rows_finite)
 from sharetrade_tpu.models.ffn import ffn_apply
 from sharetrade_tpu.models.transformer import _layer_norm
 from sharetrade_tpu.ops.attention import flash_attention
@@ -507,11 +507,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         """
         t_len, bsz = obs.shape[0], obs.shape[1]
         counts = jnp.sum(obs[:, :, window - 1] > 0, axis=0)
-        carry_ok = jnp.ones((bsz,), bool)
-        for leaf in jax.tree.leaves(carry):
-            if leaf.ndim >= 1 and leaf.shape[0] == bsz:
-                carry_ok &= jnp.all(
-                    jnp.isfinite(leaf.reshape(bsz, -1)), axis=-1)
+        carry_ok = rows_finite(carry, bsz)
         rep = jnp.argmax(jnp.where(carry_ok, counts, -1)).astype(jnp.int32)
         obs1 = jax.lax.dynamic_index_in_dim(obs, rep, 1, keepdims=True)
         carry1 = jax.tree.map(

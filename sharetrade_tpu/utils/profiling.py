@@ -72,29 +72,35 @@ class StepTimer:
     chunk_steps: int
     num_agents: int
     _last: float | None = None
-    history: list[float] = field(default_factory=list)
+    # (elapsed seconds, chunks covered) per tick: the orchestrator's sampled
+    # metrics cadence ticks once per SAMPLE, covering several dispatched
+    # chunks, so each entry carries its own chunk count.
+    history: list[tuple[float, int]] = field(default_factory=list)
 
-    def tick(self) -> dict[str, float]:
-        """Call once per completed chunk; returns throughput metrics."""
+    def tick(self, chunks: int = 1) -> dict[str, float]:
+        """Call once per completed chunk — or once per metrics sample with
+        ``chunks`` = the number of chunks dispatched since the last tick;
+        returns throughput metrics averaged over that span."""
         now = time.perf_counter()
         if self._last is None:
             self._last = now
             return {}
         dt = now - self._last
         self._last = now
-        self.history.append(dt)
-        agent_steps = self.chunk_steps * self.num_agents
+        self.history.append((dt, chunks))
+        agent_steps = self.chunk_steps * self.num_agents * chunks
         return {
-            "chunk_seconds": dt,
-            "env_steps_per_sec": self.chunk_steps / dt if dt > 0 else 0.0,
+            "chunk_seconds": dt / chunks,
+            "env_steps_per_sec":
+                self.chunk_steps * chunks / dt if dt > 0 else 0.0,
             "agent_steps_per_sec": agent_steps / dt if dt > 0 else 0.0,
         }
 
     def summary(self) -> dict[str, float]:
         if not self.history:
             return {}
-        total = sum(self.history)
-        chunks = len(self.history)
+        total = sum(dt for dt, _ in self.history)
+        chunks = sum(n for _, n in self.history)
         return {
             "chunks_timed": float(chunks),
             "total_seconds": total,

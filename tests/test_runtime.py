@@ -88,6 +88,35 @@ class TestQueriesPerPhase:
         assert repr(avg).startswith("Result(")
 
 
+class TestSampledMetrics:
+    def test_completion_exact_with_sampling_coarser_than_run(self, tmp_path):
+        """The sampled-metrics fast path (metrics_every_chunks > run
+        length): chunks dispatch with NO host materialization between
+        samples, yet the host-side env_steps upper bound must sample the
+        completion chunk — the run completes at the exact episode
+        threshold, runs exactly the right number of chunks, and serves
+        queries afterwards."""
+        import json
+        from sharetrade_tpu.utils.logging import EventLog
+        cfg = fast_cfg(tmp_path)
+        cfg.runtime.metrics_every_chunks = 1000   # coarser than the run
+        cfg.runtime.episodes = 2
+        events_path = str(tmp_path / "events.jsonl")
+        orch = Orchestrator(cfg, event_log=EventLog(events_path))
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.restarts == 0
+        events = [json.loads(l) for l in open(events_path)]
+        done = [e for e in events if e["kind"] == "training_completed"][0]
+        horizon = orch.env.num_steps
+        assert done["env_steps"] == 2 * horizon       # exact, no overshoot
+        chunks_per_episode = -(-horizon // cfg.runtime.chunk_steps)
+        assert done["chunks_timed"] == 2 * chunks_per_episode
+        avg = orch.get_avg()
+        assert avg.ok and np.isfinite(avg.value)
+
+
 @pytest.mark.slow
 class TestMidRunQueries:
     def test_query_during_training_not_blocking(self, tmp_path):
@@ -647,6 +676,10 @@ class TestPeriodicEval:
         from sharetrade_tpu.utils.logging import EventLog
         cfg = fast_cfg(tmp_path)
         cfg.runtime.eval_every_updates = 32
+        # Per-chunk metrics: this test pins the FINE cadence semantics; the
+        # sampled default quantizes cadences to metrics_every_chunks
+        # (TestSampledMetrics covers that mode).
+        cfg.runtime.metrics_every_chunks = 1
         events_path = str(tmp_path / "events.jsonl")
         orch = Orchestrator(cfg, event_log=EventLog(events_path))
         orch.send_training_data(PRICES)
