@@ -178,6 +178,16 @@ def make_configs() -> dict[str, FrameworkConfig]:
             learner__unroll_len=512, runtime__chunk_steps=512,
             model__num_layers=4, model__num_heads=8, model__head_dim=128,
             model__dtype="bfloat16"),
+        # d1024 with block-granular remat (model.remat_blocks): the MFU
+        # experiment row — recomputing block internals in the backward
+        # frees residual HBM for wider unrolls/batches; measure against
+        # the exact row above to price the recompute.
+        "ppo_tr_episode_large_d1024_remat": base(
+            learner__algo="ppo", model__kind="transformer",
+            model__seq_mode="episode", parallel__num_workers=64,
+            learner__unroll_len=512, runtime__chunk_steps=512,
+            model__num_layers=4, model__num_heads=8, model__head_dim=128,
+            model__dtype="bfloat16", model__remat_blocks=True),
         # The reference's ENTIRE workload as one compiled chunk: 10 workers x
         # the full 5,845-step episode (6,046 prices - 201 window,
         # env/trading.py num_steps), rollout + GAE + clipped updates, with

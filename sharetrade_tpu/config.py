@@ -18,6 +18,16 @@ from dataclasses import dataclass, field, fields
 from typing import Any
 
 
+class ConfigError(ValueError):
+    """An invalid configuration: unknown keys/kinds, impossible
+    compositions (e.g. pipeline_blocks + moe_experts), malformed
+    overrides. The supervision decider maps THIS type — not every
+    ValueError — to STOP (the reference's IllegalArgumentException→Stop,
+    TrainerRouterActor.scala:53-58): a bad config can never heal by
+    restarting, but a transient in-loop ValueError (JAX retrace/shape
+    wobble after a checkpoint restore) deserves the restart path."""
+
+
 @dataclass
 class DataConfig:
     """L1 market-data layer (reference: SharePriceGetter.scala)."""
@@ -37,12 +47,14 @@ class DataConfig:
     # bounded queue; falls back to synchronous appends when the native
     # library isn't built.
     async_transition_writer: bool = True
-    # Auto-compact the price-event journal once this many fetch events have
-    # accumulated since the last compaction (counting events replayed at
-    # recovery, so a bloated journal shrinks on the first fetch after a
-    # restart) — the reference's config-driven per-actor
-    # ``compaction-intervals`` (application.conf:7-14). 0 disables;
-    # explicit ``PriceDataService.compact()`` always remains available.
+    # Auto-compact the price-event journal once its REDUNDANCY — events
+    # beyond the one snapshot per symbol a compaction would leave — exceeds
+    # this count (events replayed at recovery included, so a bloated
+    # journal shrinks on the first fetch after a restart; a service caching
+    # more symbols than the threshold never thrashes) — the reference's
+    # config-driven per-actor ``compaction-intervals``
+    # (application.conf:7-14). 0 disables; explicit
+    # ``PriceDataService.compact()`` always remains available.
     price_compact_every_events: int = 64
 
 
@@ -106,6 +118,14 @@ class ModelConfig:
     # materializes the global batch on one device. Requires moe_top_k>0 and
     # a mesh with an ep axis.
     moe_dispatch: str = "psum"
+    # Episode-mode block-granular rematerialization: the replay backward
+    # recomputes each transformer block's internals from its input instead
+    # of storing them — O(L·S·d) residuals drop to the block boundaries,
+    # the HBM lever for the d>=1024 tier's long replays. Finer than
+    # learner.remat (which checkpoints the whole replay pass); composes
+    # with it. Ignored under pipeline_blocks (each pp stage already holds
+    # only its own block's residuals).
+    remat_blocks: bool = False
 
 
 @dataclass
@@ -275,7 +295,7 @@ class FrameworkConfig:
         cfg = FrameworkConfig.from_dict(self.to_dict())
         for item in overrides:
             if "=" not in item:
-                raise ValueError(f"override must look like section.key=value, got {item!r}")
+                raise ConfigError(f"override must look like section.key=value, got {item!r}")
             dotted, raw = item.split("=", 1)
             try:
                 value = json.loads(raw)

@@ -145,7 +145,7 @@ class PriceDataService:
         # one snapshot per symbol, so a long-lived service's journal stays
         # bounded without anyone remembering to call compact().
         self._compact_every = cfg.price_compact_every_events
-        self._events_since_compact = 0
+        self._journal_events = 0
         self._recover()
 
     # ---- public protocol (the RequestStockPrice equivalent) ----
@@ -188,7 +188,7 @@ class PriceDataService:
                    "series": self._cache[s].to_dict()}
                   for s in self.cached_symbols()]
         self._journal.compact(events)
-        self._events_since_compact = len(events)
+        self._journal_events = len(events)
 
     def close(self) -> None:
         self._journal.close()
@@ -198,17 +198,24 @@ class PriceDataService:
     def _persist(self, symbol: str, series: PriceSeries) -> None:
         self._journal.append({"type": "prices_fetched", "symbol": symbol,
                               "series": series.to_dict()})
-        self._events_since_compact += 1
+        self._journal_events += 1
 
     def _maybe_compact(self) -> None:
         """Threshold check, called AFTER the fetch is merged into the
         cache: compact() snapshots the cache, so compacting from inside
         _persist (pre-merge) would rewrite the journal without the very
-        event that crossed the threshold — losing it across restarts."""
+        event that crossed the threshold — losing it across restarts.
+
+        The trigger measures REDUNDANCY (journal events beyond the one
+        snapshot per symbol a compaction would leave), not raw journal
+        size: a service caching more symbols than the threshold would
+        otherwise sit above it permanently and rewrite the whole journal
+        on every fetch."""
         if (self._compact_every > 0
-                and self._events_since_compact > self._compact_every):
-            log.info("auto-compacting price journal after %d events",
-                     self._events_since_compact)
+                and (self._journal_events - len(self._cache)
+                     > self._compact_every)):
+            log.info("auto-compacting price journal: %d events for %d "
+                     "symbols", self._journal_events, len(self._cache))
             self.compact()
 
     def _merge(self, symbol: str, fetched: PriceSeries) -> None:
@@ -227,7 +234,7 @@ class PriceDataService:
         # The counter tracks events currently IN the journal (replay sees
         # them all), so a journal bloated by a previous un-compacted run
         # crosses the threshold on the first fetch after restart.
-        self._events_since_compact = count
+        self._journal_events = count
         if count:
             log.info("recovered %d fetch events for %s", count, self.cached_symbols())
 

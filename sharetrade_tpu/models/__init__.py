@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from sharetrade_tpu.config import ModelConfig
+from sharetrade_tpu.config import ConfigError, ModelConfig
 from sharetrade_tpu.models.core import Model, ModelOut, dense, dense_init  # noqa: F401
 from sharetrade_tpu.models.lstm import lstm_policy
 from sharetrade_tpu.models.mlp import ac_mlp, q_mlp
@@ -22,17 +22,17 @@ _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
 def _validate_moe_dispatch(cfg: ModelConfig, ep_mesh) -> None:
     """MoE dispatch validation shared by the window and episode branches."""
     if cfg.moe_dispatch not in ("psum", "a2a"):
-        raise ValueError(
+        raise ConfigError(
             f"unknown model.moe_dispatch {cfg.moe_dispatch!r} "
             "(expected 'psum' or 'a2a')")
     if cfg.moe_dispatch == "a2a" and cfg.moe_experts:
         if not cfg.moe_top_k:
-            raise ValueError(
+            raise ConfigError(
                 "model.moe_dispatch='a2a' is a top-k dispatch pattern; "
                 "set model.moe_top_k>0 (the dense-mask top-1 scheme has "
                 "no capacity buffers to all_to_all)")
         if ep_mesh is None:
-            raise ValueError(
+            raise ConfigError(
                 "model.moe_dispatch='a2a' needs a mesh with an 'ep' "
                 "axis (set parallel.mesh_shape, e.g. "
                 "{\"dp\": 2, \"ep\": 4})")
@@ -57,11 +57,17 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
     dtype = _DTYPES[cfg.dtype]
     actions = cfg.num_actions if num_actions is None else num_actions
     if cfg.seq_mode not in ("window", "episode"):
-        raise ValueError(f"unknown model.seq_mode {cfg.seq_mode!r}")
+        raise ConfigError(f"unknown model.seq_mode {cfg.seq_mode!r}")
     if cfg.seq_mode == "episode" and cfg.kind != "transformer":
-        raise ValueError(
+        raise ConfigError(
             f"model.seq_mode='episode' is a transformer mode; "
             f"model.kind={cfg.kind!r} would silently ignore it")
+    if cfg.remat_blocks and not (cfg.kind == "transformer"
+                                 and cfg.seq_mode == "episode"):
+        raise ConfigError(
+            "model.remat_blocks applies to the episode-mode transformer's "
+            "banded replay only; other models would silently ignore it — "
+            "use learner.remat for the window/fold replay paths")
     if cfg.kind == "mlp":
         if head == "q":
             return q_mlp(obs_dim, cfg.hidden_dim, actions,
@@ -74,7 +80,7 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
             # Same loud boundary the episode transformer gets: a TCN built
             # over the portfolio layout would silently convolve asset-1
             # prices, the budget, and the share counts as one window.
-            raise ValueError(
+            raise ConfigError(
                 "model.kind='tcn' is single-asset (PARITY.md); use the "
                 "window transformer, mlp, or lstm for multi-asset "
                 "portfolios")
@@ -92,13 +98,13 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
                       and mesh.devices.flat[0].platform != "tpu" else None)
         if cfg.seq_mode == "episode":
             if num_assets > 1:
-                raise ValueError(
+                raise ConfigError(
                     "model.seq_mode='episode' is single-asset: its shared-"
                     "trunk design amortizes ONE tick stream across the "
                     "agent batch (see PARITY.md); use seq_mode='window' "
                     "for multi-asset portfolios")
             if cfg.attention not in ("flash", "ring"):
-                raise ValueError(
+                raise ConfigError(
                     "model.seq_mode='episode' supports attention='flash' "
                     "(local banded) or 'ring' (the sp halo exchange — "
                     "episode mode's sequence-parallel scheme); ulysses is "
@@ -106,12 +112,12 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
             episode_attention = None
             if cfg.attention == "ring":
                 if mesh is None or "sp" not in mesh.axis_names:
-                    raise ValueError(
+                    raise ConfigError(
                         "model.attention='ring' needs a mesh with an 'sp' "
                         "axis (set parallel.mesh_shape, e.g. "
                         "{\"dp\": 2, \"sp\": 4})")
                 if cfg.pipeline_blocks:
-                    raise ValueError(
+                    raise ConfigError(
                         "model.attention='ring' + model.pipeline_blocks is "
                         "unsupported (no sp attention inside a pipeline "
                         "stage); pick one partitioning")
@@ -123,7 +129,7 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
             ep_pp_mesh = None
             if cfg.pipeline_blocks:
                 if mesh is None or "pp" not in mesh.axis_names:
-                    raise ValueError(
+                    raise ConfigError(
                         "model.pipeline_blocks needs a mesh with a 'pp' "
                         "axis (set parallel.mesh_shape, e.g. "
                         "{\"dp\": 2, \"pp\": 4})")
@@ -141,10 +147,11 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
                 moe_experts=cfg.moe_experts, ep_mesh=ep_mesh,
                 moe_top_k=cfg.moe_top_k,
                 moe_capacity_factor=cfg.moe_capacity_factor,
-                moe_dispatch=cfg.moe_dispatch)
+                moe_dispatch=cfg.moe_dispatch,
+                remat_blocks=cfg.remat_blocks)
         if cfg.attention in ("ring", "ulysses"):
             if mesh is None or "sp" not in mesh.axis_names:
-                raise ValueError(
+                raise ConfigError(
                     f"model.attention={cfg.attention!r} needs a mesh with an "
                     "'sp' axis (set parallel.mesh_shape, e.g. "
                     "{\"dp\": 2, \"sp\": 4})")
@@ -160,14 +167,14 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
                     mesh, seq_axis="sp", batch_axis=batch_axis,
                     use_pallas=use_pallas)
         elif cfg.attention != "flash":
-            raise ValueError(f"unknown model.attention {cfg.attention!r}")
+            raise ConfigError(f"unknown model.attention {cfg.attention!r}")
         if cfg.pipeline_blocks:
             if mesh is None or "pp" not in mesh.axis_names:
-                raise ValueError(
+                raise ConfigError(
                     "model.pipeline_blocks needs a mesh with a 'pp' axis "
                     "(set parallel.mesh_shape, e.g. {\"dp\": 2, \"pp\": 4})")
             if cfg.attention != "flash":
-                raise ValueError(
+                raise ConfigError(
                     f"model.attention={cfg.attention!r} + "
                     "model.pipeline_blocks is unsupported (nested "
                     "shard_maps); pick one partitioning")
@@ -187,4 +194,4 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
             moe_top_k=cfg.moe_top_k,
             moe_capacity_factor=cfg.moe_capacity_factor,
             moe_dispatch=cfg.moe_dispatch, num_assets=num_assets)
-    raise ValueError(f"unknown model kind {cfg.kind!r}")
+    raise ConfigError(f"unknown model kind {cfg.kind!r}")

@@ -93,7 +93,8 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                                moe_experts: int = 0, ep_mesh=None,
                                ep_axis: str = "ep", moe_top_k: int = 0,
                                moe_capacity_factor: float = 1.25,
-                               moe_dispatch: str = "psum") -> Model:
+                               moe_dispatch: str = "psum",
+                               remat_blocks: bool = False) -> Model:
     """Build the episode-mode policy (``ModelConfig.seq_mode="episode"``).
 
     ``attention_fn(q, k, v, window) -> out`` overrides the local banded
@@ -110,10 +111,14 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
     composes with. ``pp_mesh`` pipelines the banded blocks over its
     ``pp_axis`` (GPipe, parallel/pipeline.py; blocks stored stacked so
     stage i's slice shards onto pp-device i). Microbatches cut the agent
-    batch; the batch-of-1 trunk/shared-replay passes run single-microbatch
-    (a full pipeline bubble — pp on this path partitions layer memory, not
-    time). pp + MoE is rejected (nested shard_maps), as is pp + a non-local
-    attention override.
+    batch when it divides the stage count; otherwise — the batch-of-1
+    trunk/shared-replay passes — the SEQUENCE is cut into streamed chunks
+    whose banded halo flows chunk-to-chunk through a stage-local pipeline
+    carry (the sp halo-exchange trick, parallel/episode_sp.py, applied
+    along the schedule), so those passes pipeline along time instead of
+    idling (stages-1)/stages of the schedule; m=1 remains only for
+    sequences shorter than two window-1 chunks. pp + MoE is rejected
+    (nested shard_maps), as is pp + a non-local attention override.
     """
     if head_dim % 2:
         raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
@@ -140,11 +145,15 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                              "attention (no sp override inside a stage)")
 
     def block_ffn(blk, h):
+        # batch_axis keeps the dp sharding of the token batch inside the
+        # MoE's shard_map (a dp x ep mesh would otherwise all_gather the
+        # batch — correct but silently losing the dp split window mode
+        # keeps, models/transformer.py:157).
         return ffn_apply(
             blk, h, moe_experts=moe_experts, ep_mesh=ep_mesh,
             ep_axis=ep_axis, moe_top_k=moe_top_k,
             moe_capacity_factor=moe_capacity_factor,
-            moe_dispatch=moe_dispatch)
+            moe_dispatch=moe_dispatch, batch_axis=pp_batch_axis)
 
     def init(key):
         keys = jax.random.split(key, 5 + 6 * num_layers)
@@ -241,9 +250,23 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                 params, x, positions, kv_offset)
         else:
             attn = attn or attention_fn
+            blk_fn = block_apply
+            if remat_blocks:
+                # Block-granular rematerialization: the backward recomputes
+                # each block's internals (qkv, attention, FFN activations)
+                # from its input, so only the O(S·d) block boundaries are
+                # stored — the FLOPs-for-HBM trade that lets the d≥1024
+                # tier run long replays without learner.remat's coarser
+                # whole-pass checkpoint. Functionally a no-op (pinned by
+                # test_models.py::test_remat_blocks_matches_exact).
+                def blk_fn(blk, x, positions, *, attn, kv_offset):
+                    return jax.checkpoint(
+                        lambda b, h, p: block_apply(
+                            b, h, p, attn=attn, kv_offset=kv_offset)
+                    )(blk, x, positions)
             kv, aux = [], jnp.float32(0.0)
             for blk in blocks_of(params):
-                x, kv_tail, blk_aux = block_apply(
+                x, kv_tail, blk_aux = blk_fn(
                     blk, x, positions, attn=attn, kv_offset=kv_offset)
                 kv.append(kv_tail)
                 aux = aux + blk_aux
@@ -262,14 +285,29 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         receives exactly one state array). K/V tails and the per-block aux
         escape as pipeline side outputs (pipeline_apply side_template).
         Microbatches cut the agent batch when it divides by the stage
-        count; the batch-of-1 trunk/shared-replay passes run m=1 (full
-        bubble — correctness, not throughput, on those passes).
+        count; otherwise the SEQUENCE is cut into streamed chunks
+        (_forward_blocks_pipelined_seq) — the batch-of-1 trunk/shared-
+        replay passes pipeline along time instead of idling
+        (stages-1)/stages of the schedule. m=1 (full bubble) remains only
+        for sequences too short to chunk.
         """
+        bsz, s_len = x.shape[0], x.shape[1]
+        stages = num_layers
+        if bsz % stages == 0:
+            return _forward_blocks_pipelined_batch(
+                params, x, positions, kv_offset, m=stages)
+        plan = _seq_chunk_plan(s_len, kv_offset)
+        if plan is not None:
+            return _forward_blocks_pipelined_seq(
+                params, x, positions, kv_offset, plan)
+        return _forward_blocks_pipelined_batch(
+            params, x, positions, kv_offset, m=1)
+
+    def _forward_blocks_pipelined_batch(params, x, positions, kv_offset, m):
+        """Microbatches cut the AGENT batch (independent rows)."""
         from jax.sharding import PartitionSpec as P
         from sharetrade_tpu.parallel.pipeline import pipeline_apply
         bsz, s_len = x.shape[0], x.shape[1]
-        stages = num_layers
-        m = stages if bsz % stages == 0 else 1
         mb_b = bsz // m
         state = jnp.concatenate(
             [x.astype(jnp.float32),
@@ -318,6 +356,121 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         # sum over M then divide by M keeps the per-token mean semantics).
         kv = [(sides["k"][l].reshape(bsz, num_heads, window, head_dim),
                sides["v"][l].reshape(bsz, num_heads, window, head_dim))
+              for l in range(num_layers)]
+        aux = jnp.sum(sides["aux"]) / m
+        return x, kv, aux
+
+    def _seq_chunk_plan(s_len, kv_offset):
+        """(m, chunk_len, pad) for sequence-chunk pipelining, or None when
+        the sequence is too short for >1 chunk. Constraints (all static):
+        chunk_len >= window-1 (the banded halo fits in one predecessor
+        chunk, and the chunk-0 exact-head pass needs window-1 local rows)
+        and the cache-tail slice must start inside [halo | chunk]
+        (chunk_len - 1 - kv_offset - pad >= 0). More chunks shrink the
+        GPipe bubble (stages-1)/(m+stages-1); 4*stages chunks put it under
+        ~20% with diminishing returns beyond."""
+        halo = window - 1
+        for m in range(min(s_len // max(halo, 1), 4 * num_layers), 1, -1):
+            chunk_len = -(-s_len // m)
+            pad = m * chunk_len - s_len
+            if chunk_len >= halo and chunk_len - 1 - kv_offset - pad >= 0:
+                return m, chunk_len, pad
+        return None
+
+    def _forward_blocks_pipelined_seq(params, x, positions, kv_offset,
+                                      plan):
+        """Microbatches cut the SEQUENCE: chunk m streams through the
+        stages right behind chunk m-1, and each stage hands its banded-
+        attention halo (its chunk's last window-1 roped K/V rows) to the
+        next chunk through a stage-local pipeline carry
+        (parallel/pipeline.py carry_template) — sequential microbatches,
+        the pipeline analogue of the sp halo exchange
+        (parallel/episode_sp.py), with the same chunk-0 correction: the
+        first chunk's zero halo would take softmax weight, so its first
+        window-1 queries (whose bands sit entirely in the local prefix)
+        are answered by a small plain-causal pass. End padding rides
+        behind every real row, so causality keeps it invisible; the
+        cache-tail side slices around it (static offset)."""
+        from jax.sharding import PartitionSpec as P
+        from sharetrade_tpu.parallel.pipeline import pipeline_apply
+        bsz, s_len = x.shape[0], x.shape[1]
+        m, chunk_len, pad = plan
+        halo = window - 1
+        state = jnp.concatenate(
+            [x.astype(jnp.float32),
+             positions[..., None].astype(jnp.float32)], axis=-1)
+        if pad:
+            state = jnp.pad(state, ((0, 0), (0, pad), (0, 0)))
+        mb = state.reshape(bsz, m, chunk_len, d_model + 1).transpose(
+            1, 0, 2, 3)
+        # Chunk-index flag channel: stage_fn selects the chunk-0 head
+        # correction from it (a pipeline stage sees only its state array).
+        flags = jnp.broadcast_to(
+            jnp.arange(m, dtype=jnp.float32).reshape(m, 1, 1, 1),
+            (m, bsz, chunk_len, 1))
+        mb = jnp.concatenate([mb, flags], axis=-1)
+        b_axis = pp_batch_axis
+        if b_axis is not None and bsz % pp_mesh.shape[b_axis]:
+            b_axis = None       # odd batch (the B=1 passes): replicate
+        b_shard = 1 if b_axis is None else pp_mesh.shape[b_axis]
+        b_loc = bsz // b_shard
+        lo = chunk_len - 1 - kv_offset - pad  # tail start in [halo|chunk]
+
+        def stage_fn(blk, st, carry):
+            xb = st[..., :d_model].astype(dtype)
+            pos = st[..., d_model].astype(jnp.int32)
+            first = st[0, 0, d_model + 1] == 0.0
+            b, c = xb.shape[0], xb.shape[1]
+            h = _layer_norm(xb, blk["ln1"]["scale"], blk["ln1"]["bias"])
+            qkv = dense(blk["qkv"], h).reshape(b, c, 3, num_heads, head_dim)
+            q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
+            q = _rope(q, pos)
+            k = _rope(k, pos)
+            kv_k = jnp.concatenate([carry["k"], k], axis=2)
+            kv_v = jnp.concatenate([carry["v"], v], axis=2)
+            # Left-pad queries so q row j aligns with key row j; the pad
+            # rows' outputs are sliced off (episode_sp.py alignment trick).
+            qp = jnp.pad(q, [(0, 0), (0, 0), (halo, 0), (0, 0)])
+            out = local_attention(qp, kv_k, kv_v, window)[:, :, halo:]
+            head_exact = local_attention(
+                q[:, :, :halo], k[:, :, :halo], v[:, :, :halo], window)
+            head = jnp.where(first, head_exact, out[:, :, :halo])
+            attn_out = jnp.concatenate([head, out[:, :, halo:]], axis=2)
+            attn_out = attn_out.transpose(0, 2, 1, 3).reshape(
+                b, c, d_model).astype(dtype)
+            xb = xb + dense(blk["proj"], attn_out)
+            h2 = _layer_norm(xb, blk["ln2"]["scale"], blk["ln2"]["bias"])
+            y, aux = block_ffn(blk, h2)
+            if b_axis is not None:
+                aux = jax.lax.pmean(aux, b_axis)
+            xb = xb + y
+            side = {"k": kv_k[:, :, lo:lo + window],
+                    "v": kv_v[:, :, lo:lo + window], "aux": aux}
+            new_carry = {"k": k[:, :, -halo:], "v": v[:, :, -halo:]}
+            out_st = jnp.concatenate(
+                [xb.astype(jnp.float32), st[..., d_model:]], axis=-1)
+            return out_st, side, new_carry
+
+        side_template = {
+            "k": jnp.zeros((b_loc, num_heads, window, head_dim), dtype),
+            "v": jnp.zeros((b_loc, num_heads, window, head_dim), dtype),
+            "aux": jnp.float32(0.0),
+        }
+        side_specs = {"k": P(None, None, b_axis),
+                      "v": P(None, None, b_axis), "aux": P()}
+        carry_template = {
+            "k": jnp.zeros((b_loc, num_heads, halo, head_dim), dtype),
+            "v": jnp.zeros((b_loc, num_heads, halo, head_dim), dtype),
+        }
+        mb_out, sides = pipeline_apply(
+            stage_fn, params["blocks"], mb, pp_mesh, axis=pp_axis,
+            mb_spec=P(None, b_axis), side_template=side_template,
+            side_specs=side_specs, carry_template=carry_template)
+        x = mb_out[..., :d_model].transpose(1, 0, 2, 3).reshape(
+            bsz, m * chunk_len, d_model)[:, :s_len].astype(dtype)
+        # Cache tail: only the LAST chunk's side row is the real series
+        # tail (earlier chunks' slices are discarded).
+        kv = [(sides["k"][l, -1], sides["v"][l, -1])
               for l in range(num_layers)]
         aux = jnp.sum(sides["aux"]) / m
         return x, kv, aux

@@ -23,7 +23,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 def pipeline_apply(stage_fn, stage_params, microbatches, mesh: Mesh,
                    *, axis: str = "pp", mb_spec: P = P(),
-                   side_template=None, side_specs=None):
+                   side_template=None, side_specs=None,
+                   carry_template=None):
     """Run ``microbatches`` through ``num_stages`` pipelined stages.
 
     - ``stage_fn(params, x) -> x``: one stage's forward (same signature for
@@ -31,6 +32,16 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh: Mesh,
       ``side_template``, ``stage_fn(params, x) -> (x, side)`` — ``side`` is
       a per-(stage, microbatch) pytree matching the template's
       shapes/dtypes (e.g. a block's K/V cache tail, its MoE balance loss).
+      With ``carry_template`` (requires ``side_template``),
+      ``stage_fn(params, x, carry) -> (x, side, carry)`` — ``carry`` is a
+      STAGE-LOCAL streaming state threaded tick-to-tick within each stage
+      and never communicated: microbatch m's processing at stage i sees the
+      carry microbatch m-1 left there (GPipe microbatches are normally
+      independent; the carry supports SEQUENTIAL microbatches — sequence
+      chunks whose banded-attention halo flows chunk to chunk,
+      models/transformer_episode.py). Initialized to the template's zeros
+      per call; updates are masked off on fill/drain ticks so garbage
+      states never pollute it.
     - ``stage_params``: pytree whose leaves have leading dim ``num_stages``
       (stage i's slice lives on pp-device i).
     - ``microbatches``: array of shape (M, ...) — M microbatches.
@@ -57,6 +68,9 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh: Mesh,
     num_micro = microbatches.shape[0]
     if axis in jax.tree.leaves(tuple(mb_spec)):
         raise ValueError(f"mb_spec {mb_spec} must not shard over {axis!r}")
+    if carry_template is not None and side_template is None:
+        raise ValueError("carry_template requires side_template "
+                         "(stage_fn returns (x, side, carry))")
 
     def local_fn(params_local, mb_local):
         # params_local: this stage's params (leading dim stripped by the
@@ -71,6 +85,8 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh: Mesh,
         sides = jax.tree.map(
             lambda t: jnp.zeros((num_micro,) + t.shape, t.dtype),
             side_template)
+        carry = jax.tree.map(lambda t: jnp.zeros(t.shape, t.dtype),
+                             carry_template)
 
         for t in range(num_micro + num_stages - 1):
             # Stage 0 ingests microbatch t on ticks 0..M-1.
@@ -82,12 +98,21 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh: Mesh,
             if side_template is None:
                 state = stage_fn(params_here, state)
             else:
-                state, side = stage_fn(params_here, state)
                 # This stage processes microbatch (t - stage) at tick t;
                 # record its side there (ticks outside [stage, stage+M)
                 # carry fill/garbage state and are masked off).
                 mb_idx = jnp.clip(t - stage, 0, num_micro - 1)
                 live = (t >= stage) & (t - stage < num_micro)
+                if carry_template is None:
+                    state, side = stage_fn(params_here, state)
+                else:
+                    state, side, new_carry = stage_fn(
+                        params_here, state, carry)
+                    # Fill/drain ticks run on garbage states; their carry
+                    # must not leak into the first real microbatch.
+                    carry = jax.tree.map(
+                        lambda c, nc: jnp.where(live, nc, c),
+                        carry, new_carry)
                 sides = jax.tree.map(
                     lambda acc, s: acc.at[mb_idx].set(
                         jnp.where(live, s, acc[mb_idx])), sides, side)

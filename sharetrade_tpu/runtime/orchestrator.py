@@ -43,7 +43,7 @@ import numpy as np
 from sharetrade_tpu.agents import build_agent
 from sharetrade_tpu.agents.base import Agent, TrainState
 from sharetrade_tpu.checkpoint import CheckpointManager
-from sharetrade_tpu.config import FrameworkConfig
+from sharetrade_tpu.config import ConfigError, FrameworkConfig
 from sharetrade_tpu.env import trading
 from sharetrade_tpu.env.portfolio import make_portfolio_env
 from sharetrade_tpu.parallel import build_mesh, make_parallel_step
@@ -62,11 +62,15 @@ RESUME, RESTART, STOP, ESCALATE = "resume", "restart", "stop", "escalate"
 #: (ArithmeticException→Resume, NullPointer→Restart, IllegalArgument→Stop,
 #: anything else→Escalate... except here unknown errors Restart, because on
 #: TPU transient device errors are the common case and restart-from-
-#: checkpoint is the designed recovery path).
+#: checkpoint is the designed recovery path). The Stop verb is scoped to
+#: ConfigError, not all ValueError: a bad config can never heal by
+#: restarting, but a transient in-loop ValueError (a JAX tracing/shape
+#: error from a restored-then-retraced step) deserves the restart path
+#: instead of permanently failing the run.
 DEFAULT_ERROR_POLICY: dict[type, str] = {
     ArithmeticError: RESUME,
     AttributeError: RESTART,
-    ValueError: STOP,
+    ConfigError: STOP,
     KeyboardInterrupt: ESCALATE,
 }
 

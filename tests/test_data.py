@@ -175,6 +175,29 @@ def test_service_auto_compaction_disabled_by_zero(tmp_path):
     svc.close()
 
 
+def test_service_compaction_not_thrashing_with_many_symbols(tmp_path):
+    """With more cached symbols than the threshold, the trigger measures
+    REDUNDANCY (events beyond one snapshot per symbol), not raw journal
+    size — a size trigger would sit above threshold permanently and
+    rewrite the whole journal on every subsequent fetch."""
+    from sharetrade_tpu.config import DataConfig
+
+    cfg = DataConfig(price_compact_every_events=2, journal_dir=str(tmp_path))
+    journal = Journal(str(tmp_path / "events.journal"))
+    svc = PriceDataService(journal=journal,
+                           provider=synthetic_provider(length=50), config=cfg)
+    for s in ["AA", "BB", "CC", "DD"]:        # 4 symbols > threshold 2
+        svc.request(s)
+    assert len(journal) == 4       # one event per symbol: nothing to shrink
+    svc.refresh("AA")
+    assert len(journal) == 5       # accumulates — no per-fetch rewrite
+    svc.refresh("AA")
+    assert len(journal) == 6
+    svc.refresh("AA")              # redundancy 3 > 2: compacts
+    assert len(journal) == 4       # back to one snapshot per symbol
+    svc.close()
+
+
 def test_service_bloated_journal_compacts_after_restart(tmp_path):
     """Events replayed at recovery count toward the threshold, so a journal
     bloated by a previous (auto-compaction-off) run shrinks on the first

@@ -503,6 +503,87 @@ class TestEpisodeMode:
         ts2, metrics = jax.jit(agent.step)(ts2)
         assert np.isfinite(float(metrics["loss"]))
 
+    def test_remat_blocks_matches_exact(self):
+        """model.remat_blocks must be numerically a no-op — identical
+        replay outputs AND parameter gradients, only the residual-memory
+        profile changes (the HBM lever for the d>=1024 tier)."""
+        from sharetrade_tpu.agents.rollout import collect_rollout
+
+        _, agent, env = self._setup(num_agents=3)
+        model = agent.model
+        ts = agent.init(jax.random.PRNGKey(0))
+        init_carry = ts.carry
+        ts, traj, _, _ = collect_rollout(model, env, ts, 8, 3)
+
+        _, agent_r, _ = self._setup(num_agents=3, remat_blocks=True)
+        model_r = agent_r.model
+
+        def loss(params, fwd):
+            logits, values, _ = fwd(params, traj.obs, init_carry)
+            return (jnp.sum(jax.nn.log_softmax(logits)[..., 0])
+                    + jnp.sum(jnp.square(values)))
+
+        l_e, v_e, _ = model.apply_unroll(ts.params, traj.obs, init_carry)
+        l_r, v_r, _ = model_r.apply_unroll(ts.params, traj.obs, init_carry)
+        np.testing.assert_allclose(np.asarray(l_r), np.asarray(l_e),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(v_r), np.asarray(v_e),
+                                   atol=1e-5)
+        g_e = jax.grad(loss)(ts.params, model.apply_unroll)
+        g_r = jax.grad(loss)(ts.params, model_r.apply_unroll)
+        for p_e, p_r in zip(jax.tree.leaves(g_e), jax.tree.leaves(g_r)):
+            np.testing.assert_allclose(np.asarray(p_r), np.asarray(p_e),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_episode_pp_b1_pipelines_sequence_chunks(self, cpu_devices,
+                                                     monkeypatch):
+        """The B=1 replay pass pipelines along the SEQUENCE: banded-halo
+        carries stream chunk-to-chunk through the stages, so >1 microbatch
+        is in flight (round-4 weak #4: these passes ran m=1 — a full
+        pipeline bubble), with parity against the unpartitioned forward."""
+        from jax.sharding import Mesh
+        from sharetrade_tpu.models.transformer_episode import (
+            episode_transformer_policy)
+        from sharetrade_tpu.parallel import pipeline as pipeline_mod
+        from sharetrade_tpu.parallel.pipeline import stack_stage_params
+
+        mesh = Mesh(np.array(cpu_devices[:2]).reshape(2), ("pp",))
+        obs_dim = self.WINDOW + 2
+        base = episode_transformer_policy(
+            obs_dim, 3, num_layers=2, num_heads=2, head_dim=16,
+            use_pallas=False)
+        piped = episode_transformer_policy(
+            obs_dim, 3, num_layers=2, num_heads=2, head_dim=16,
+            use_pallas=False, pp_mesh=mesh)
+        params = base.init(jax.random.PRNGKey(3))
+        params_pp = dict(params)
+        params_pp["blocks"] = stack_stage_params(params["blocks"])
+
+        seen_m = []
+        real = pipeline_mod.pipeline_apply
+
+        def spy(stage_fn, sp, mb, *a, **k):
+            seen_m.append(mb.shape[0])
+            return real(stage_fn, sp, mb, *a, **k)
+
+        monkeypatch.setattr(pipeline_mod, "pipeline_apply", spy)
+
+        t_len = 8
+        win = jnp.linspace(10.0, 12.0, self.WINDOW)
+        obs_row = jnp.concatenate(
+            [win, jnp.asarray([20.0, 0.0])])[None]        # (1, obs_dim)
+        obs_t = jnp.broadcast_to(obs_row, (t_len, 1, obs_dim))
+        carry1 = jax.tree.map(lambda x: x[None], base.init_carry())
+
+        l_b, v_b, _ = base.apply_unroll(params, obs_t, carry1)
+        l_p, v_p, _ = piped.apply_unroll(params_pp, obs_t, carry1)
+        np.testing.assert_allclose(np.asarray(l_p), np.asarray(l_b),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(v_p), np.asarray(v_b),
+                                   rtol=2e-4, atol=2e-4)
+        assert seen_m and max(seen_m) > 1, \
+            f"B=1 replay ran a full-bubble pipeline (microbatches: {seen_m})"
+
     @pytest.mark.slow
     def test_episode_pipeline_matches_unpartitioned(self, cpu_devices):
         """Episode × pp: the pipelined banded forward (positions riding the

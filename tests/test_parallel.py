@@ -336,6 +336,50 @@ class TestPartitionedTransformer:
                         num_layers=2)
 
 
+class TestShardedHeal:
+    """Per-agent kill-and-heal UNDER A DP MESH (round-4 verdict #7): the
+    heal's device_get/_place round-trips must compose with donated,
+    sharded buffers — the interaction that can only break sharded. The
+    unsharded twin lives in tests/test_runtime.py TestPerAgentRecovery."""
+
+    def test_kill_and_heal_on_dp_mesh(self, tmp_path, cpu_devices):
+        from sharetrade_tpu.runtime import Orchestrator, ReplyState
+        cfg = tiny_cfg(workers=8)
+        cfg.runtime.chunk_steps = 8   # 4 chunks: poison at 1, detect at 2
+        cfg.parallel.mesh_shape = {"dp": 4}
+        cfg.runtime.checkpoint_dir = str(tmp_path / "ckpts")
+        poisoned = []
+
+        def chaos(chunk_idx, metrics):
+            if chunk_idx == 1 and not poisoned:
+                poisoned.append(1)
+                ts = orch._ts
+                budget = np.asarray(
+                    jax.device_get(ts.env_state.budget)).copy()
+                budget[5] = np.nan       # one row on dp shard 2 corrupted
+                orch._ts = orch._place(ts.replace(
+                    env_state=ts.env_state.replace(
+                        budget=jnp.asarray(budget))))
+
+        mesh = build_mesh(cfg.parallel, devices=cpu_devices[:4])
+        orch = Orchestrator(cfg, mesh=mesh, fault_hook=chaos)
+        prices = np.linspace(10.0, 20.0, 40, dtype=np.float32)  # 32 steps
+        orch.send_training_data(prices)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        # Healed in place on the mesh: no restart, no rollback.
+        assert orch.restarts == 0
+        assert orch.agent_heals == 1
+        snap = orch.snapshot()
+        assert snap["unhealthy_workers"] == 0
+        assert snap["trained_workers"] == 8
+        assert orch.get_avg().ok and np.isfinite(orch.get_avg().value)
+        # The healed state is still dp-sharded (a heal that silently
+        # replicated the batch would "pass" while undoing the mesh).
+        spec = orch.train_state.env_state.budget.sharding.spec
+        assert "dp" in jax.tree.leaves(tuple(spec)), spec
+
+
 @pytest.mark.slow
 class TestPartitionedTrainingEndToEnd:
     """Full PPO training through the Orchestrator with the partitioned

@@ -186,13 +186,32 @@ class TestSupervision:
         cfg = fast_cfg(tmp_path)
 
         def bad(chunk_idx, metrics):
-            raise ValueError("bad input")  # policy: stop (IllegalArgument analogue)
+            from sharetrade_tpu.config import ConfigError
+            raise ConfigError("bad input")  # policy: stop (IllegalArgument analogue)
 
         orch = Orchestrator(cfg, fault_hook=bad)
         orch.send_training_data(PRICES)
         orch.start_training(background=False)
         assert orch.lifecycle.phase is Phase.FAILED
         assert orch.restarts == 0  # stopped, not restarted
+
+    def test_plain_value_error_restarts_not_stops(self, tmp_path):
+        """A transient in-loop ValueError (JAX retrace/shape wobble) takes
+        the RESTART path; only ConfigError maps to STOP — a run must not
+        permanently fail on an error class that healing can fix."""
+        cfg = fast_cfg(tmp_path)
+        hits = []
+
+        def flaky(chunk_idx, metrics):
+            if not hits:
+                hits.append(1)
+                raise ValueError("transient retrace wobble")
+
+        orch = Orchestrator(cfg, fault_hook=flaky)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.restarts == 1   # restarted once, then completed
 
     def test_error_policy_resume(self, tmp_path):
         cfg = fast_cfg(tmp_path)
@@ -458,8 +477,9 @@ class TestFailedPhaseProtocol:
                         "portfolio_std": 0.0}
 
         def chaos(chunk_idx, metrics):
+            from sharetrade_tpu.config import ConfigError
             if chunk_idx >= 2:   # let two chunks land a snapshot first
-                raise ValueError("poisoned")  # policy: stop -> FAILED
+                raise ConfigError("poisoned")  # policy: stop -> FAILED
 
         orch = Orchestrator(cfg, step_override=fake_step, fault_hook=chaos)
         orch.send_training_data(PRICES)
