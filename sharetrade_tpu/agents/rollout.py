@@ -217,8 +217,36 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
 
     step_priced = env.step_priced
 
+    # Linearity-factored head (models/core.py rollout_head_factored): the
+    # whole unroll's trunk→logits/value terms become ONE batched matmul
+    # out here, leaving only a (3 -> A) portfolio contraction inside the
+    # scan — the per-iteration d-sized head GEMMs were the measured d=256
+    # bound once everything else was hoisted (BASELINE.md round 5).
+    factored = model.rollout_head_factored
+    if factored is not None:
+        base_l, base_v, pf_fn = factored(ts.params, hn_base)
+        head_xs = (base_l[:unroll_len], base_v[:unroll_len])
+
+        def head_outs(head_i, obs):
+            base_l_i, base_v_i = head_i
+            d_l, d_v = pf_fn(obs)
+            return base_l_i[None] + d_l, base_v_i + d_v
+
+        final_head = (base_l[unroll_len], base_v[unroll_len])
+    else:
+        head_xs = (hn_base[:unroll_len],)
+
+        def head_outs(head_i, obs):
+            (hn_i,) = head_i
+            outs = model.apply_rollout_head(
+                ts.params,
+                jnp.broadcast_to(hn_i, (num_agents,) + hn_i.shape), obs)
+            return outs.logits, outs.value
+
+        final_head = (hn_base[unroll_len],)
+
     def one_step(env_state, inputs):
-        win_i, price_i, g_i, hn_i = inputs
+        win_i, price_i, g_i, head_i = inputs
         # Assemble the observation from the precomputed (shared) window +
         # the live wallet (the only state-dependent features).
         obs_raw = jnp.concatenate(
@@ -229,11 +257,9 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
         active = ((env_state.t < horizon) & healthy).astype(jnp.float32)
         obs = jnp.where(healthy[:, None], obs_raw, 0.0)
 
-        outs = model.apply_rollout_head(
-            ts.params,
-            jnp.broadcast_to(hn_i, (num_agents,) + hn_i.shape), obs)
-        actions = jnp.argmax(outs.logits + g_i, axis=-1).astype(jnp.int32)
-        log_probs = jax.nn.log_softmax(outs.logits)
+        logits, value = head_outs(head_i, obs)
+        actions = jnp.argmax(logits + g_i, axis=-1).astype(jnp.int32)
+        log_probs = jax.nn.log_softmax(logits)
         # one_hot contraction, not take_along_axis: gathers are scalar-unit
         # dispatches inside a scan.
         logp = jnp.sum(
@@ -250,21 +276,18 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
         rewards = jnp.where(mask, rewards, 0.0)
 
         data = StepData(obs=obs, action=actions, logp=logp,
-                        value=outs.value, reward=rewards, active=active)
+                        value=value, reward=rewards, active=active)
         return new_env, data
 
     env_state, traj = jax.lax.scan(
         one_step, ts.env_state,
-        (windows[:-1], trade_prices, gumbel, hn_base[:unroll_len]))
+        (windows[:-1], trade_prices, gumbel, head_xs))
 
     final_raw = jax.vmap(env.observe)(env_state)
     final_fine = quarantine_mask(final_raw, env_state)
     final_obs = jnp.where(final_fine[:, None], final_raw, 0.0)
-    final_outs = model.apply_rollout_head(
-        ts.params,
-        jnp.broadcast_to(hn_base[unroll_len],
-                         (num_agents,) + hn_base.shape[1:]), final_obs)
-    bootstrap = final_outs.value * (
+    _, final_value = head_outs(final_head, final_obs)
+    bootstrap = final_value * (
         (env_state.t < horizon) & final_fine).astype(jnp.float32)
 
     steps_taken = jnp.sum(jnp.any(traj.active > 0, axis=1)).astype(jnp.int32)
@@ -288,19 +311,33 @@ def greedy_rollout_precomputed(model: Model, env: TradingEnv, params,
         model, env, params, state1, carry1, horizon, horizon)
     step_priced = env.step_priced
 
+    factored = model.rollout_head_factored
+    if factored is not None:   # same hoist as _collect_rollout_precomputed
+        base_l, _, pf_fn = factored(params, hn_base)
+        head_xs = (base_l[:horizon],)
+
+        def head_logits(head_i, obs):
+            return head_i[0][None] + pf_fn(obs)[0]
+    else:
+        head_xs = (hn_base[:horizon],)
+
+        def head_logits(head_i, obs):
+            return model.apply_rollout_head(params, head_i[0][None],
+                                            obs).logits
+
     def one(env_state, inputs):
-        win_i, price_i, hn_i = inputs
+        win_i, price_i, head_i = inputs
         obs = jnp.concatenate(
             [win_i[None], env_state.budget[:, None],
              env_state.shares[:, None]], axis=-1)
-        outs = model.apply_rollout_head(params, hn_i[None], obs)
-        action = jnp.argmax(outs.logits, axis=-1).astype(jnp.int32)
+        logits = head_logits(head_i, obs)
+        action = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         new_state, reward = jax.vmap(
             step_priced, in_axes=(0, 0, None))(env_state, action, price_i)
         return new_state, reward[0]
 
     final, rewards = jax.lax.scan(
-        one, state1, (windows[:-1], trade_prices, hn_base[:horizon]))
+        one, state1, (windows[:-1], trade_prices, head_xs))
     return jax.tree.map(lambda x: x[0], final), rewards
 
 

@@ -94,6 +94,21 @@ class Model:
     # invariant (see agents/rollout.py agent-invariance notes).
     apply_unroll_shared: Callable[[Any, jax.Array, Any],
                                   tuple[jax.Array, jax.Array, jax.Array]] | None = None
+    # Optional LINEARITY-FACTORED rollout head. When the head is affine in
+    # (trunk output, portfolio features) — logits = dense(policy,
+    # hn + dense(port, feats)) with no nonlinearity between — it splits
+    # exactly into a trunk term, precomputable for the WHOLE unroll in one
+    # batched matmul outside the env scan, plus a tiny (3 -> A) portfolio
+    # term evaluated per step. The sequential loop's per-iteration matmuls
+    # drop from three d-sized GEMMs to one 3-wide contraction — the round-4
+    # measured bound at d=256 was exactly those per-iteration head matmuls.
+    #
+    # rollout_head_factored(params, hn_base (T+1, d)) ->
+    #   (base_logits (T+1, A) f32, base_values (T+1,) f32,
+    #    pf_fn(obs (B, obs_dim)) -> (dlogits (B, A) f32, dvalues (B,) f32))
+    # with ModelOut-equivalent totals base + pf (pinned by
+    # tests/test_models.py::test_factored_rollout_head_matches_exact).
+    rollout_head_factored: Callable | None = None
 
 
 def apply_batched(model: Model, params: Any, obs_batch: jax.Array,

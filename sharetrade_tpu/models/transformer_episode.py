@@ -744,6 +744,33 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         values = dense(params["value"], hn).astype(jnp.float32)[..., 0]
         return ModelOut(logits=logits, value=values, aux=jnp.float32(0.0))
 
+    def rollout_head_factored(params, hn_base):
+        """The rollout head with its linearity exploited (models/core.py
+        field doc): dense(policy, hn + dense(port, feats)) ==
+        [dense(policy, hn)] + [feats @ (Wp Wl) + bp Wl]. The first term is
+        one (T+1, d) x (d, A) matmul over the whole unroll's precomputed
+        trunk; the second is a (3 -> A) contraction per step — removing
+        the d-sized per-iteration GEMMs that bound the d=256 flagship
+        scan (BASELINE.md round-5 section). Exact up to float
+        reassociation; the combined matrices are folded in f32."""
+        base_logits = dense(params["policy"],
+                            hn_base.astype(dtype)).astype(jnp.float32)
+        base_values = dense(params["value"],
+                            hn_base.astype(dtype)).astype(jnp.float32)[..., 0]
+        wp = params["port"]["w"].astype(jnp.float32)      # (3, d)
+        bp = params["port"]["b"].astype(jnp.float32)      # (d,)
+        wl = params["policy"]["w"].astype(jnp.float32)    # (d, A)
+        wv = params["value"]["w"].astype(jnp.float32)     # (d, 1)
+        w_pl, b_pl = wp @ wl, bp @ wl                     # (3, A), (A,)
+        w_pv, b_pv = (wp @ wv)[:, 0], (bp @ wv)[0]        # (3,), scalar
+
+        def pf_fn(obs):
+            feats = _port_feats(obs[:, window], obs[:, window + 1],
+                                obs[:, window - 1]).astype(jnp.float32)
+            return feats @ w_pl + b_pl, feats @ w_pv + b_pv
+
+        return base_logits, base_values, pf_fn
+
     def init_carry():
         return {
             "k": jnp.zeros((num_layers, num_heads, window, head_dim), dtype),
@@ -757,5 +784,6 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                  apply_unroll_shared=apply_unroll_shared,
                  apply_rollout_trunk=apply_rollout_trunk,
                  apply_rollout_head=apply_rollout_head,
+                 rollout_head_factored=rollout_head_factored,
                  obs_dim=obs_dim, num_actions=num_actions,
                  name="transformer_episode")

@@ -503,6 +503,33 @@ class TestEpisodeMode:
         ts2, metrics = jax.jit(agent.step)(ts2)
         assert np.isfinite(float(metrics["loss"]))
 
+    def test_factored_rollout_head_matches_exact(self):
+        """rollout_head_factored (trunk terms hoisted, tiny portfolio term
+        in-scan) must equal apply_rollout_head exactly up to float
+        reassociation — the linearity split is algebraic, not an
+        approximation."""
+        _, agent, env = self._setup(num_agents=3)
+        model = agent.model
+        params = model.init(jax.random.PRNGKey(7))
+        t_len, bsz, d = 5, 3, model.num_actions
+        key = jax.random.PRNGKey(8)
+        hn_base = jax.random.normal(key, (t_len + 1, 32))  # d_model=2*16
+        base_l, base_v, pf_fn = model.rollout_head_factored(params, hn_base)
+        assert base_l.shape == (t_len + 1, d)
+        assert base_v.shape == (t_len + 1,)
+        obs = jnp.abs(jax.random.normal(
+            jax.random.PRNGKey(9), (bsz, model.obs_dim))) * 30.0 + 1.0
+        for i in range(t_len + 1):
+            exact = model.apply_rollout_head(
+                params, jnp.broadcast_to(hn_base[i], (bsz, 32)), obs)
+            d_l, d_v = pf_fn(obs)
+            np.testing.assert_allclose(
+                np.asarray(base_l[i][None] + d_l), np.asarray(exact.logits),
+                rtol=1e-5, atol=1e-5, err_msg=f"row {i} logits")
+            np.testing.assert_allclose(
+                np.asarray(base_v[i] + d_v), np.asarray(exact.value),
+                rtol=1e-5, atol=1e-5, err_msg=f"row {i} value")
+
     def test_remat_blocks_matches_exact(self):
         """model.remat_blocks must be numerically a no-op — identical
         replay outputs AND parameter gradients, only the residual-memory
