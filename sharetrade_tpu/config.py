@@ -123,8 +123,8 @@ class ModelConfig:
     # of storing them — O(L·S·d) residuals drop to the block boundaries,
     # the HBM lever for the d>=1024 tier's long replays. Finer than
     # learner.remat (which checkpoints the whole replay pass); composes
-    # with it. Ignored under pipeline_blocks (each pp stage already holds
-    # only its own block's residuals).
+    # with it, and with pipeline_blocks (each stage then stores only its
+    # schedule-tick boundary states).
     remat_blocks: bool = False
 
 

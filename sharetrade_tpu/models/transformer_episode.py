@@ -331,6 +331,12 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                 [xb.astype(jnp.float32), st[..., d_model:]], axis=-1)
             return out, {"k": k_t, "v": v_t, "aux": aux}
 
+        if remat_blocks:
+            # Per-(stage, tick) remat: the backward recomputes the block's
+            # internals from the tick's input state, so a stage stores only
+            # its schedule-tick boundaries.
+            stage_fn = jax.checkpoint(stage_fn)
+
         # Side templates use the per-device LOCAL batch shape; the K/V
         # sides declare the batch axis in their specs so each dp shard
         # contributes its own rows (a replicated spec would silently hand
@@ -450,6 +456,9 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
             out_st = jnp.concatenate(
                 [xb.astype(jnp.float32), st[..., d_model:]], axis=-1)
             return out_st, side, new_carry
+
+        if remat_blocks:
+            stage_fn = jax.checkpoint(stage_fn)
 
         side_template = {
             "k": jnp.zeros((b_loc, num_heads, window, head_dim), dtype),
