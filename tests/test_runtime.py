@@ -89,6 +89,41 @@ class TestQueriesPerPhase:
 
 
 class TestSampledMetrics:
+    def test_fault_detected_at_next_sample_within_bound(self, tmp_path):
+        """The sampled-metrics contract (config.RuntimeConfig
+        .metrics_every_chunks): a persistent fault (non-finite loss)
+        surfacing on an UNSAMPLED chunk is not seen there — the fast path
+        materializes nothing — but MUST be caught at the next sample,
+        bounding detection latency at metrics_every_chunks chunks; the
+        run then restores and completes."""
+        cfg = fast_cfg(tmp_path)
+        cfg.runtime.metrics_every_chunks = 3
+        calls, restarts_seen = [], []
+
+        def fake_step(ts):
+            calls.append(1)
+            restarts_seen.append(orch.restarts)
+            n = len(calls)
+            # Persistent poison from call 2 until the restore (detection
+            # at the call-3 sample bounds it); finite again afterwards.
+            loss = float("nan") if 2 <= n <= 3 else 0.1
+            return ts, {"env_steps": float(min(16 * n, 64)),
+                        "updates": float(n), "loss": loss,
+                        "portfolio_mean": 10.0, "portfolio_std": 0.0,
+                        "trained_workers": 4.0, "unhealthy_workers": 0.0}
+
+        orch = Orchestrator(cfg, step_override=fake_step)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.restarts == 1, "non-finite loss was never detected"
+        # Calls 1-3 all ran BEFORE the restart: the poisoned call-2 chunk
+        # was dispatched on the fast path (undetected there — with
+        # metrics_every_chunks=1 the restart would land before call 3),
+        # and the call-3 sample caught it.
+        assert restarts_seen[2] == 0
+        assert restarts_seen[-1] == 1
+
     def test_completion_exact_with_sampling_coarser_than_run(self, tmp_path):
         """The sampled-metrics fast path (metrics_every_chunks > run
         length): chunks dispatch with NO host materialization between
