@@ -103,6 +103,10 @@ class Orchestrator:
         self._eval_fn = None   # cached jitted greedy-eval program
         self._snapshot: dict[str, float] = {}
         self._snapshot_lock = threading.Lock()
+        # Guards the donated step dispatch vs concurrent _ts readers
+        # (evaluate()'s snapshot): held only across the non-blocking
+        # dispatch + reassignment, never across device execution.
+        self._step_lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self.restarts = 0
@@ -181,6 +185,23 @@ class Orchestrator:
             raw = (int(saved_episode) if saved_episode is not None
                    else int(state.env_steps) // horizon)
             self.episode = max(0, min(raw, self.cfg.runtime.episodes - 1))
+            if (int(np.min(np.asarray(state.env_state.t))) >= horizon
+                    and int(state.env_steps)
+                    < (self.episode + 1) * horizon):
+                # Resumed the final checkpoint of a COMPLETED episode while
+                # the config asks for more passes (runtime.episodes raised):
+                # every cursor is frozen at the horizon, so without a
+                # re-arm the run would spin chunks forever waiting for a
+                # completion threshold frozen agents can never advance
+                # toward. Re-arm the next episode in place — fresh env
+                # cursors/carry, learned params/opt/env_steps kept (the
+                # Initialise→Train cycle, TrainerChildActor.scala:57-59).
+                # (If heals inflated env_steps past the threshold instead,
+                # the normal completion gate re-arms on the first chunk.)
+                log.info("resumed a completed episode with episodes=%d; "
+                         "re-arming episode %d",
+                         self.cfg.runtime.episodes, self.episode)
+                self._reset_episode()
             log.info("resumed from checkpoint step=%d "
                      "(env cursor %d, %d updates, episode %d)", step,
                      int(state.env_state.t[0]), int(state.updates),
@@ -230,7 +251,20 @@ class Orchestrator:
                 param_rules=rules)
         else:
             self._place = lambda ts: ts
-            self._step_fn = jax.jit(self.agent.step)
+            # Donated input, matching the mesh path: the previous chunk's
+            # TrainState is dead the moment the next step executes, halving
+            # the state's HBM footprint (matters at the d>=1024 tier:
+            # params+opt+replay double-buffered otherwise). Failure paths
+            # are covered — _ensure_live_state restores when a raise leaves
+            # donated-dead buffers behind, and save_async snapshots to host
+            # before the next chunk can free them. Known trade (same as the
+            # mesh path has always made): a RESUME-verb error raised from
+            # INSIDE the step can no longer resume-in-place — the input was
+            # donated — so it recovers via checkpoint restore, losing at
+            # most checkpoint_every_updates updates instead of none (the
+            # bound holds from chunk 0: _run_supervised writes a baseline
+            # checkpoint before the first chunk).
+            self._step_fn = jax.jit(self.agent.step, donate_argnums=0)
 
     # ------------------------------------------------------------------
     # protocol: StartTraining (TrainerRouterActor.scala:86-88)
@@ -290,6 +324,19 @@ class Orchestrator:
                          else max(1, rt.metrics_every_chunks))
         timer = StepTimer(rt.chunk_steps, self.cfg.parallel.num_workers)
         self.tracer.start()
+        # Baseline checkpoint before the first chunk (async; skipped when
+        # one already exists or checkpointing is off): with donated step
+        # inputs, a failure INSIDE a step can never resume in place — it
+        # restores from the latest checkpoint — and without this save the
+        # pre-first-cadence window would restore-to-nothing and silently
+        # reinitialize, discarding warm-start/resume state. This makes the
+        # "lose at most checkpoint_every_updates updates" bound true from
+        # chunk 0.
+        if (rt.checkpoint_every_updates > 0
+                and self.checkpoints.latest_step() is None):
+            self.checkpoints.save_async(
+                int(jax.device_get(self._ts.updates)), self._ts,
+                metadata={"episode": self.episode})
         timer.tick()
         last_env_steps: int | None = None
         chunks_since = 0
@@ -299,10 +346,15 @@ class Orchestrator:
                     last_env_steps = int(jax.device_get(self._ts.env_steps))
                     chunks_since = 0
                 with self.tracer.span(f"train_chunk_{chunk_idx}"):
-                    ts, metrics = self._step_fn(self._ts)
-                # Commit the new state BEFORE any hook can raise: the mesh
-                # step donates its input, so the old state is already dead.
-                self._ts = ts
+                    # The step lock fences evaluate()'s state snapshot from
+                    # this donating dispatch; dispatch is non-blocking so
+                    # the lock is held microseconds, not the chunk.
+                    with self._step_lock:
+                        ts, metrics = self._step_fn(self._ts)
+                        # Commit the new state BEFORE any hook can raise:
+                        # both step paths donate their input, so the old
+                        # state is already dead.
+                        self._ts = ts
                 transitions = metrics.pop("transitions", None)
                 chunks_since += 1
                 threshold = horizon * (self.episode + 1)
@@ -741,13 +793,22 @@ class Orchestrator:
         without retention the collapsed policy is what a user ships."""
         if self.agent is None or self._ts is None:
             raise RuntimeError("no training data / state")
-        result = self._evaluate_params(self._ts.params)
+        # Snapshot the state under the step lock: both step paths donate
+        # their input, so an external evaluate() racing the training
+        # thread's next dispatch could otherwise read donated-dead buffers
+        # ("Array has been deleted"). While the lock is held no donating
+        # dispatch can be enqueued, and the copies dispatched here hold
+        # their own buffers afterwards.
+        with self._step_lock:
+            ts = jax.tree.map(
+                lambda x: jnp.copy(x) if hasattr(x, "devices") else x,
+                self._ts)
+        result = self._evaluate_params(ts.params)
         # The greedy-eval curve lands in the event log so learning progress
         # is auditable after the run (the reference's only observable is the
         # final avg, ShareTradeHelper.scala:46; this is the per-policy
         # learning signal it never records).
-        self.events.emit("evaluation", updates=int(self._ts.updates),
-                         **result)
+        self.events.emit("evaluation", updates=int(ts.updates), **result)
         if self.cfg.runtime.keep_best_eval:
             # Locked check-then-act: the training thread's periodic eval
             # (runtime.eval_every_updates) and a caller thread's explicit
@@ -761,13 +822,13 @@ class Orchestrator:
                 if result["eval_portfolio"] > self._best_eval:
                     self._best_eval = result["eval_portfolio"]
                     self.checkpoints.save_tagged(
-                        "best", self._ts,
+                        "best", ts,
                         metadata={"eval_portfolio": result["eval_portfolio"],
-                                  "updates": int(self._ts.updates)})
+                                  "updates": int(ts.updates)})
                     self.events.emit(
                         "best_eval_retained",
                         eval_portfolio=result["eval_portfolio"],
-                        updates=int(self._ts.updates))
+                        updates=int(ts.updates))
         return result
 
     def evaluate_best(self) -> dict[str, float]:

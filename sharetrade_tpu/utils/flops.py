@@ -133,12 +133,17 @@ def _episode_mode_flops_per_agent_step(cfg: FrameworkConfig,
 
         rollout trunk:  (S+1)/T tokens / B agents (agents/rollout.py
                         precomputed path)
-        rollout head:   1 tiny head (port + policy + value projections)
+        rollout head:   FACTORED (round 5, rollout_head_factored): the
+                        d-sized policy/value projections run ONCE over the
+                        representative's T+1 trunk rows (shared /B), and
+                        the per-agent-step residue is the 3-wide portfolio
+                        contraction
         replay trunk:   epochs x minibatches x 3 (fwd+bwd) x S/T tokens / B
                         (apply_unroll_shared: one trunk per minibatch PASS,
                         not per agent — each pass re-runs it because the
                         params just changed)
-        replay heads:   epochs x 3 per agent-step
+        replay heads:   epochs x 3 per agent-step (the replay head is NOT
+                        factored — its gradients need the d-sized path)
 
     MFU computed from this is hardware utilization of the executed matmuls;
     the pre-round-4 convention counted the per-agent replay trunks the
@@ -163,8 +168,12 @@ def _episode_mode_flops_per_agent_step(cfg: FrameworkConfig,
         passes = epochs * mb_count
     else:
         epochs, passes = 1, 1
+    # Factored rollout head: shared base projections over T+1 trunk rows
+    # plus the per-step 3-wide portfolio term (policy+value: A+1 outputs).
+    head_base = 2.0 * d * (model.num_actions + 1) * (t + 1) / t / b
+    head_pf_step = 2.0 * 3 * (model.num_actions + 1)
     return (per_token * (s + 1) / t / b           # rollout trunk (shared)
-            + per_head                             # per-step rollout head
+            + head_base + head_pf_step             # factored rollout head
             + per_token * passes * 3.0 * s / t / b  # replay trunks (shared)
             + per_head * epochs * 3.0)             # per-agent replay heads
 

@@ -355,6 +355,31 @@ class TestPerAgentRecovery:
         assert resumed.is_everything_done().state is ReplyState.COMPLETED
         assert resumed.get_avg().value == pytest.approx(avg, rel=1e-6)
 
+    def test_resume_completed_run_with_more_episodes_rearms(self, tmp_path):
+        """Resuming a completed run with runtime.episodes RAISED must
+        re-arm the next episode (fresh cursors, learned params kept) and
+        actually train it — without the re-arm, every cursor sits frozen
+        at the horizon and the chunk loop spins forever toward a
+        completion threshold nothing advances (pre-existing bug found in
+        round 5: reproduced on the round-4 tree)."""
+        cfg = fast_cfg(tmp_path)
+        orch = run_end_to_end(cfg, PRICES)
+        horizon = len(PRICES) - WINDOW
+        assert int(orch.train_state.env_steps) == horizon
+        updates_before = int(orch.train_state.updates)
+
+        more = fast_cfg(tmp_path)
+        more.runtime.episodes = 2
+        resumed = Orchestrator(more)
+        resumed.send_training_data(PRICES, resume=True)
+        resumed.start_training(background=True)
+        assert resumed.wait(180), "resumed run never completed episode 2"
+        assert resumed.is_everything_done().state is ReplyState.COMPLETED
+        # Episode 2 genuinely trained: cumulative steps doubled, learned
+        # updates carried over and extended.
+        assert int(resumed.train_state.env_steps) == 2 * horizon
+        assert int(resumed.train_state.updates) > updates_before
+
     def test_recovery_disabled_completes_without_stranded_agent(self, tmp_path):
         """With partial_recovery=False a quarantined row can never respawn;
         the run must still COMPLETE (the stranded row counts as excluded)
