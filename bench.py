@@ -45,20 +45,29 @@ REFERENCE_CEILING_STEPS_PER_S = 58_450 / 1_005.0  # ≈58.2, derivation above
 
 
 def bench_episode_config(config_name: str, metric: str, *,
-                         reps: int = 2) -> dict:
+                         reps: int = 2, length: int | None = None) -> dict:
     """Time one of the canonical episode-mode PPO configs from
     benchmarks/run_all.py (so bench.py and the ladder can never silently
     measure different workloads): chunks repeat on fresh inits whenever the
-    next chunk would outrun the horizon, so every timed step is live."""
+    next chunk would outrun the horizon, so every timed step is live.
+    ``length`` shrinks the series for same-series comparisons
+    (benchmarks/orchestrator_throughput.py smoke mode); None uses the
+    config's own fixture length (DataConfig.synthetic_length)."""
     from benchmarks.run_all import make_configs
     cfg = make_configs()[config_name]
 
-    series = synthetic_price_series(length=6046)
+    series = synthetic_price_series(
+        length=cfg.data.synthetic_length if length is None else length)
     env_params = trading.env_from_prices(
         series.prices, window=cfg.env.window,
         initial_budget=cfg.env.initial_budget)
     horizon = trading.num_steps(env_params)
     chunks_per_run = horizon // cfg.runtime.chunk_steps   # live chunks
+    if chunks_per_run < 1:
+        raise ValueError(
+            f"series horizon {horizon} is shorter than one chunk "
+            f"({cfg.runtime.chunk_steps} steps) for {config_name}; "
+            "use a longer series (--length)")
 
     agent = build_agent(cfg, env_params)
     step = jax.jit(agent.step)      # no donation: re-inits reuse the shape

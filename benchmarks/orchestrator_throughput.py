@@ -37,8 +37,10 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="ppo_tr_episode_b128_u1024_bf16")
     ap.add_argument("--episodes", type=int, default=4)
-    ap.add_argument("--length", type=int, default=6046,
-                    help="price series length (shrink for smoke tests)")
+    ap.add_argument("--length", type=int, default=None,
+                    help="price series length (shrink for smoke tests; "
+                         "default: the config's DataConfig.synthetic_length"
+                         " — must exceed window + chunk_steps)")
     ap.add_argument("--skip-raw", action="store_true",
                     help="skip the raw-loop comparison row")
     args = ap.parse_args()
@@ -51,7 +53,9 @@ def main() -> None:
 
     cfg = make_configs()[args.config]
     cfg.runtime.episodes = args.episodes
-    series = synthetic_price_series(length=args.length)
+    length = (cfg.data.synthetic_length if args.length is None
+              else args.length)
+    series = synthetic_price_series(length=length)
 
     workdir = tempfile.mkdtemp(prefix="orch_bench_")
     os.chdir(workdir)
@@ -88,7 +92,8 @@ def main() -> None:
     }
     if not args.skip_raw:
         raw = bench_episode_config(
-            args.config, f"raw_{args.config}_agent_steps_per_sec", reps=2)
+            args.config, f"raw_{args.config}_agent_steps_per_sec", reps=2,
+            length=length)
         out["raw_loop"] = raw["value"]
         out["orchestrator_over_raw"] = round(orch_rate / raw["value"], 3)
     print(json.dumps(out), flush=True)
