@@ -18,6 +18,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from sharetrade_tpu.config import ConfigError
+
 from sharetrade_tpu.models.core import (
     Model, ModelOut, dense, dense_init, portfolio_features,
     tick_window_features)
@@ -61,10 +63,10 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
     this degenerates EXACTLY to the single-asset layout (same parameters,
     same sequence), so checkpoints stay compatible."""
     if num_assets < 1:
-        raise ValueError(f"num_assets must be >= 1, got {num_assets}")
+        raise ConfigError(f"num_assets must be >= 1, got {num_assets}")
     window = (obs_dim - 1 - num_assets) // num_assets
     if num_assets * window + 1 + num_assets != obs_dim:
-        raise ValueError(
+        raise ConfigError(
             f"obs_dim={obs_dim} does not match the {num_assets}-asset "
             f"portfolio layout (A*window + 1 + A)")
     seq_len = num_assets * (window + 1)
@@ -73,11 +75,11 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         attention_fn = lambda q, k, v: flash_attention(  # noqa: E731
             q, k, v, causal=True, use_pallas=use_pallas)
     if pp_mesh is not None and pp_mesh.shape[pp_axis] != num_layers:
-        raise ValueError(
+        raise ConfigError(
             f"pipeline_blocks needs num_layers == pp size "
             f"({num_layers} != {pp_mesh.shape[pp_axis]})")
     if moe_experts and pp_mesh is not None:
-        raise ValueError("pipeline_blocks + moe_experts is unsupported "
+        raise ConfigError("pipeline_blocks + moe_experts is unsupported "
                          "(nested shard_maps); pick one partitioning")
 
     def init(key):

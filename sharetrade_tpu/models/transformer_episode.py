@@ -376,7 +376,9 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         GPipe bubble (stages-1)/(m+stages-1); 4*stages chunks put it under
         ~20% with diminishing returns beyond."""
         halo = window - 1
-        for m in range(min(s_len // max(halo, 1), 4 * num_layers), 1, -1):
+        if halo < 1:
+            return None   # window=1: no band to carry, nothing to pipeline
+        for m in range(min(s_len // halo, 4 * num_layers), 1, -1):
             chunk_len = -(-s_len // m)
             pad = m * chunk_len - s_len
             if chunk_len >= halo and chunk_len - 1 - kv_offset - pad >= 0:
@@ -639,6 +641,17 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         return (logits[:, q_pos].swapaxes(0, 1),
                 values[:, q_pos].swapaxes(0, 1), aux)
 
+    def _head_fold(params):
+        """The (3 -> A)/(3 -> 1) folded portfolio-head matrices of the
+        factored head (f32): shared by rollout_head_factored AND the
+        shared replay so their op order — and thus their bf16 rounding —
+        can never diverge. Differentiable (the folds stay in the graph)."""
+        wp = params["port"]["w"].astype(jnp.float32)      # (3, d)
+        bp = params["port"]["b"].astype(jnp.float32)      # (d,)
+        wl = params["policy"]["w"].astype(jnp.float32)    # (d, A)
+        wv = params["value"]["w"].astype(jnp.float32)     # (d, 1)
+        return wp @ wl, bp @ wl, (wp @ wv)[:, 0], (bp @ wv)[0]
+
     def apply_unroll_shared(params, obs, carry):
         """Training replay with the trunk's factor-B agent redundancy
         removed: every healthy agent's price series is IDENTICAL (the
@@ -689,13 +702,21 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
             params, series, positions, port)
         q_pos = hist_len + window - 1 + jnp.arange(t_len)
         hn_q = hn[0, q_pos]                             # (T, d)
-        # Per-agent head: the only part of the forward the wallet touches.
+        # Per-agent head, in the same FACTORED form as the rollout's
+        # (rollout_head_factored): base projections over the T shared
+        # trunk rows + the 3-wide portfolio term per agent-step. Keeping
+        # the op order identical to the rollout head makes stored logp and
+        # replayed logp agree to rounding even at bf16 (split forms
+        # diverge by ~bf16 eps, which would bias the PPO ratios at epoch
+        # 1), and drops the replay's per-agent d-sized head GEMMs.
+        base_l = dense(params["policy"], hn_q).astype(jnp.float32)  # (T, A)
+        base_v = dense(params["value"], hn_q).astype(jnp.float32)[..., 0]
+        w_pl, b_pl, w_pv, b_pv = _head_fold(params)
         anchor = obs[:, :, window - 1]                  # (T, B)
-        feats = _port_feats(obs[:, :, window], obs[:, :, window + 1], anchor)
-        hn_port = (hn_q[:, None, :]
-                   + dense(params["port"], feats.astype(dtype)))
-        logits = dense(params["policy"], hn_port).astype(jnp.float32)
-        values = dense(params["value"], hn_port).astype(jnp.float32)[..., 0]
+        feats = _port_feats(obs[:, :, window], obs[:, :, window + 1],
+                            anchor).astype(jnp.float32)
+        logits = base_l[:, None] + feats @ w_pl + b_pl
+        values = base_v[:, None] + feats @ w_pv + b_pv
         return logits, values, aux
 
     def apply_rollout_trunk(params, obs, future_ticks, carry):
@@ -766,12 +787,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                             hn_base.astype(dtype)).astype(jnp.float32)
         base_values = dense(params["value"],
                             hn_base.astype(dtype)).astype(jnp.float32)[..., 0]
-        wp = params["port"]["w"].astype(jnp.float32)      # (3, d)
-        bp = params["port"]["b"].astype(jnp.float32)      # (d,)
-        wl = params["policy"]["w"].astype(jnp.float32)    # (d, A)
-        wv = params["value"]["w"].astype(jnp.float32)     # (d, 1)
-        w_pl, b_pl = wp @ wl, bp @ wl                     # (3, A), (A,)
-        w_pv, b_pv = (wp @ wv)[:, 0], (bp @ wv)[0]        # (3,), scalar
+        w_pl, b_pl, w_pv, b_pv = _head_fold(params)
 
         def pf_fn(obs):
             feats = _port_feats(obs[:, window], obs[:, window + 1],

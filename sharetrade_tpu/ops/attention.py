@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from sharetrade_tpu.config import ConfigError
+
 BLOCK_Q = 128
 BLOCK_K = 128
 LANE = 128
@@ -68,7 +70,7 @@ def reference_attention(q, k, v, *, causal: bool = True, sm_scale: float | None 
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     if local_window is not None and not causal:
-        raise ValueError("local_window requires causal attention")
+        raise ConfigError("local_window requires causal attention")
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * sm_scale
     if causal:
@@ -186,7 +188,7 @@ def _flash_forward(q, k, v, causal, sm_scale, local_window, interpret):
     if causal and kv_len != seq_len:
         # Causal alignment between unequal q/kv lengths is ambiguous
         # (prefix vs suffix); refuse rather than guess.
-        raise ValueError(
+        raise ConfigError(
             f"causal attention requires q_len == kv_len, got {seq_len} vs {kv_len}")
 
     qp, kp, vp, d_pad = _pad_inputs(q, k, v)
@@ -747,14 +749,14 @@ def flash_attention(q, k, v, *, causal: bool = True,
     separately — tests/test_ops.py — so both paths stay covered).
     """
     if q.ndim != 4:
-        raise ValueError(f"expected (batch, heads, seq, head_dim), got {q.shape}")
+        raise ConfigError(f"expected (batch, heads, seq, head_dim), got {q.shape}")
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     if local_window is not None:
         if not causal:
-            raise ValueError("local_window requires causal attention")
+            raise ConfigError("local_window requires causal attention")
         if local_window < 1:
-            raise ValueError(f"local_window must be >= 1, got {local_window}")
+            raise ConfigError(f"local_window must be >= 1, got {local_window}")
         if local_window >= q.shape[2]:
             local_window = None    # band covers everything: plain causal
     if use_pallas is None:

@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from sharetrade_tpu.config import ConfigError
+
 from sharetrade_tpu.ops.attention import flash_attention
 
 
@@ -63,7 +65,7 @@ def halo_banded_attention_sharded(mesh: Mesh, *, seq_axis: str = "sp",
             widths = [(0, 0), (0, 0), (0, pad), (0, 0)]
             q, k, v = (jnp.pad(x, widths) for x in (q, k, v))
         if (seq + pad) // n < window - 1:
-            raise ValueError(
+            raise ConfigError(
                 f"sp shard length {(seq + pad) // n} < window-1 "
                 f"({window - 1}); the halo band would span multiple shards "
                 f"— use fewer sp shards or longer unrolls")

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from sharetrade_tpu.config import ConfigError
+
 
 def init_moe_params(key: jax.Array, num_experts: int, in_dim: int,
                     hidden_dim: int, *, dtype=jnp.float32) -> dict:
@@ -216,7 +218,7 @@ def moe_apply_topk_sharded(params: dict, tokens: jax.Array, mesh: Mesh,
     num_experts = params["gate"].shape[-1]
     ep = mesh.shape[axis]
     if num_experts % ep != 0:
-        raise ValueError(f"num_experts={num_experts} not divisible by "
+        raise ConfigError(f"num_experts={num_experts} not divisible by "
                          f"{axis}={ep}")
     if batch_axis is not None and tokens.shape[0] % mesh.shape[batch_axis]:
         batch_axis = None   # odd token count: fall back to replication
@@ -281,10 +283,10 @@ def moe_apply_topk_a2a(params: dict, tokens: jax.Array, mesh: Mesh,
     num_experts = params["gate"].shape[-1]
     ep = mesh.shape[axis]
     if num_experts % ep != 0:
-        raise ValueError(f"num_experts={num_experts} not divisible by "
+        raise ConfigError(f"num_experts={num_experts} not divisible by "
                          f"{axis}={ep}")
     if tokens.shape[0] % ep != 0:
-        raise ValueError(f"token count {tokens.shape[0]} not divisible by "
+        raise ConfigError(f"token count {tokens.shape[0]} not divisible by "
                          f"{axis}={ep} (a2a dispatch shards tokens)")
     n_local = tokens.shape[0] // ep
     local_e = num_experts // ep
@@ -346,7 +348,7 @@ def moe_apply_sharded(params: dict, tokens: jax.Array, mesh: Mesh,
     num_experts = params["gate"].shape[-1]
     ep = mesh.shape[axis]
     if num_experts % ep != 0:
-        raise ValueError(f"num_experts={num_experts} not divisible by "
+        raise ConfigError(f"num_experts={num_experts} not divisible by "
                          f"{axis}={ep}")
     if batch_axis is not None and tokens.shape[0] % mesh.shape[batch_axis]:
         batch_axis = None   # odd token count: fall back to replication

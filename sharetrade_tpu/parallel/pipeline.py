@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from sharetrade_tpu.config import ConfigError
+
 
 def pipeline_apply(stage_fn, stage_params, microbatches, mesh: Mesh,
                    *, axis: str = "pp", mb_spec: P = P(),
@@ -67,9 +69,9 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh: Mesh,
     num_stages = mesh.shape[axis]
     num_micro = microbatches.shape[0]
     if axis in jax.tree.leaves(tuple(mb_spec)):
-        raise ValueError(f"mb_spec {mb_spec} must not shard over {axis!r}")
+        raise ConfigError(f"mb_spec {mb_spec} must not shard over {axis!r}")
     if carry_template is not None and side_template is None:
-        raise ValueError("carry_template requires side_template "
+        raise ConfigError("carry_template requires side_template "
                          "(stage_fn returns (x, side, carry))")
 
     def local_fn(params_local, mb_local):

@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sharetrade_tpu.config import ConfigError
+
 _NEG_INF = -1e30
 
 
@@ -76,7 +78,7 @@ def ring_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "sp",
         sm_scale = q.shape[-1] ** -0.5
     num_shards = mesh.shape[seq_axis]
     if q.shape[2] % num_shards != 0:
-        raise ValueError(
+        raise ConfigError(
             f"seq len {q.shape[2]} not divisible by {seq_axis}={num_shards}")
     local_len = q.shape[2] // num_shards
 
@@ -123,7 +125,7 @@ def ring_attention_padded(q, k, v, mesh: Mesh, *, seq_axis: str = "sp",
     row, so no real output attends to padding; padded QUERY rows produce
     garbage that is sliced off."""
     if not causal:
-        raise ValueError("ring_attention_padded requires causal=True "
+        raise ConfigError("ring_attention_padded requires causal=True "
                          "(non-causal padding would attend to zero tokens)")
     if batch_axis is not None and q.shape[0] % mesh.shape[batch_axis]:
         batch_axis = None   # odd batch (e.g. eval's batch-1): replicate it

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from sharetrade_tpu.config import ConfigError
+
 from sharetrade_tpu.ops.attention import flash_attention
 
 
@@ -42,12 +44,12 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "sp",
     num_shards = mesh.shape[seq_axis]
     heads, seq = q.shape[1], q.shape[2]
     if heads % num_shards != 0:
-        raise ValueError(
+        raise ConfigError(
             f"ulysses needs heads divisible by {seq_axis}: "
             f"{heads} % {num_shards} != 0 (use ring attention for rings "
             f"wider than the head count)")
     if seq % num_shards != 0:
-        raise ValueError(
+        raise ConfigError(
             f"seq len {seq} not divisible by {seq_axis}={num_shards}")
 
     def local_fn(q_loc, k_loc, v_loc):
@@ -83,7 +85,7 @@ def ulysses_attention_padded(q, k, v, mesh: Mesh, *, seq_axis: str = "sp",
     ring_attention_padded: padded KEY positions sit strictly after every real
     query's row, padded QUERY rows are sliced off."""
     if not causal:
-        raise ValueError("ulysses_attention_padded requires causal=True "
+        raise ConfigError("ulysses_attention_padded requires causal=True "
                          "(non-causal padding would attend to zero tokens)")
     if batch_axis is not None and q.shape[0] % mesh.shape[batch_axis]:
         batch_axis = None   # odd batch (e.g. eval's batch-1): replicate it

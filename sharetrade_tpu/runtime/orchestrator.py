@@ -185,19 +185,27 @@ class Orchestrator:
             raw = (int(saved_episode) if saved_episode is not None
                    else int(state.env_steps) // horizon)
             self.episode = max(0, min(raw, self.cfg.runtime.episodes - 1))
-            if (int(np.min(np.asarray(state.env_state.t))) >= horizon
-                    and int(state.env_steps)
+            from sharetrade_tpu.agents.base import agent_health
+            ok = np.asarray(jax.device_get(agent_health(state.env_state)))
+            t = np.asarray(state.env_state.t)
+            # HEALTHY cursors only: a run completed via the stranded-rows-
+            # excluded gate (partial_recovery off) carries a quarantined
+            # row frozen BELOW the horizon; counting it would skip the
+            # re-arm and reintroduce the spin for exactly that resume.
+            done_cursors = bool(ok.any()) and int(np.min(t[ok])) >= horizon
+            if (done_cursors and int(state.env_steps)
                     < (self.episode + 1) * horizon):
                 # Resumed the final checkpoint of a COMPLETED episode while
                 # the config asks for more passes (runtime.episodes raised):
-                # every cursor is frozen at the horizon, so without a
+                # every live cursor is frozen at the horizon, so without a
                 # re-arm the run would spin chunks forever waiting for a
                 # completion threshold frozen agents can never advance
                 # toward. Re-arm the next episode in place — fresh env
-                # cursors/carry, learned params/opt/env_steps kept (the
-                # Initialise→Train cycle, TrainerChildActor.scala:57-59).
-                # (If heals inflated env_steps past the threshold instead,
-                # the normal completion gate re-arms on the first chunk.)
+                # cursors/carry (which also respawns any stranded row),
+                # learned params/opt/env_steps kept (the Initialise→Train
+                # cycle, TrainerChildActor.scala:57-59). (If heals inflated
+                # env_steps past the threshold instead, the normal
+                # completion gate re-arms on the first chunk.)
                 log.info("resumed a completed episode with episodes=%d; "
                          "re-arming episode %d",
                          self.cfg.runtime.episodes, self.episode)
@@ -492,6 +500,7 @@ class Orchestrator:
                 if verb == RESUME:
                     log.warning("resuming after %r (policy: resume)", exc)
                     self._ensure_live_state()
+                    timer.rebase()   # exclude the failed chunk's time
                     continue
                 if verb == STOP:
                     self.lifecycle.force(Phase.FAILED)
@@ -517,6 +526,9 @@ class Orchestrator:
                 if self._stop.wait(delay):
                     return
                 self._restore_or_reinit()
+                # Exclude the failed chunk + backoff + restore from the
+                # next throughput sample.
+                timer.rebase()
 
     def _reset_episode(self) -> None:
         """Fresh env cursors/carry/RNG for the next episode; parameters,
@@ -793,16 +805,11 @@ class Orchestrator:
         without retention the collapsed policy is what a user ships."""
         if self.agent is None or self._ts is None:
             raise RuntimeError("no training data / state")
-        # Snapshot the state under the step lock: both step paths donate
-        # their input, so an external evaluate() racing the training
-        # thread's next dispatch could otherwise read donated-dead buffers
-        # ("Array has been deleted"). While the lock is held no donating
-        # dispatch can be enqueued, and the copies dispatched here hold
-        # their own buffers afterwards.
-        with self._step_lock:
-            ts = jax.tree.map(
-                lambda x: jnp.copy(x) if hasattr(x, "devices") else x,
-                self._ts)
+        # Snapshot the state under the step lock (_snapshot_ts): both step
+        # paths donate their input, so an external evaluate() racing the
+        # training thread's next dispatch could otherwise read donated-dead
+        # buffers ("Array has been deleted").
+        ts = self._snapshot_ts()
         result = self._evaluate_params(ts.params)
         # The greedy-eval curve lands in the event log so learning progress
         # is auditable after the run (the reference's only observable is the
@@ -920,9 +927,33 @@ class Orchestrator:
             self._transitions_journal.close()
             self._transitions_journal = None
 
+    def _snapshot_ts(self) -> TrainState:
+        """Copy the live TrainState under the step lock. Both step paths
+        DONATE their input, so any reader racing the training thread's
+        next dispatch could observe freed buffers; while the lock is held
+        no donating dispatch can be enqueued, and the copies own their
+        buffers afterwards. Raises when the state is mid-recovery (a
+        failed donated step left dead buffers behind) — the caller should
+        retry after the supervision path restores."""
+        with self._step_lock:
+            if any(getattr(l, "is_deleted", lambda: False)()
+                   for l in jax.tree.leaves(self._ts)):
+                raise RuntimeError(
+                    "training state is recovering from a failed step; "
+                    "retry shortly")
+            return jax.tree.map(
+                lambda x: jnp.copy(x) if hasattr(x, "devices") else x,
+                self._ts)
+
     @property
     def train_state(self) -> TrainState | None:
-        return self._ts
+        """A SNAPSHOT of the live training state (safe against the donated
+        step consuming the original buffers mid-read); None before data."""
+        if self._ts is None:
+            return None
+        if self._thread is None or not self._thread.is_alive():
+            return self._ts          # no concurrent dispatch: zero-copy
+        return self._snapshot_ts()
 
 
 def run_end_to_end(cfg: FrameworkConfig, prices, *, use_mesh: bool = False,

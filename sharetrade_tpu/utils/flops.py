@@ -142,8 +142,9 @@ def _episode_mode_flops_per_agent_step(cfg: FrameworkConfig,
                         (apply_unroll_shared: one trunk per minibatch PASS,
                         not per agent — each pass re-runs it because the
                         params just changed)
-        replay heads:   epochs x 3 per agent-step (the replay head is NOT
-                        factored — its gradients need the d-sized path)
+        replay heads:   ALSO factored (round 5): d-sized base projections
+                        once per pass over the shared trunk rows, 3-wide
+                        portfolio term per agent-step, x3 for fwd+bwd
 
     MFU computed from this is hardware utilization of the executed matmuls;
     the pre-round-4 convention counted the per-agent replay trunks the
@@ -155,7 +156,6 @@ def _episode_mode_flops_per_agent_step(cfg: FrameworkConfig,
     per_token = (model.num_layers * (24.0 * d * d + 4.0 * w * d)
                  + 2.0 * 3 * d        # tick embed
                  + 2.0 * d * (model.num_actions + 1 + 3))  # heads + port
-    per_head = 2.0 * d * (model.num_actions + 1 + 3)
     t = max(learner.unroll_len, 1)
     b = max(cfg.parallel.num_workers, 1)
     s = model.num_layers * (w - 1) + t
@@ -168,14 +168,16 @@ def _episode_mode_flops_per_agent_step(cfg: FrameworkConfig,
         passes = epochs * mb_count
     else:
         epochs, passes = 1, 1
-    # Factored rollout head: shared base projections over T+1 trunk rows
-    # plus the per-step 3-wide portfolio term (policy+value: A+1 outputs).
+    # Factored heads: shared base projections over the trunk rows plus the
+    # per-step 3-wide portfolio term (policy+value: A+1 outputs).
     head_base = 2.0 * d * (model.num_actions + 1) * (t + 1) / t / b
     head_pf_step = 2.0 * 3 * (model.num_actions + 1)
+    replay_heads = (2.0 * d * (model.num_actions + 1) * passes * 3.0 / b
+                    + head_pf_step * epochs * 3.0)
     return (per_token * (s + 1) / t / b           # rollout trunk (shared)
             + head_base + head_pf_step             # factored rollout head
             + per_token * passes * 3.0 * s / t / b  # replay trunks (shared)
-            + per_head * epochs * 3.0)             # per-agent replay heads
+            + replay_heads)                        # factored replay heads
 
 
 def train_flops_per_agent_step(cfg: FrameworkConfig, obs_dim: int) -> float:

@@ -96,6 +96,13 @@ class StepTimer:
             "agent_steps_per_sec": agent_steps / dt if dt > 0 else 0.0,
         }
 
+    def rebase(self) -> None:
+        """Restart the interval clock without recording anything — called
+        after a supervision recovery so the failed chunk, the backoff
+        sleep, and the checkpoint restore don't pollute the next sample's
+        throughput metrics."""
+        self._last = time.perf_counter()
+
     def summary(self) -> dict[str, float]:
         if not self.history:
             return {}
