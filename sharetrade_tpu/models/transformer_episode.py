@@ -51,6 +51,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from sharetrade_tpu.config import ConfigError
+
 from sharetrade_tpu.models.core import (
     Model, ModelOut, dense, dense_init, portfolio_features, rows_finite)
 from sharetrade_tpu.models.ffn import ffn_apply
@@ -121,7 +123,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
     (nested shard_maps), as is pp + a non-local attention override.
     """
     if head_dim % 2:
-        raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
+        raise ConfigError(f"RoPE needs an even head_dim, got {head_dim}")
     window = obs_dim - 2                    # ticks per observation window
     hist_len = (num_layers - 1) * (window - 1)
     d_model = num_heads * head_dim
@@ -134,14 +136,14 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         attention_fn = local_attention
     if pp_mesh is not None:
         if pp_mesh.shape[pp_axis] != num_layers:
-            raise ValueError(
+            raise ConfigError(
                 f"pipeline_blocks needs num_layers == pp size "
                 f"({num_layers} != {pp_mesh.shape[pp_axis]})")
         if moe_experts:
-            raise ValueError("pipeline_blocks + moe_experts is unsupported "
+            raise ConfigError("pipeline_blocks + moe_experts is unsupported "
                              "(nested shard_maps); pick one partitioning")
         if attention_fn is not local_attention:
-            raise ValueError("pipeline_blocks requires the local banded "
+            raise ConfigError("pipeline_blocks requires the local banded "
                              "attention (no sp override inside a stage)")
 
     def block_ffn(blk, h):

@@ -192,7 +192,11 @@ class Orchestrator:
             # excluded gate (partial_recovery off) carries a quarantined
             # row frozen BELOW the horizon; counting it would skip the
             # re-arm and reintroduce the spin for exactly that resume.
-            done_cursors = bool(ok.any()) and int(np.min(t[ok])) >= horizon
+            # ALL rows stranded counts as done too — no live cursor can
+            # advance, and the re-arm's fresh state is the only recovery
+            # (restoring the same poisoned checkpoint can't be).
+            done_cursors = (not bool(ok.any())
+                            or int(np.min(t[ok])) >= horizon)
             if (done_cursors and int(state.env_steps)
                     < (self.episode + 1) * horizon):
                 # Resumed the final checkpoint of a COMPLETED episode while
@@ -206,8 +210,11 @@ class Orchestrator:
                 # cycle, TrainerChildActor.scala:57-59). (If heals inflated
                 # env_steps past the threshold instead, the normal
                 # completion gate re-arms on the first chunk.)
-                log.info("resumed a completed episode with episodes=%d; "
-                         "re-arming episode %d",
+                log.info("resumed a %s with episodes=%d; re-arming "
+                         "episode %d",
+                         "completed episode" if ok.any()
+                         else "checkpoint with every row stranded "
+                              "(mid-episode progress discarded)",
                          self.cfg.runtime.episodes, self.episode)
                 self._reset_episode()
             log.info("resumed from checkpoint step=%d "
