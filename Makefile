@@ -8,9 +8,9 @@
 
 PYTHON ?= python
 
-.PHONY: check test slow native bench clean
+.PHONY: check test slow native bench bench-dispatch lint clean
 
-check: native
+check: native lint
 	$(PYTHON) -m pytest tests/ -q -m "not slow" -x
 	$(PYTHON) tools/smoke_compile.py
 
@@ -25,6 +25,16 @@ native:
 
 bench:
 	$(PYTHON) bench.py
+
+# The dispatch-floor ladder alone (megachunk K in {1, 8, 64}): the lever
+# behind runtime.megachunk_factor, runnable on CPU in ~a minute.
+bench-dispatch:
+	$(PYTHON) -c "import json, bench; \
+	print(json.dumps(bench.bench_dispatch_floor(), indent=2))"
+
+# Static guard: no bare scalar device syncs in the orchestrator hot loop.
+lint:
+	$(PYTHON) tools/lint_hot_loop.py
 
 clean:
 	$(MAKE) -C native clean

@@ -28,6 +28,7 @@ import time
 from typing import Any
 
 import jax
+import numpy as np
 from flax import serialization
 
 from sharetrade_tpu.utils.logging import get_logger
@@ -142,6 +143,18 @@ class CheckpointManager:
             if hasattr(leaf, "copy_to_host_async"):
                 leaf.copy_to_host_async()
         host_state = jax.device_get(train_state)  # fast: DMAs already in flight
+        # device_get can return ZERO-COPY views of the runtime's buffers
+        # (owndata=False on the CPU backend). The caller's next donated-input
+        # step frees/reuses those buffers while the writer thread is still
+        # serializing — a use-after-free, not just a torn checkpoint — so the
+        # handoff must own its memory. Copy ONLY the non-owning views:
+        # accelerator backends already materialize owning host arrays, and
+        # re-copying the whole parameter tree on the training thread would
+        # double the save stall the async DMAs above exist to hide.
+        host_state = jax.tree.map(
+            lambda a: np.array(a, copy=True)
+            if isinstance(a, np.ndarray) and not a.flags.owndata
+            else a, host_state)
         if self._worker is None:
             self._queue = queue.Queue()
             self._worker = threading.Thread(

@@ -240,6 +240,35 @@ class RuntimeConfig:
     # transitions (DQN journaling) and runs with a fault_hook installed
     # sample every chunk regardless (durability / test-seam semantics).
     metrics_every_chunks: int = 10
+    # Device-resident megachunks: fuse this many consecutive chunks into ONE
+    # jitted program (a lax.scan over the agent step), so the host pays one
+    # dispatch per K chunks instead of K — the lever against the ~0.1 s
+    # dispatch floor per chunk on tunneled links. Per-chunk metrics stack
+    # into a (K, ...) device buffer read back with a single batched
+    # device_get at megachunk boundaries, so sampled metric streams stay
+    # per-chunk (bit-identical to K=1 — the parity contract,
+    # tests/test_megachunk.py). Supervision semantics are preserved at
+    # megachunk granularity: fault_hook fires per inner chunk with its true
+    # chunk index after readback; health checks / eval / checkpoint cadence
+    # evaluate on the boundary; near the episode threshold the loop falls
+    # back to K=1 dispatches so the exact-completion gate never overshoots.
+    # 1 (default) = today's per-chunk host loop. Must be >= 1 (validated at
+    # orchestrator construction, alongside metrics_every_chunks: sampling
+    # finer than a megachunk is delivered late-but-complete via the stacked
+    # rows, and a cadence that is not a multiple of K rounds up to the next
+    # megachunk boundary).
+    megachunk_factor: int = 1
+    # Double-buffered dispatch: issue megachunk k+1 BEFORE blocking on
+    # megachunk k's metric readback, so the host-side D2H transfer overlaps
+    # device compute (the async-checkpoint overlap pattern applied to the
+    # metrics path). Only engages in the cruise regime — when the env-step
+    # upper bound after one more megachunk stays strictly below the episode
+    # threshold and no replay transitions are being journaled — so the
+    # completion gate and journal durability never race an in-flight
+    # program. Fault detection and checkpoint step labels may lag by one
+    # in-flight megachunk. Inert at megachunk_factor=1 on the single-chunk
+    # exact path near episode ends.
+    double_buffer_dispatch: bool = False
     # Periodic greedy evaluation DURING training: every this many updates
     # the orchestrator runs evaluate() between chunks (one argmax episode
     # replay; the jitted program is cached), feeding the event-log learning

@@ -21,7 +21,7 @@ from typing import Any
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sharetrade_tpu.agents.base import TrainState
+from sharetrade_tpu.agents.base import TrainState, megachunk_step
 
 
 def batch_axis_sharding(mesh: Mesh, data_axis: str = "dp"):
@@ -137,15 +137,34 @@ def train_state_shardings(ts: TrainState, mesh: Mesh, *,
 
 
 def make_parallel_step(agent, mesh: Mesh, *, data_axis: str = "dp",
-                       param_rules: dict[str, P] | None = None):
+                       param_rules: dict[str, P] | None = None,
+                       megachunk_factor: int = 1):
     """jit the agent's chunk step with mesh shardings.
 
     Returns ``(place, step)``: ``place(ts)`` device_puts a freshly-initialized
     TrainState onto the mesh; ``step`` is the compiled chunk function with
     donated input (the TrainState is consumed each call — no HBM double-
     buffering of parameters).
+
+    ``megachunk_factor`` K > 1 composes the device-resident megachunk
+    (agents/base.py ``megachunk_step``) INSIDE the pjit boundary: the
+    K-chunk ``lax.scan`` is one partitioned program, so the ICI collectives
+    of consecutive inner chunks stay fused (no host round-trip re-dispatches
+    them) and the host pays one dispatch per K chunks. Metrics return
+    stacked ``(K, ...)``, replicated — the out-sharding spec is rank-
+    agnostic, so the same replicate spec covers both shapes.
     """
     replicate = NamedSharding(mesh, P())
+    step_fn = (agent.step if megachunk_factor <= 1
+               else megachunk_step(agent.step, megachunk_factor))
+    # NO donation for a fused megachunk on CPU devices: donating the
+    # TrainState into the lax.scan corrupts the heap on the CPU runtime
+    # (use-after-free once checkpoint restores interleave with megachunk
+    # dispatches — same hazard the orchestrator's CPU-fallback path avoids).
+    # Accelerator meshes keep donation, where HBM double-buffering matters.
+    donate = (() if megachunk_factor > 1
+              and next(iter(mesh.devices.flat)).platform == "cpu"
+              else (0,))
     cache: dict[str, Any] = {}  # sharding pytree + jitted fn, built once
 
     def _ensure(ts):
@@ -153,9 +172,9 @@ def make_parallel_step(agent, mesh: Mesh, *, data_axis: str = "dp",
             sh = train_state_shardings(ts, mesh, data_axis=data_axis,
                                        param_rules=param_rules)
             cache["sh"] = sh
-            cache["fn"] = jax.jit(agent.step, in_shardings=(sh,),
+            cache["fn"] = jax.jit(step_fn, in_shardings=(sh,),
                                   out_shardings=(sh, replicate),
-                                  donate_argnums=0)
+                                  donate_argnums=donate)
         return cache
 
     def place(ts: TrainState) -> TrainState:

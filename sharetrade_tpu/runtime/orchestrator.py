@@ -11,7 +11,12 @@ owns:
   ``chunk_steps`` env steps per host visit; between chunks the host snapshots
   metrics, so ``get_avg``/``get_std`` answer **without stopping the device**
   (the reference interrupts trained workers with ask(GetPortfolio);
-  SURVEY.md §7.4 "Queryability");
+  SURVEY.md §7.4 "Queryability"). With ``runtime.megachunk_factor`` K > 1
+  the host visit itself amortizes: K chunks fuse into one device-resident
+  lax.scan (agents/base.py ``megachunk_step``), per-chunk metrics stack into
+  a (K, ...) buffer read back with ONE batched ``jax.device_get`` at
+  megachunk boundaries, and the loop falls back to K=1 dispatches near the
+  episode threshold so the exact-completion gate keeps its semantics;
 - supervision: a failing chunk triggers exponential-backoff restart from the
   latest checkpoint (initial 3 s, cap 60 s, jitter 0.2 — the reference's
   Backoff.onFailure envelope, TrainerRouterActor.scala:46-52) up to
@@ -41,7 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sharetrade_tpu.agents import build_agent
-from sharetrade_tpu.agents.base import Agent, TrainState
+from sharetrade_tpu.agents.base import Agent, TrainState, megachunk_step
 from sharetrade_tpu.checkpoint import CheckpointManager
 from sharetrade_tpu.config import ConfigError, FrameworkConfig
 from sharetrade_tpu.env import trading
@@ -75,6 +80,17 @@ DEFAULT_ERROR_POLICY: dict[type, str] = {
 }
 
 
+def _metric_rows(host: dict, k: int) -> list[dict[str, float]]:
+    """Split one batched megachunk readback into its K per-chunk rows.
+
+    ``host`` holds host-side arrays: scalars for a single chunk (k == 1),
+    ``(K,)``-stacked values for a fused megachunk — the scan-stacked metric
+    buffer of agents/base.py ``megachunk_step``."""
+    if k == 1:
+        return [{key: float(v) for key, v in host.items()}]
+    return [{key: float(v[i]) for key, v in host.items()} for i in range(k)]
+
+
 class Orchestrator:
     def __init__(self, cfg: FrameworkConfig, *,
                  mesh=None,
@@ -85,6 +101,26 @@ class Orchestrator:
                  error_policy: dict[type, str] | None = None):
         self.cfg = cfg
         self.mesh = mesh
+        if cfg.runtime.megachunk_factor < 1:
+            # A bad factor can never heal by restarting — same class of
+            # error as any other impossible composition, so it fails at
+            # construction (the supervision decider's STOP verb territory).
+            raise ConfigError(
+                "runtime.megachunk_factor must be >= 1, got "
+                f"{cfg.runtime.megachunk_factor}")
+        if (cfg.runtime.megachunk_factor > 1
+                and cfg.runtime.metrics_every_chunks
+                % cfg.runtime.megachunk_factor != 0):
+            # Not an error — sampling quantizes UP to the next megachunk
+            # boundary (rows are delivered late-but-complete from the
+            # stacked buffer) — but worth a line in the log so a surprised
+            # operator finds the interaction documented in config.py.
+            log.info(
+                "metrics_every_chunks=%d is not a multiple of "
+                "megachunk_factor=%d; metric samples land on megachunk "
+                "boundaries (rounded up)",
+                cfg.runtime.metrics_every_chunks,
+                cfg.runtime.megachunk_factor)
         self.lifecycle = Lifecycle()
         self.metrics = MetricsRegistry()
         self.checkpoints = checkpoints or CheckpointManager(
@@ -100,6 +136,7 @@ class Orchestrator:
         self.env = None  # TradingEnv once data arrives
         self._ts: TrainState | None = None
         self._step_fn = None
+        self._mega_fn = None   # K-chunk fused program (megachunk_factor > 1)
         self._eval_fn = None   # cached jitted greedy-eval program
         self._snapshot: dict[str, float] = {}
         self._snapshot_lock = threading.Lock()
@@ -248,7 +285,12 @@ class Orchestrator:
                 background=getattr(self, "_stashed_background", True))
 
     def _build_step(self) -> None:
+        factor = self.cfg.runtime.megachunk_factor
+        self._mega_fn = None
         if self._step_override is not None:
+            # Host-side test seam: an arbitrary Python callable cannot be
+            # traced into a lax.scan, so megachunks are unavailable and the
+            # loop runs its K=1 path regardless of megachunk_factor.
             self._place = lambda ts: ts
             self._step_fn = self._step_override
         elif self.mesh is not None:
@@ -264,6 +306,15 @@ class Orchestrator:
             self._place, self._step_fn = make_parallel_step(
                 self.agent, self.mesh, data_axis=self.cfg.parallel.data_axis,
                 param_rules=rules)
+            if factor > 1:
+                # The K-chunk scan composes INSIDE the pjit boundary (one
+                # partitioned program), so ICI collectives stay fused across
+                # inner chunks; the single-chunk program above remains the
+                # exact path near episode thresholds.
+                _, self._mega_fn = make_parallel_step(
+                    self.agent, self.mesh,
+                    data_axis=self.cfg.parallel.data_axis,
+                    param_rules=rules, megachunk_factor=factor)
         else:
             self._place = lambda ts: ts
             # Donated input, matching the mesh path: the previous chunk's
@@ -280,6 +331,17 @@ class Orchestrator:
             # bound holds from chunk 0: _run_supervised writes a baseline
             # checkpoint before the first chunk).
             self._step_fn = jax.jit(self.agent.step, donate_argnums=0)
+            if factor > 1:
+                # NO donation on the CPU-fallback megachunk: donating the
+                # TrainState into the fused lax.scan corrupts the heap on
+                # the CPU runtime (use-after-free that surfaces as segfaults
+                # in unrelated threads once checkpoint restores interleave
+                # with megachunk dispatches — reproduced by the supervision
+                # tests). The cost is one extra live TrainState per K chunks
+                # on the fallback path only; the mesh/pjit path above keeps
+                # donation, where HBM double-buffering actually matters.
+                self._mega_fn = jax.jit(
+                    megachunk_step(self.agent.step, factor))
 
     # ------------------------------------------------------------------
     # protocol: StartTraining (TrainerRouterActor.scala:86-88)
@@ -337,8 +399,23 @@ class Orchestrator:
         # raised them.
         metrics_every = (1 if self._fault_hook is not None
                          else max(1, rt.metrics_every_chunks))
+        # Device-resident megachunks (config.RuntimeConfig.megachunk_factor):
+        # K consecutive chunks fused into ONE compiled lax.scan, so the host
+        # pays one dispatch per K chunks instead of K — the lever against
+        # the ~0.1 s per-dispatch floor on tunneled links. Per-chunk metrics
+        # come back as a stacked (K, ...) buffer read with ONE batched
+        # device_get; near the episode threshold the loop falls back to the
+        # K=1 exact path below. _build_step leaves _mega_fn None for the
+        # host-side step_override seam.
+        mega = rt.megachunk_factor if self._mega_fn is not None else 1
         timer = StepTimer(rt.chunk_steps, self.cfg.parallel.num_workers)
         self.tracer.start()
+        # ONE batched readback seeds both the baseline-checkpoint label and
+        # the env-step completion bound (formerly two scalar device_gets —
+        # tools/lint_hot_loop.py keeps stray per-scalar syncs out).
+        updates0, env_steps0 = (
+            int(v) for v in jax.device_get(  # hot-loop-sync-ok: once, before the first chunk
+                (self._ts.updates, self._ts.env_steps)))
         # Baseline checkpoint before the first chunk (async; skipped when
         # one already exists or checkpointing is off): with donated step
         # inputs, a failure INSIDE a step can never resume in place — it
@@ -350,41 +427,120 @@ class Orchestrator:
         if (rt.checkpoint_every_updates > 0
                 and self.checkpoints.latest_step() is None):
             self.checkpoints.save_async(
-                int(jax.device_get(self._ts.updates)), self._ts,
-                metadata={"episode": self.episode})
+                updates0, self._ts, metadata={"episode": self.episode})
         timer.tick()
-        last_env_steps: int | None = None
+        last_env_steps: int | None = env_steps0
         chunks_since = 0
+        # Double-buffered dispatch (runtime.double_buffer_dispatch): the
+        # (metrics, K, agent_heals-at-dispatch) of a megachunk already
+        # issued while its predecessor's rows are read back and processed.
+        # The heals mark lets the health check below recognize a STALE
+        # unhealthy_workers report: rows computed before a boundary heal
+        # still carry the quarantined row, and re-healing it would find no
+        # bad rows and spuriously escalate to a full restart.
+        pending: tuple[dict, int, int] | None = None
         while not self._stop.is_set():
             try:
-                if last_env_steps is None:  # start / after restore
-                    last_env_steps = int(jax.device_get(self._ts.env_steps))
+                if last_env_steps is None:  # after any recovery path
+                    last_env_steps = int(
+                        jax.device_get(self._ts.env_steps))  # hot-loop-sync-ok: once per recovery, not per chunk
                     chunks_since = 0
-                with self.tracer.span(f"train_chunk_{chunk_idx}"):
-                    # The step lock fences evaluate()'s state snapshot from
-                    # this donating dispatch; dispatch is non-blocking so
-                    # the lock is held microseconds, not the chunk.
-                    with self._step_lock:
-                        ts, metrics = self._step_fn(self._ts)
-                        # Commit the new state BEFORE any hook can raise:
-                        # both step paths donate their input, so the old
-                        # state is already dead.
-                        self._ts = ts
-                transitions = metrics.pop("transitions", None)
-                chunks_since += 1
                 threshold = horizon * (self.episode + 1)
+                if pending is not None:
+                    metrics, k, heals_mark = pending
+                    pending = None
+                else:
+                    heals_mark = self.agent_heals
+                    # Fuse K chunks ONLY when even the env-step UPPER BOUND
+                    # after K more chunks stays strictly below the episode
+                    # threshold (each chunk advances the counter by at most
+                    # chunk_steps): no inner chunk can hit the completion
+                    # gate, so near episode ends the loop degrades to K=1
+                    # dispatches and the gate keeps its exact semantics.
+                    k = (mega if mega > 1
+                         and (last_env_steps + (chunks_since + mega)
+                              * rt.chunk_steps) < threshold
+                         else 1)
+                    with self.tracer.span(
+                            f"train_chunk_{chunk_idx}"
+                            + (f"_x{k}" if k > 1 else "")):
+                        # The step lock fences evaluate()'s state snapshot
+                        # from this donating dispatch; dispatch is
+                        # non-blocking so the lock is held microseconds,
+                        # not the chunk.
+                        with self._step_lock:
+                            ts, metrics = (self._mega_fn if k > 1
+                                           else self._step_fn)(self._ts)
+                            # Commit the new state BEFORE any hook can
+                            # raise: the mesh/accelerator paths donate their
+                            # input (old state already dead), and the non-
+                            # donating CPU megachunk paths must still never
+                            # re-dispatch a superseded state after a hook
+                            # fault. Do NOT assume donation on every path —
+                            # the CPU fused-scan carve-outs (_build_step,
+                            # sharding.py) exist to avoid a use-after-free.
+                            self._ts = ts
+                transitions = metrics.pop("transitions", None)
+                chunks_since += k
                 est_env_steps = min(
                     last_env_steps + chunks_since * rt.chunk_steps, threshold)
                 if (chunks_since < metrics_every and transitions is None
                         and est_env_steps < threshold):
-                    chunk_idx += 1
+                    chunk_idx += k
                     continue        # fast path: no host materialization
-                self._journal_transitions(
-                    transitions, int(np.asarray(metrics["env_steps"])))
-                metrics = {k: float(np.asarray(v)) for k, v in metrics.items()}
-                if self._fault_hook is not None:
-                    self._fault_hook(chunk_idx, metrics)
-                chunk_idx += 1
+                if (rt.double_buffer_dispatch and k > 1
+                        and transitions is None and self._fault_hook is None
+                        and (last_env_steps + (chunks_since + k)
+                             * rt.chunk_steps) < threshold):
+                    # Cruise-regime double buffering: issue megachunk k+1
+                    # BEFORE blocking on this one's readback, so the D2H
+                    # metric transfer below overlaps device compute (the
+                    # async-checkpoint D2H overlap applied to the metrics
+                    # path). Guarded exactly like the fused dispatch (no
+                    # inner chunk of the in-flight program can complete the
+                    # episode), and off when transitions are journaled
+                    # (durability) or a fault_hook is installed (the chaos
+                    # seam needs dispatch-synchronous state). Consequence,
+                    # documented in config.py: fault detection and the
+                    # checkpoint/eval cadence act on a state one in-flight
+                    # megachunk ahead of the rows being read.
+                    # The span covers the chunks the prefetch advances
+                    # (chunk_idx + k onward) so the trace keeps one
+                    # train_chunk_* entry per dispatch, not just the first.
+                    with self.tracer.span(f"train_chunk_{chunk_idx + k}_x{k}"):
+                        with self._step_lock:
+                            ts, ahead = self._mega_fn(self._ts)
+                            self._ts = ts
+                    pending = (ahead, k, self.agent_heals)
+                # ONE batched readback for the whole megachunk: the stacked
+                # (K, ...) metric rows and (for DQN journaling) the stacked
+                # transition batch cross to the host together, replacing the
+                # per-chunk float(np.asarray(...)) scalar round-trips
+                # (tools/lint_hot_loop.py pins this).
+                host, host_tr = jax.device_get((metrics, transitions))  # hot-loop-sync-ok: THE batched megachunk readback
+                rows = _metric_rows(host, k)
+                base = chunk_idx
+                for i, row in enumerate(rows):
+                    if host_tr is not None:
+                        self._journal_transitions(
+                            jax.tree.map(lambda a: a[i], host_tr)
+                            if k > 1 else host_tr,
+                            int(row["env_steps"]))
+                    if self._fault_hook is not None:
+                        # Per inner chunk with its TRUE chunk index: a fault
+                        # landing mid-megachunk surfaces at the boundary but
+                        # is attributed (and, on raise, retried) at the
+                        # chunk that raised it.
+                        self._fault_hook(base + i, row)
+                    chunk_idx = base + i + 1
+                    if i + 1 < k:
+                        # Inner (non-boundary) rows keep the per-chunk
+                        # metric stream complete — delivered late, at the
+                        # boundary; snapshot/supervision/cadence below read
+                        # the boundary row, which subsumes them (quarantine
+                        # and counters are monotone within a megachunk).
+                        self.metrics.record_many(row)
+                metrics = rows[-1]
                 metrics.update(timer.tick(chunks_since))
                 last_env_steps = int(metrics["env_steps"])
                 chunks_since = 0
@@ -394,7 +550,12 @@ class Orchestrator:
 
                 workers = self.cfg.parallel.num_workers
                 if (rt.partial_recovery
-                        and metrics.get("unhealthy_workers", 0) > 0):
+                        and metrics.get("unhealthy_workers", 0) > 0
+                        # Stale report from a pre-heal in-flight megachunk
+                        # (double buffering): the row was already respawned
+                        # at the previous boundary; the next fresh megachunk
+                        # re-reports if the fault actually persists.
+                        and heals_mark == self.agent_heals):
                     # Quarantined rows detected: respawn just those agents
                     # (the reference's one-dead-child heal). Raising falls
                     # through to the supervision decider -> full restore.
@@ -500,6 +661,7 @@ class Orchestrator:
                         "no further progress is possible")
             except Exception as exc:  # supervision decider
                 last_env_steps = None   # resync after any recovery path
+                pending = None          # in-flight megachunk is now stale
                 self.last_error = exc
                 verb = self._decide(exc)
                 self.events.emit("worker_failed", error=repr(exc), verb=verb,

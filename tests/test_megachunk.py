@@ -1,0 +1,296 @@
+"""Device-resident megachunk tests (runtime.megachunk_factor).
+
+The contract under test: K chunks fused into one compiled ``lax.scan``
+(agents/base.py ``megachunk_step``) are BIT-IDENTICAL to K host-dispatched
+chunks — TrainState and per-chunk metric stream both — while the
+orchestrator's supervision semantics (fault attribution by true chunk
+index, restart/backoff, exact episode completion) survive at megachunk
+granularity, with the documented near-episode-end fallback to K=1.
+"""
+
+import importlib.util
+import pathlib
+
+import jax
+import numpy as np
+import pytest
+
+from sharetrade_tpu.config import ConfigError, FrameworkConfig
+from sharetrade_tpu.runtime import Orchestrator, ReplyState, run_end_to_end
+
+WINDOW = 8
+#: 256-step episode: long enough that a K=8 megachunk engages for the first
+#: half (upper bound 8 x 16 = 128 < 256) and the loop then falls back to
+#: K=1 singles for the exact completion approach.
+PRICES = np.linspace(10.0, 20.0, 264, dtype=np.float32)
+#: 512-step episode: cruise region wide enough for double-buffered dispatch
+#: (the prefetch guard needs TWO megachunks of headroom below the threshold).
+LONG_PRICES = np.linspace(10.0, 20.0, 520, dtype=np.float32)
+
+
+def fast_cfg(tmp_path, *, megachunk=1, algo="qlearn"):
+    cfg = FrameworkConfig()
+    cfg.learner.algo = algo
+    cfg.env.window = WINDOW
+    cfg.model.hidden_dim = 8
+    cfg.parallel.num_workers = 4
+    cfg.runtime.chunk_steps = 16
+    cfg.runtime.checkpoint_every_updates = 64
+    cfg.runtime.checkpoint_dir = str(tmp_path / f"ckpts_k{megachunk}")
+    cfg.runtime.backoff_initial_s = 0.01
+    cfg.runtime.backoff_max_s = 0.05
+    cfg.runtime.max_restarts = 3
+    cfg.runtime.metrics_every_chunks = 1   # per-chunk stream for parity
+    cfg.runtime.megachunk_factor = megachunk
+    return cfg
+
+
+def _assert_states_identical(a, b):
+    for la, lb in zip(jax.tree.leaves(jax.device_get(a)),
+                      jax.tree.leaves(jax.device_get(b))):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+class TestMegachunkStep:
+    """agents/base.py megachunk_step in isolation."""
+
+    def test_fused_matches_sequential_bitwise(self, tmp_path):
+        from sharetrade_tpu.agents import build_agent
+        from sharetrade_tpu.agents.base import megachunk_step
+        from sharetrade_tpu.env import trading
+        cfg = fast_cfg(tmp_path)
+        env = trading.make_trading_env(
+            PRICES, window=WINDOW, initial_budget=cfg.env.initial_budget,
+            initial_shares=0)
+        agent = build_agent(cfg, env)
+        k = 4
+
+        step = jax.jit(agent.step)
+        ts_seq = agent.init(jax.random.PRNGKey(7))
+        last_metrics = None
+        for _ in range(k):
+            ts_seq, last_metrics = step(ts_seq)
+
+        fused = jax.jit(megachunk_step(agent.step, k))
+        ts_fused, stacked = fused(agent.init(jax.random.PRNGKey(7)))
+
+        _assert_states_identical(ts_seq, ts_fused)
+        # Metrics stack along a leading (K,) axis; the last row is the
+        # boundary row the orchestrator snapshots.
+        for key, v in stacked.items():
+            assert np.asarray(v).shape[0] == k, key
+            np.testing.assert_array_equal(
+                np.asarray(v)[-1], np.asarray(last_metrics[key]))
+
+    def test_factor_below_one_rejected(self):
+        from sharetrade_tpu.agents.base import megachunk_step
+        with pytest.raises(ValueError, match="factor"):
+            megachunk_step(lambda ts: (ts, {}), 0)
+
+
+class TestOrchestratorParity:
+    def test_k8_bit_identical_to_k1(self, tmp_path):
+        """The acceptance row: megachunk_factor=8 produces the SAME
+        TrainState and the SAME per-chunk metric stream as K=1 on a fixed
+        seed — one fused scan per 8 chunks is a pure dispatch-count
+        optimization, not a numerics change."""
+        runs = {}
+        for k in (1, 8):
+            orch = run_end_to_end(fast_cfg(tmp_path, megachunk=k), PRICES)
+            assert orch.is_everything_done().state is ReplyState.COMPLETED
+            assert orch.restarts == 0
+            runs[k] = orch
+        _assert_states_identical(runs[1].train_state, runs[8].train_state)
+        for key in ("loss", "env_steps", "updates", "reward_sum",
+                    "portfolio_mean", "portfolio_std"):
+            s1 = [v for _, v in runs[1].metrics.series(key)]
+            s8 = [v for _, v in runs[8].metrics.series(key)]
+            assert s1 == s8, f"metric stream diverged for {key!r}"
+        assert runs[1].get_avg().value == runs[8].get_avg().value
+
+    def test_double_buffer_bit_identical(self, tmp_path):
+        """double_buffer_dispatch only reorders HOST work (readback overlaps
+        the in-flight megachunk); device results must stay bit-identical."""
+        plain = run_end_to_end(fast_cfg(tmp_path, megachunk=8), LONG_PRICES)
+        cfg = fast_cfg(tmp_path, megachunk=8)
+        cfg.runtime.checkpoint_dir = str(tmp_path / "ckpts_db")
+        cfg.runtime.double_buffer_dispatch = True
+        buffered = run_end_to_end(cfg, LONG_PRICES)
+        for orch in (plain, buffered):
+            assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert int(buffered.train_state.env_steps) == len(LONG_PRICES) - WINDOW
+        _assert_states_identical(plain.train_state, buffered.train_state)
+        s1 = [v for _, v in plain.metrics.series("loss")]
+        s2 = [v for _, v in buffered.metrics.series("loss")]
+        assert s1 == s2
+
+    def test_mesh_megachunk_matches_singles(self, tmp_path, cpu_mesh):
+        """The pjit composition (parallel/sharding.py): a K-chunk scan
+        compiled INSIDE the sharded program equals K single sharded steps."""
+        from sharetrade_tpu.agents import build_agent
+        from sharetrade_tpu.env import trading
+        from sharetrade_tpu.parallel import make_parallel_step
+        cfg = fast_cfg(tmp_path)
+        cfg.parallel.num_workers = 8           # divisible by the dp mesh
+        env = trading.make_trading_env(
+            PRICES, window=WINDOW, initial_budget=cfg.env.initial_budget,
+            initial_shares=0)
+        agent = build_agent(cfg, env)
+        k = 4
+
+        place, step = make_parallel_step(agent, cpu_mesh)
+        ts_seq = place(agent.init(jax.random.PRNGKey(3)))
+        for _ in range(k):
+            ts_seq, metrics = step(ts_seq)
+
+        place_k, mega = make_parallel_step(agent, cpu_mesh,
+                                           megachunk_factor=k)
+        ts_fused, stacked = mega(place_k(agent.init(jax.random.PRNGKey(3))))
+
+        _assert_states_identical(ts_seq, ts_fused)
+        np.testing.assert_array_equal(
+            np.asarray(stacked["env_steps"])[-1],
+            np.asarray(metrics["env_steps"]))
+
+
+class TestSupervisionAtMegachunkGranularity:
+    def test_fault_mid_megachunk_fires_with_true_chunk_index(self, tmp_path):
+        """A fault landing on an inner chunk surfaces at the megachunk
+        boundary but is attributed to the chunk that raised it, and the
+        restarted loop retries from that same chunk index — the reference's
+        PoisonPill chaos seam preserved at megachunk granularity."""
+        cfg = fast_cfg(tmp_path, megachunk=4)
+        seen, fired = [], []
+
+        def chaos(chunk_idx, metrics):
+            seen.append(chunk_idx)
+            if chunk_idx == 2 and not fired:
+                fired.append(1)
+                raise RuntimeError("injected mid-megachunk PoisonPill")
+
+        orch = Orchestrator(cfg, fault_hook=chaos)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.restarts == 1
+        # Inner chunks 0 and 1 were processed from the stacked rows, the
+        # fault fired at TRUE index 2, and the post-restore loop retried
+        # chunk 2 (same index), not 4 (the already-dispatched boundary).
+        assert seen[:4] == [0, 1, 2, 2]
+
+    def test_heal_under_double_buffer_not_double_counted(self, tmp_path):
+        """double_buffer_dispatch keeps one megachunk in flight past the
+        boundary that heals a poisoned row; the in-flight rows were computed
+        PRE-heal and still report the quarantined row. That stale report
+        must not re-trigger healing (no bad rows would be found, spuriously
+        escalating to a full checkpoint restore): one heal, zero restarts."""
+        cfg = fast_cfg(tmp_path, megachunk=8)
+        cfg.runtime.double_buffer_dispatch = True
+        orch = Orchestrator(cfg)
+        orch.send_training_data(LONG_PRICES)
+        # Poison one wallet BEFORE the loop starts: the quarantine masks the
+        # row on-device from chunk 0, and with double buffering the second
+        # megachunk is dispatched before the first boundary's heal runs.
+        ts = orch._ts
+        budget = np.asarray(jax.device_get(ts.env_state.budget)).copy()
+        budget[2] = np.nan
+        orch._ts = ts.replace(env_state=ts.env_state.replace(
+            budget=jax.numpy.asarray(budget)))
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.agent_heals == 1
+        assert orch.restarts == 0
+        assert orch.snapshot()["unhealthy_workers"] == 0
+
+    def test_completion_gate_never_overshoots(self, tmp_path):
+        """Two episodes under K=8 with sampling coarser than the run: the
+        upper-bound guard must fall back to single chunks near each episode
+        threshold, completing at EXACTLY episodes x horizon env steps with
+        exactly the K=1 chunk count (no fused overshoot past a re-arm)."""
+        import json
+        from sharetrade_tpu.utils.logging import EventLog
+        cfg = fast_cfg(tmp_path, megachunk=8)
+        cfg.runtime.metrics_every_chunks = 1000
+        cfg.runtime.episodes = 2
+        events_path = str(tmp_path / "events.jsonl")
+        orch = Orchestrator(cfg, event_log=EventLog(events_path))
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.restarts == 0
+        horizon = len(PRICES) - WINDOW
+        done = [json.loads(l) for l in open(events_path)
+                if json.loads(l)["kind"] == "training_completed"][0]
+        assert done["env_steps"] == 2 * horizon       # exact, no overshoot
+        chunks_per_episode = -(-horizon // cfg.runtime.chunk_steps)
+        assert done["chunks_timed"] == 2 * chunks_per_episode
+
+    def test_factor_shorter_than_episode_always_falls_back(self, tmp_path):
+        """A megachunk that cannot fit below the first threshold (K x
+        chunk_steps >= horizon) must transparently run the K=1 path for the
+        whole episode — same completion, same results as factor 1."""
+        short = np.linspace(10.0, 20.0, 72, dtype=np.float32)  # horizon 64
+        base = run_end_to_end(fast_cfg(tmp_path, megachunk=1), short)
+        cfg = fast_cfg(tmp_path, megachunk=8)
+        cfg.runtime.checkpoint_dir = str(tmp_path / "ckpts_fb")
+        fb = run_end_to_end(cfg, short)
+        assert fb.is_everything_done().state is ReplyState.COMPLETED
+        _assert_states_identical(base.train_state, fb.train_state)
+
+    def test_invalid_factor_rejected_at_construction(self, tmp_path):
+        cfg = fast_cfg(tmp_path)
+        cfg.runtime.megachunk_factor = 0
+        with pytest.raises(ConfigError, match="megachunk_factor"):
+            Orchestrator(cfg)
+
+
+class TestJournaledTransitionsAcrossMegachunks:
+    def test_dqn_journal_rows_exactly_once(self, tmp_path):
+        """DQN journaling under K=4: the stacked (K, T, B, ...) transition
+        batch is journaled per inner chunk from the single batched readback,
+        keeping the exactly-once row count of the K=1 path."""
+        cfg = fast_cfg(tmp_path, megachunk=4, algo="dqn")
+        cfg.runtime.chunk_steps = 8
+        cfg.learner.journal_replay = True
+        cfg.learner.replay_capacity = 4096
+        cfg.learner.replay_batch = 8
+        cfg.data.journal_dir = str(tmp_path / "journal")
+        prices = np.linspace(10.0, 20.0, 72, dtype=np.float32)  # horizon 64
+        orch = run_end_to_end(cfg, prices)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        horizon = len(prices) - WINDOW
+        assert (int(orch.train_state.extras.replay.size)
+                == horizon * cfg.parallel.num_workers)
+        from sharetrade_tpu.data.transitions import read_tail_transitions
+        tail = read_tail_transitions(
+            f"{cfg.data.journal_dir}/transitions.journal", 0)  # unbounded
+        assert tail is not None
+        assert tail[0].shape[0] == horizon * cfg.parallel.num_workers
+        orch.stop()
+
+
+def test_hot_loop_sync_lint_passes():
+    """tools/lint_hot_loop.py is the guard that keeps bare scalar device
+    syncs out of _run_supervised; run it as part of tier-1 so a regression
+    fails CI, not just `make check`."""
+    tool = (pathlib.Path(__file__).resolve().parent.parent
+            / "tools" / "lint_hot_loop.py")
+    spec = importlib.util.spec_from_file_location("lint_hot_loop", tool)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
+
+
+@pytest.mark.slow
+class TestMegachunkSoak:
+    def test_k64_soak_completes_exactly(self, tmp_path):
+        """K=64 with tiny chunks: 256 chunks collapse to a handful of host
+        dispatches; the run must still complete at the exact horizon."""
+        cfg = fast_cfg(tmp_path, megachunk=64)
+        cfg.runtime.chunk_steps = 4
+        cfg.runtime.metrics_every_chunks = 64
+        prices = np.linspace(10.0, 20.0, 1032, dtype=np.float32)
+        orch = run_end_to_end(cfg, prices)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.restarts == 0
+        assert int(orch.train_state.env_steps) == len(prices) - WINDOW

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Grep-lint for the orchestrator's training hot loop.
+
+The megachunk refactor (runtime/orchestrator.py _run_supervised) replaced
+the per-chunk scalar device round-trips — ``jax.device_get(ts.updates)``,
+``float(np.asarray(v))`` per metric key — with ONE batched readback per
+(mega)chunk sample; each stray scalar sync costs a full device round-trip
+that serializes the dispatch pipeline (~0.1 s on tunneled links, about the
+price of an entire flagship chunk, BASELINE.md). This lint keeps the loop
+clean: it FAILS when a bare ``device_get(`` / ``float(np.asarray`` /
+``block_until_ready(`` reappears inside the hot-loop functions without the
+explicit ``hot-loop-sync-ok`` marker naming why that sync is off the
+per-chunk path (pre-loop seed, once-per-recovery resync, or THE batched
+megachunk readback itself).
+
+Run directly, via ``make check``, or through the tier-1 guard in
+tests/test_megachunk.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+
+TARGET = (pathlib.Path(__file__).resolve().parent.parent
+          / "sharetrade_tpu" / "runtime" / "orchestrator.py")
+#: Functions whose bodies are the per-chunk hot path.
+HOT_FUNCS = ("_run_supervised",)
+#: Host-sync constructs that serialize the dispatch pipeline.
+PATTERN = re.compile(
+    r"device_get\(|float\(np\.asarray|block_until_ready\(")
+#: Escape hatch: a line carrying this marker declares (and should name) why
+#: its sync is not a per-chunk cost.
+MARKER = "hot-loop-sync-ok"
+
+
+def main() -> int:
+    src = TARGET.read_text()
+    lines = src.splitlines()
+    bad: list[tuple[str, int, str]] = []
+    found: set[str] = set()
+    for node in ast.walk(ast.parse(src)):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in HOT_FUNCS):
+            found.add(node.name)
+            for ln in range(node.lineno, node.end_lineno + 1):
+                text = lines[ln - 1]
+                # Comment-only lines can't dispatch a sync; skip them so
+                # prose ABOUT device_get doesn't trip the lint.
+                if text.lstrip().startswith("#"):
+                    continue
+                if PATTERN.search(text) and MARKER not in text:
+                    bad.append((node.name, ln, text.strip()))
+    missing = set(HOT_FUNCS) - found
+    if missing:
+        # A rename must update this lint, not silently un-guard the loop.
+        print(f"hot-loop lint: function(s) {sorted(missing)} not found in "
+              f"{TARGET} — update tools/lint_hot_loop.py HOT_FUNCS")
+        return 1
+    if bad:
+        print(f"hot-loop sync lint FAILED ({TARGET.name}):")
+        for fn, ln, text in bad:
+            print(f"  {fn}:{ln}: {text}")
+        print("per-chunk host syncs serialize the dispatch pipeline; route "
+              "reads through the batched megachunk readback, or tag the "
+              f"line '# {MARKER}: <why this is not a per-chunk cost>'")
+        return 1
+    print(f"hot-loop sync lint OK ({', '.join(sorted(found))})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
