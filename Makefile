@@ -8,11 +8,12 @@
 
 PYTHON ?= python
 
-.PHONY: check test slow native bench bench-dispatch lint clean
+.PHONY: check test slow native bench bench-dispatch bench-obs obs-demo lint clean
 
 check: native lint
 	$(PYTHON) -m pytest tests/ -q -m "not slow" -x
 	$(PYTHON) tools/smoke_compile.py
+	$(PYTHON) tools/obs_demo.py
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -31,6 +32,20 @@ bench:
 bench-dispatch:
 	$(PYTHON) -c "import json, bench; \
 	print(json.dumps(bench.bench_dispatch_floor(), indent=2))"
+
+# Telemetry overhead alone (obs.enabled off vs on at K in {1, 8}, with an
+# A/A noise-floor control, plus the direct per-sample cost): the <2%
+# budget recorded in BASELINE.md "Telemetry overhead".
+bench-obs:
+	$(PYTHON) -c "import json, bench; \
+	r = bench.bench_obs_overhead(); \
+	r['per_sample'] = bench.bench_obs_sample_cost(); \
+	print(json.dumps(r, indent=2))"
+
+# Zero-to-summary telemetry demo: short obs-enabled training, artifact
+# checks, then the `cli obs` summary of the run dir (also part of check).
+obs-demo:
+	$(PYTHON) tools/obs_demo.py
 
 # Static guard: no bare scalar device syncs in the orchestrator hot loop.
 lint:

@@ -21,6 +21,10 @@ object, with the reference-shape row nested under ``"reference_shape"``.
    workload at megachunk factors K ∈ {1, 8, 64} — host dispatches/sec and
    agent-steps/sec as the per-chunk dispatch floor is amortized by the
    ``runtime.megachunk_factor`` device-resident loop.
+4. **Telemetry overhead** (``bench_obs_overhead``): the orchestrator hot
+   loop with ``obs.enabled`` false vs true at K ∈ {1, 8} — the span trace /
+   metrics export / flight recorder must cost <2% (BASELINE.md "Telemetry
+   overhead").
 
 Baseline derivation (the reference publishes NO numbers — BASELINE.md): its
 driver polls up to 201 × 5 s ≈ 1,005 s for a complete run
@@ -269,6 +273,124 @@ def bench_dispatch_floor(factors: tuple[int, ...] = (1, 8, 64), *,
     return out
 
 
+def bench_obs_overhead(factors: tuple[int, ...] = (1, 8), *,
+                       chunks: int = 48, trials: int = 2) -> dict:
+    """Telemetry-overhead ladder: the ORCHESTRATOR hot loop (where the obs
+    instrumentation lives — bench loops above bypass it) driven over an
+    identical chunk budget with ``obs.enabled`` false vs true, at megachunk
+    K ∈ ``factors``. Each mode re-runs episodes on ONE orchestrator so the
+    compiled step is reused (episode 1 compiles and is discarded; timed
+    episodes dispatch the cached program) and keeps the best of ``trials``.
+    The budget (BASELINE.md "Telemetry overhead"): <2% — obs spans ride the
+    sampling cadence, so between samples the loop must stay span-free."""
+    import os
+    import tempfile
+
+    from sharetrade_tpu.runtime.orchestrator import Orchestrator
+
+    import statistics
+
+    out: dict = {
+        "metric": "obs_overhead_qlearn",
+        "chunk_steps": 50,
+        "chunks_per_episode": chunks,
+        "rows": {},
+    }
+    # Modes: obs off, obs on, and an A/A CONTROL (a second obs-off
+    # orchestrator). The control's delta vs "off" is the measurement's own
+    # noise floor — episode-level timing on a shared/freq-scaled host can
+    # swing ~±10% between IDENTICAL configs (measured round 7), so an
+    # overhead_pct smaller than aa_noise_pct is a bound, not a difference.
+    # The structural per-sample cost is pinned separately by
+    # ``bench_obs_sample_cost`` (µs per sampled boundary).
+    for k in factors:
+        with tempfile.TemporaryDirectory() as d:
+            orchs: dict[str, Orchestrator] = {}
+            for mode in ("off", "on", "control"):
+                cfg = FrameworkConfig()
+                cfg.learner.algo = "qlearn"
+                cfg.parallel.num_workers = 10  # reference noOfChildren
+                cfg.env.window = 32
+                cfg.runtime.chunk_steps = 50
+                cfg.runtime.megachunk_factor = k
+                # Checkpoint/eval cadences off: measure the chunk loop, not
+                # disk IO shared by both modes.
+                cfg.runtime.checkpoint_every_updates = 0
+                cfg.runtime.keep_best_eval = False
+                cfg.runtime.checkpoint_dir = os.path.join(d, f"ckpts-{mode}")
+                cfg.obs.enabled = mode == "on"
+                cfg.obs.dir = os.path.join(d, f"obs-{mode}")
+                series = synthetic_price_series(
+                    length=cfg.env.window + chunks * cfg.runtime.chunk_steps
+                    + 8)
+                orch = Orchestrator(cfg)
+                orch.send_training_data(series.prices)
+                # Episode 1: compile + warm. Later start_training calls
+                # re-arm from COMPLETED and reuse the jitted step.
+                orch.start_training(background=False)
+                orchs[mode] = orch
+            # Trials interleave the modes and take MEDIANS — a sequential
+            # per-mode layout hands whichever mode runs first a different
+            # host frequency/cache regime, and best-of-N keeps whichever
+            # mode got the one lucky window (the bench_dispatch_floor
+            # lesson, plus the A/A control above).
+            times: dict[str, list[float]] = {m: [] for m in orchs}
+            for _ in range(max(1, trials)):
+                for mode, orch in orchs.items():
+                    t0 = time.perf_counter()
+                    orch.start_training(background=False)
+                    times[mode].append(time.perf_counter() - t0)
+            for orch in orchs.values():
+                orch.stop()
+            med = {m: statistics.median(ts) for m, ts in times.items()}
+            row = {f"{m}_s": round(v, 4) for m, v in med.items()}
+            row["overhead_pct"] = round(
+                100.0 * (med["on"] / med["off"] - 1.0), 2)
+            row["aa_noise_pct"] = round(
+                100.0 * (med["control"] / med["off"] - 1.0), 2)
+            out["rows"][f"k{k}"] = row
+    return out
+
+
+def bench_obs_sample_cost(samples: int = 20000) -> dict:
+    """Structural per-sample telemetry cost, measured directly: the exact
+    obs operations the orchestrator adds at ONE sampled metrics boundary
+    (3 spans + 1 flight-ring record of a 14-key row, including the
+    buffered JSON encode and periodic file flush). Divide by
+    ``metrics_every_chunks`` × chunk seconds for the hot-loop fraction —
+    the number episode-level timing cannot resolve under host noise
+    (``bench_obs_overhead``'s aa_noise_pct column)."""
+    import os
+    import tempfile
+
+    from sharetrade_tpu.obs import build_obs
+    from sharetrade_tpu.utils.metrics import MetricsRegistry
+
+    with tempfile.TemporaryDirectory() as d:
+        cfg = FrameworkConfig()
+        cfg.obs.enabled = True
+        cfg.obs.dir = os.path.join(d, "obs")
+        cfg.obs.export_interval_s = 3600  # isolate the sample path
+        obs = build_obs(cfg, MetricsRegistry())
+        row = {f"m{i}": float(i) for i in range(14)}
+        t0 = time.perf_counter()
+        for i in range(samples):
+            with obs.span("dispatch", chunk=i, k=1):
+                pass
+            with obs.span("readback", chunk=i, k=1):
+                pass
+            with obs.span("host_process", chunk=i, k=1):
+                pass
+            obs.record("chunk_metrics", chunk=i, **row)
+        per_sample_us = (time.perf_counter() - t0) / samples * 1e6
+        obs.close()
+    return {
+        "metric": "obs_per_sample_cost",
+        "samples": samples,
+        "per_sample_us": round(per_sample_us, 2),
+    }
+
+
 def _await_devices(attempts: int = 3, timeout_s: float = 180.0,
                    backoff_s: float = 30.0) -> None:
     """Fail LOUDLY — but not eagerly — when device discovery hangs (a dead
@@ -388,6 +510,8 @@ def main() -> None:
     result["large_model"] = bench_large_model()
     result["prior_flagship_b128"] = bench_prior_flagship_b128()
     result["dispatch_floor"] = bench_dispatch_floor()
+    result["obs_overhead"] = bench_obs_overhead()
+    result["obs_overhead"]["per_sample"] = bench_obs_sample_cost()
     print(json.dumps(result), flush=True)
 
 

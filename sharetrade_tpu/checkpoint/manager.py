@@ -19,6 +19,7 @@ the caller's ``device_put``/shardings dictate.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import queue
@@ -39,7 +40,7 @@ _PREFIX = "ckpt_"
 
 
 class CheckpointManager:
-    def __init__(self, directory: str, *, keep: int = 3):
+    def __init__(self, directory: str, *, keep: int = 3, tracer: Any = None):
         self.directory = directory
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
@@ -47,11 +48,25 @@ class CheckpointManager:
         self._queue: queue.Queue | None = None
         self._inflight = 0                   # queued + mid-write async saves
         self._cv = threading.Condition()
+        # Optional obs SpanTracer (settable post-construction): save/restore
+        # phases land in the host trace timeline — including writes on the
+        # async worker thread (the tracer is thread-safe).
+        self.tracer = tracer
+
+    def _span(self, name: str, **args: Any):
+        if self.tracer is None:
+            return contextlib.nullcontext()
+        return self.tracer.span(name, **args)
 
     # ---- save ----
 
     def save(self, step: int, train_state: Any,
              metadata: dict[str, Any] | None = None) -> str:
+        with self._span("checkpoint_save", step=int(step)):
+            return self._save(step, train_state, metadata)
+
+    def _save(self, step: int, train_state: Any,
+              metadata: dict[str, Any] | None = None) -> str:
         host_state = jax.device_get(train_state)
         payload = serialization.to_bytes(host_state)
         meta = {"step": int(step), "saved_at": time.time(),
@@ -139,10 +154,11 @@ class CheckpointManager:
         mandatory, because donated-input steps will free these buffers on
         the next chunk — then serialization + disk IO run on a worker
         thread. Call :meth:`wait_pending` before reading the directory."""
-        for leaf in jax.tree.leaves(train_state):
-            if hasattr(leaf, "copy_to_host_async"):
-                leaf.copy_to_host_async()
-        host_state = jax.device_get(train_state)  # fast: DMAs already in flight
+        with self._span("checkpoint_snapshot", step=int(step)):
+            for leaf in jax.tree.leaves(train_state):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+            host_state = jax.device_get(train_state)  # fast: DMAs in flight
         # device_get can return ZERO-COPY views of the runtime's buffers
         # (owndata=False on the CPU backend). The caller's next donated-input
         # step frees/reuses those buffers while the writer thread is still
@@ -206,10 +222,12 @@ class CheckpointManager:
             if step is None:
                 raise FileNotFoundError(
                     f"no checkpoints under {self.directory}")
-        path = os.path.join(self.directory, f"{_PREFIX}{step:010d}")
-        with open(os.path.join(path, "state.msgpack"), "rb") as f:
-            payload = f.read()
-        state = serialization.from_bytes(jax.device_get(template), payload)
+        with self._span("checkpoint_restore", step=int(step)):
+            path = os.path.join(self.directory, f"{_PREFIX}{step:010d}")
+            with open(os.path.join(path, "state.msgpack"), "rb") as f:
+                payload = f.read()
+            state = serialization.from_bytes(
+                jax.device_get(template), payload)
         log.info("restored checkpoint step=%d", step)
         return state, step
 

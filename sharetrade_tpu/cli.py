@@ -9,6 +9,7 @@ portfolio aggregation plus throughput.
 
     python -m sharetrade_tpu.cli train [--config cfg.json] [--set k=v ...]
     python -m sharetrade_tpu.cli query --config cfg.json   # inspect data layer
+    python -m sharetrade_tpu.cli obs --dir obs             # summarize a run dir
 """
 
 from __future__ import annotations
@@ -121,6 +122,27 @@ def cmd_train(args) -> int:
         service.close()
 
 
+def cmd_obs(args) -> int:
+    """Summarize a telemetry run dir (obs.enabled=true output): manifest
+    identity, span aggregates from the Chrome trace, metrics tail, and the
+    flight-recorder verdict when a bundle was dumped."""
+    import os
+
+    from sharetrade_tpu.obs import summarize_run_dir
+
+    if not os.path.isdir(args.dir):
+        log.error("no run dir at %s (train with --set obs.enabled=true "
+                  "--set obs.dir=%s first)", args.dir, args.dir)
+        return 1
+    summary = summarize_run_dir(args.dir)
+    if len(summary) <= 1:   # only {"run_dir": ...}: nothing telemetric inside
+        log.error("%s contains no telemetry artifacts "
+                  "(manifest.json/trace.jsonl/metrics.jsonl)", args.dir)
+        return 1
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
 def cmd_query(args) -> int:
     cfg = _load_config(args)
     service = PriceDataService(config=cfg.data)
@@ -162,6 +184,11 @@ def main(argv=None) -> int:
                            help="also evaluate the retained best-eval "
                                 "checkpoint (runtime.keep_best_eval)")
         p.set_defaults(fn=fn)
+
+    p = sub.add_parser("obs", help="summarize a telemetry run dir")
+    p.add_argument("--dir", default="obs",
+                   help="run dir written by a train run with obs.enabled")
+    p.set_defaults(fn=cmd_obs)
 
     args = parser.parse_args(argv)
     configure()

@@ -285,6 +285,44 @@ class RuntimeConfig:
 
 
 @dataclass
+class ObsConfig:
+    """Telemetry (obs/): span trace, metrics export, crash flight recorder.
+
+    Everything is OFF by default: a run with ``enabled=False`` creates no
+    directories, opens no files, and adds no measurable hot-loop cost
+    (pinned by tests/test_obs.py; measured <2% by bench.py
+    ``bench_obs_overhead`` — BASELINE.md "Telemetry overhead"). All
+    instrumentation rides the existing ``runtime.metrics_every_chunks``
+    sampling cadence and reads only host-side values from the batched
+    megachunk readback — enabling obs adds NO new device syncs
+    (tools/lint_hot_loop.py stays the guard)."""
+
+    enabled: bool = False
+    # Run directory: manifest.json, trace.jsonl, metrics.jsonl,
+    # metrics.prom, and (on failure) flight_recorder.json land here.
+    dir: str = "obs"
+    # Host span trace (dispatch / readback / host_process / checkpoint /
+    # recovery phases) in Chrome trace-event format — open the file at
+    # https://ui.perfetto.dev or chrome://tracing.
+    trace: bool = True
+    # Background MetricsRegistry drain: append-only metrics.jsonl history
+    # plus an atomically-rewritten Prometheus textfile snapshot.
+    metrics_export: bool = True
+    export_interval_s: float = 2.0
+    # Bounded ring of recent chunk metrics / lifecycle transitions /
+    # WARNING+ log lines, dumped as flight_recorder.json when supervision
+    # trips, the NaN-loss guard fires, or the run escalates.
+    flight_recorder: bool = True
+    flight_capacity: int = 256
+    # Soak-run growth caps (active regardless of ``enabled`` — they bound
+    # the IN-MEMORY primitives, not the exported files). Short runs never
+    # reach them, so default behavior is unchanged; 0 = unbounded (the
+    # pre-cap behavior, growing without limit on long runs).
+    max_metric_points: int = 65536     # per-series ring in MetricsRegistry
+    max_timer_history: int = 65536     # StepTimer per-sample history ring
+
+
+@dataclass
 class FrameworkConfig:
     data: DataConfig = field(default_factory=DataConfig)
     env: EnvConfig = field(default_factory=EnvConfig)
@@ -292,6 +330,7 @@ class FrameworkConfig:
     learner: LearnerConfig = field(default_factory=LearnerConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
     seed: int = 0
 
     # ---- serialization ----
@@ -369,4 +408,5 @@ _NESTED = {
     "learner": LearnerConfig,
     "parallel": ParallelConfig,
     "runtime": RuntimeConfig,
+    "obs": ObsConfig,
 }

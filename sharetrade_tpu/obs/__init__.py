@@ -1,0 +1,183 @@
+"""Unified telemetry (SURVEY.md §5: the subsystem the reference lacks).
+
+Three persistent surfaces over the existing in-memory primitives, all
+gated by ``ObsConfig`` (everything off by default — zero files, near-zero
+hot-loop cost when disabled):
+
+- :mod:`trace` — host span tracer → ``trace.jsonl`` (Chrome trace events;
+  open in Perfetto / chrome://tracing);
+- :mod:`exporter` — background drain of :class:`MetricsRegistry` →
+  ``metrics.jsonl`` + Prometheus textfile ``metrics.prom``;
+- :mod:`flight` — bounded ring of recent chunk metrics / lifecycle /
+  log events → ``flight_recorder.json`` forensic bundle on failure;
+- :mod:`manifest` — run identity (``manifest.json``: config hash, mesh,
+  backend, git rev) written at construction.
+
+The :class:`Obs` facade is what the orchestrator holds; a disabled instance
+is inert (``span()`` hands back a shared null context, ``record()`` returns
+immediately) so the hot loop never branches on more than ``obs.enabled``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any
+
+from sharetrade_tpu.obs.exporter import MetricsExporter  # noqa: F401
+from sharetrade_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    RingLogHandler,
+)
+from sharetrade_tpu.obs.manifest import build_manifest, write_manifest  # noqa: F401
+from sharetrade_tpu.obs.trace import SpanTracer, read_trace  # noqa: F401
+
+FLIGHT_BUNDLE = "flight_recorder.json"
+
+
+class Obs:
+    """Facade over tracer / exporter / flight recorder for one run dir."""
+
+    def __init__(self, *, run_dir: str | None = None,
+                 tracer: SpanTracer | None = None,
+                 exporter: MetricsExporter | None = None,
+                 flight: FlightRecorder | None = None,
+                 log_handler: RingLogHandler | None = None):
+        self.run_dir = run_dir
+        self.enabled = run_dir is not None
+        self.tracer = tracer if tracer is not None else SpanTracer(None)
+        self.exporter = exporter
+        # obs.flight_recorder=false means NO ring feeding and NO bundle —
+        # the attribute stays a (never-dumped) recorder so attribute access
+        # is uniform, but record()/dump_flight() gate on _flight_on.
+        self._flight_on = self.enabled and flight is not None
+        self.flight = flight if flight is not None else FlightRecorder(1)
+        self._log_handler = log_handler
+        self._closed = False
+
+    # -- hot-loop surface ------------------------------------------------
+
+    def span(self, name: str, **args: Any):
+        return self.tracer.span(name, **args)
+
+    def record(self, kind: str, **payload: Any) -> None:
+        if self._flight_on:
+            self.flight.record(kind, **payload)
+
+    # -- failure path ----------------------------------------------------
+
+    def dump_flight(self, *, reason: str, **context: Any) -> str | None:
+        """Write the forensic bundle into the run dir; None when the flight
+        recorder (or obs entirely) is disabled."""
+        if not self._flight_on:
+            return None
+        path = os.path.join(self.run_dir, FLIGHT_BUNDLE)
+        out = self.flight.dump(path, reason=reason, **context)
+        self.tracer.instant("flight_recorder_dump", reason=reason)
+        return out
+
+    # -- lifecycle -------------------------------------------------------
+
+    def flush(self) -> None:
+        """Make everything durable without ending the run (terminal loop
+        states flush; only Orchestrator.stop()/close() tear down)."""
+        if not self.enabled:
+            return
+        self.tracer.flush()
+        if self.exporter is not None:
+            try:
+                self.exporter.drain()
+            except Exception:
+                pass            # export IO never outranks the run itself
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.exporter is not None:
+            self.exporter.stop()
+        self.tracer.close()
+        if self._log_handler is not None:
+            logging.getLogger("sharetrade").removeHandler(self._log_handler)
+            self._log_handler = None
+
+
+def build_obs(cfg: Any, registry: Any, *, mesh: Any = None) -> Obs:
+    """Construct the run's telemetry from ``cfg.obs``; inert when disabled
+    (no directory is created, nothing is opened)."""
+    oc = cfg.obs
+    if not oc.enabled:
+        return Obs()
+    run_dir = oc.dir
+    os.makedirs(run_dir, exist_ok=True)
+    write_manifest(os.path.join(run_dir, "manifest.json"), cfg, mesh=mesh)
+    tracer = SpanTracer(os.path.join(run_dir, "trace.jsonl")
+                        if oc.trace else None)
+    exporter = None
+    if oc.metrics_export:
+        exporter = MetricsExporter(registry, run_dir,
+                                   interval_s=oc.export_interval_s)
+        exporter.start()
+    flight = log_handler = None
+    if oc.flight_recorder:
+        flight = FlightRecorder(oc.flight_capacity)
+        log_handler = RingLogHandler(flight)
+        logging.getLogger("sharetrade").addHandler(log_handler)
+    return Obs(run_dir=run_dir, tracer=tracer, exporter=exporter,
+               flight=flight, log_handler=log_handler)
+
+
+def summarize_run_dir(run_dir: str) -> dict:
+    """The ``cli obs`` summary: what a run dir contains, condensed to one
+    JSON object (manifest identity, span aggregates, metrics tail, flight
+    bundle verdict)."""
+    out: dict[str, Any] = {"run_dir": run_dir}
+    manifest_path = os.path.join(run_dir, "manifest.json")
+    if os.path.isfile(manifest_path):
+        with open(manifest_path, encoding="utf-8") as f:
+            m = json.load(f)
+        out["manifest"] = {k: m.get(k) for k in (
+            "config_hash", "backend", "device_count", "mesh_shape",
+            "git_rev", "created_at")}
+    trace_path = os.path.join(run_dir, "trace.jsonl")
+    if os.path.isfile(trace_path):
+        spans: dict[str, dict[str, float]] = {}
+        for ev in read_trace(trace_path):
+            if ev.get("ph") != "X":
+                continue
+            agg = spans.setdefault(ev["name"].split(":")[0],
+                                   {"count": 0, "total_ms": 0.0})
+            agg["count"] += 1
+            agg["total_ms"] += ev.get("dur", 0.0) / 1e3
+        out["trace"] = {
+            name: {"count": int(a["count"]),
+                   "total_ms": round(a["total_ms"], 3),
+                   "mean_ms": round(a["total_ms"] / a["count"], 3)}
+            for name, a in sorted(spans.items())}
+    metrics_path = os.path.join(run_dir, "metrics.jsonl")
+    if os.path.isfile(metrics_path):
+        last = None
+        drains = 0
+        with open(metrics_path, encoding="utf-8") as f:
+            for line in f:
+                if line.strip():
+                    drains += 1
+                    last = line
+        out["metrics"] = {
+            "drains": drains,
+            "last": json.loads(last) if last else None,
+            "prom_file": os.path.isfile(
+                os.path.join(run_dir, "metrics.prom")),
+        }
+    flight_path = os.path.join(run_dir, FLIGHT_BUNDLE)
+    if os.path.isfile(flight_path):
+        with open(flight_path, encoding="utf-8") as f:
+            bundle = json.load(f)
+        out["flight_recorder"] = {
+            "reason": bundle.get("reason"),
+            "failing_chunk": bundle.get("failing_chunk"),
+            "context": bundle.get("context"),
+            "events": len(bundle.get("events", [])),
+        }
+    return out

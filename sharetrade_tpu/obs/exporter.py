@@ -1,0 +1,103 @@
+"""Metrics exporter: MetricsRegistry → JSONL history + Prometheus textfile.
+
+The registry holds everything in memory (it exists so mid-run queries never
+stop the device loop); this exporter makes that state durable and scrapeable
+without adding anything to the hot loop: a background thread drains
+``registry.snapshot()``/``registry.counters()`` every ``interval_s`` seconds
+into
+
+- ``metrics.jsonl`` — one append-only line per drain (the full time series
+  a notebook replays after the run), skipped when nothing changed;
+- ``metrics.prom`` — a Prometheus textfile-collector snapshot (gauges +
+  counters, atomically rewritten) for node_exporter-style scraping.
+
+The training thread never blocks on exporter IO; a crashed exporter write
+degrades observability, never the run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+
+from sharetrade_tpu.utils.logging import get_logger
+from sharetrade_tpu.utils.metrics import MetricsRegistry
+
+log = get_logger("obs.exporter")
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str, prefix: str) -> str:
+    return f"{prefix}_{_PROM_BAD.sub('_', name)}"
+
+
+class MetricsExporter:
+    def __init__(self, registry: MetricsRegistry, run_dir: str, *,
+                 interval_s: float = 2.0, prefix: str = "sharetrade"):
+        self._registry = registry
+        self._jsonl_path = os.path.join(run_dir, "metrics.jsonl")
+        self._prom_path = os.path.join(run_dir, "metrics.prom")
+        self._interval_s = max(0.05, float(interval_s))
+        self._prefix = prefix
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last: tuple[dict, dict] | None = None
+        self._io_lock = threading.Lock()   # drain() callable off-thread too
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="obs-exporter", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            try:
+                self.drain()
+            except Exception:   # exporter IO must never kill anything
+                log.exception("metrics export failed; will retry")
+
+    def drain(self) -> bool:
+        """One export pass; returns True when something was written."""
+        gauges = self._registry.snapshot()
+        counters = self._registry.counters()
+        with self._io_lock:
+            if (gauges, counters) == self._last:
+                return False
+            self._last = (gauges, counters)
+            record = {"ts": time.time(), "gauges": gauges,
+                      "counters": counters}
+            with open(self._jsonl_path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(record) + "\n")
+            self._write_prom(gauges, counters)
+        return True
+
+    def _write_prom(self, gauges: dict, counters: dict) -> None:
+        lines = []
+        for name, value in sorted(gauges.items()):
+            pname = _prom_name(name, self._prefix)
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {value}")
+        for name, value in sorted(counters.items()):
+            pname = _prom_name(name, self._prefix)
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname} {value}")
+        tmp = f"{self._prom_path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines) + ("\n" if lines else ""))
+        os.replace(tmp, self._prom_path)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        try:
+            self.drain()        # final snapshot always lands on disk
+        except Exception:
+            log.exception("final metrics export failed")

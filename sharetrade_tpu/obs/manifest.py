@@ -1,0 +1,66 @@
+"""Run manifest: the identity card every telemetry consumer needs first.
+
+One ``manifest.json`` per run dir, written at orchestrator construction:
+the full config plus a stable hash of it (so two run dirs are comparable at
+a glance), the device backend and mesh shape the run actually got, and the
+git revision of the code that produced the numbers. Everything is
+best-effort — a missing git binary or a detached workdir must not block
+training — and written atomically like every other obs artifact.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import platform
+import subprocess
+import sys
+import time
+from typing import Any
+
+
+def _git_rev() -> str | None:
+    try:
+        repo_dir = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=repo_dir, timeout=5,
+            capture_output=True, text=True)
+        return out.stdout.strip() or None if out.returncode == 0 else None
+    except Exception:
+        return None
+
+
+def build_manifest(cfg: Any, *, mesh: Any = None) -> dict:
+    cfg_dict = cfg.to_dict()
+    blob = json.dumps(cfg_dict, sort_keys=True).encode()
+    try:
+        import jax
+        backend = jax.default_backend()
+        device_count = jax.device_count()
+        jax_version = jax.__version__
+    except Exception:       # manifest must not force device discovery to work
+        backend, device_count, jax_version = None, None, None
+    return {
+        "created_at": time.time(),
+        "config_hash": hashlib.sha256(blob).hexdigest()[:16],
+        "config": cfg_dict,
+        "backend": backend,
+        "device_count": device_count,
+        "mesh_shape": dict(mesh.shape) if mesh is not None else None,
+        "git_rev": _git_rev(),
+        "jax_version": jax_version,
+        "python_version": sys.version.split()[0],
+        "hostname": platform.node(),
+        "pid": os.getpid(),
+    }
+
+
+def write_manifest(path: str, cfg: Any, *, mesh: Any = None) -> dict:
+    manifest = build_manifest(cfg, mesh=mesh)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=2, default=str)
+    os.replace(tmp, path)
+    return manifest

@@ -1,0 +1,134 @@
+"""Host-side span tracer emitting Chrome trace-event JSON.
+
+``jax.profiler`` device traces (utils/profiling.py Tracer) need TensorBoard/
+XProf to read their XPlane protos; this tracer is the complementary HOST
+timeline: orchestrator phases (dispatch, readback, host processing,
+checkpoint IO, supervision recovery) written as Chrome trace events that
+Perfetto (https://ui.perfetto.dev) or chrome://tracing load directly, no
+profiler runtime required.
+
+File format: the JSON Array Format of the Trace Event spec — an opening
+``[`` then one ``{event},`` per line. The spec makes the closing ``]``
+optional precisely so crashed writers still leave a loadable trace, which is
+also what makes the file greppable/tail-able like JSONL: every event is one
+self-contained line. Events are buffered and flushed every
+``flush_every`` records (and on close), so the hot loop pays a dict+append,
+not a syscall, per span.
+
+``SpanTracer(None)`` is the disabled instance: ``span()`` returns a shared
+null context and nothing is ever opened or written (the obs.enabled=false
+contract — zero files, near-zero cost).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any
+
+_NULL_CTX = contextlib.nullcontext()
+
+
+class _Span:
+    """One in-flight span; emits a complete ("ph": "X") event on exit."""
+
+    __slots__ = ("_tracer", "_name", "_args", "_t0")
+
+    def __init__(self, tracer: "SpanTracer", name: str, args: dict):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self) -> "_Span":
+        self._t0 = self._tracer._now_us()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        t1 = self._tracer._now_us()
+        self._tracer._emit({
+            "name": self._name, "ph": "X", "ts": self._t0,
+            "dur": t1 - self._t0, "pid": self._tracer._pid,
+            "tid": threading.get_ident(),
+            **({"args": self._args} if self._args else {}),
+        })
+
+
+class SpanTracer:
+    def __init__(self, path: str | None, *, flush_every: int = 64):
+        self._path = path
+        self._flush_every = max(1, flush_every)
+        self._lock = threading.Lock()
+        self._buf: list[str] = []
+        self._pid = os.getpid()
+        # Trace timestamps are microseconds on the perf_counter clock from
+        # tracer construction (Perfetto only needs them monotone/relative);
+        # wall-clock anchoring lives in the run manifest.
+        self._t0 = time.perf_counter()
+        self._fh = None
+        if path:
+            self._fh = open(path, "w", encoding="utf-8")
+            self._fh.write("[\n")
+
+    @property
+    def enabled(self) -> bool:
+        return self._fh is not None
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def span(self, name: str, **args: Any):
+        """Context manager timing one named phase; no-op when disabled."""
+        if self._fh is None:
+            return _NULL_CTX
+        return _Span(self, name, args)
+
+    def instant(self, name: str, **args: Any) -> None:
+        """Zero-duration marker (lifecycle transitions, dumps, restarts)."""
+        if self._fh is None:
+            return
+        self._emit({
+            "name": name, "ph": "i", "ts": self._now_us(), "s": "p",
+            "pid": self._pid, "tid": threading.get_ident(),
+            **({"args": args} if args else {}),
+        })
+
+    def _emit(self, event: dict) -> None:
+        with self._lock:
+            if self._fh is None:
+                return
+            self._buf.append(json.dumps(event))
+            if len(self._buf) >= self._flush_every:
+                self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if self._buf and self._fh is not None:
+            self._fh.write("".join(line + ",\n" for line in self._buf))
+            self._fh.flush()
+            self._buf.clear()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def close(self) -> None:
+        with self._lock:
+            self._flush_locked()
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+def read_trace(path: str) -> list[dict]:
+    """Parse a (possibly unterminated) JSON-Array-Format trace back into
+    event dicts — the reader the `cli obs` summary and tests share."""
+    with open(path, encoding="utf-8") as f:
+        content = f.read()
+    content = content.strip()
+    if not content or content == "[":
+        return []
+    if not content.endswith("]"):
+        content = content.rstrip(",") + "]"
+    return json.loads(content)

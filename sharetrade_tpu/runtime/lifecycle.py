@@ -62,12 +62,19 @@ _TRANSITIONS: dict[Phase, set[Phase]] = {
 
 
 class Lifecycle:
-    """Thread-safe phase holder with legal-transition enforcement."""
+    """Thread-safe phase holder with legal-transition enforcement.
+
+    ``on_transition`` (settable post-construction) observes every phase
+    change as ``on_transition(old, new)`` — invoked OUTSIDE the lock so an
+    observer that queries the lifecycle (the obs flight recorder / trace
+    markers) can never deadlock it.
+    """
 
     def __init__(self) -> None:
         self._phase = Phase.AWAITING_DATA
         self._lock = threading.Lock()
         self.start_requested = False  # the "stashed StartTraining" flag
+        self.on_transition = None     # callable (old, new) | None
 
     @property
     def phase(self) -> Phase:
@@ -82,8 +89,19 @@ class Lifecycle:
                 raise RuntimeError(
                     f"illegal lifecycle transition {self._phase.value} "
                     f"-> {new.value}")
-            self._phase = new
+            old, self._phase = self._phase, new
+        self._notify(old, new)
 
     def force(self, new: Phase) -> None:
         with self._lock:
-            self._phase = new
+            if new is self._phase:
+                return
+            old, self._phase = self._phase, new
+        self._notify(old, new)
+
+    def _notify(self, old: Phase, new: Phase) -> None:
+        if self.on_transition is not None:
+            try:
+                self.on_transition(old, new)
+            except Exception:
+                pass    # observability must never break the FSM

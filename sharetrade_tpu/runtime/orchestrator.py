@@ -36,6 +36,7 @@ owns:
 
 from __future__ import annotations
 
+import contextlib
 import random
 import threading
 import time
@@ -51,6 +52,7 @@ from sharetrade_tpu.checkpoint import CheckpointManager
 from sharetrade_tpu.config import ConfigError, FrameworkConfig
 from sharetrade_tpu.env import trading
 from sharetrade_tpu.env.portfolio import make_portfolio_env
+from sharetrade_tpu.obs import build_obs
 from sharetrade_tpu.parallel import build_mesh, make_parallel_step
 from sharetrade_tpu.runtime.lifecycle import Lifecycle, Phase, QueryReply, ReplyState
 from sharetrade_tpu.utils.logging import EventLog, get_logger
@@ -58,6 +60,9 @@ from sharetrade_tpu.utils.metrics import MetricsRegistry
 from sharetrade_tpu.utils.profiling import StepTimer, Tracer
 
 log = get_logger("runtime.orchestrator")
+
+#: Shared no-op context for un-sampled / obs-disabled span sites.
+_NULL_CTX = contextlib.nullcontext()
 
 
 #: Supervision verbs (the Akka directive vocabulary).
@@ -122,10 +127,25 @@ class Orchestrator:
                 cfg.runtime.metrics_every_chunks,
                 cfg.runtime.megachunk_factor)
         self.lifecycle = Lifecycle()
-        self.metrics = MetricsRegistry()
+        self.metrics = MetricsRegistry(
+            max_points=cfg.obs.max_metric_points)
+        # Telemetry (obs/): inert facade when cfg.obs.enabled is False —
+        # zero files, span() hands back a shared null context. All of the
+        # hot-loop instrumentation below rides the metrics_every_chunks
+        # sampling cadence and reads only host values that the batched
+        # megachunk readback already materialized (no new device syncs).
+        self.obs = build_obs(cfg, self.metrics, mesh=mesh)
         self.checkpoints = checkpoints or CheckpointManager(
             cfg.runtime.checkpoint_dir, keep=cfg.runtime.keep_checkpoints)
         self.events = event_log or EventLog(None)
+        if self.obs.enabled:
+            # Structured run events double into the flight ring (the tap),
+            # lifecycle transitions mark the trace timeline, and checkpoint
+            # save/restore phases span it from whichever thread writes.
+            self.events.mirror = self._obs_event_tap
+            self.lifecycle.on_transition = self._obs_phase_tap
+            if getattr(self.checkpoints, "tracer", None) is None:
+                self.checkpoints.tracer = self.obs.tracer
         self.tracer = Tracer(cfg.runtime.profile_dir)
         self._step_override = step_override
         self._fault_hook = fault_hook
@@ -170,6 +190,17 @@ class Orchestrator:
             if self._transitions_journal is None:
                 self._transitions_journal = _open_journal(
                     path, prefer_native=cfg.data.use_native_journal)
+
+    # ------------------------------------------------------------------
+    # telemetry taps (obs/): wired only when cfg.obs.enabled
+    # ------------------------------------------------------------------
+
+    def _obs_event_tap(self, kind: str, payload: dict) -> None:
+        self.obs.record("event", event=kind, **payload)
+
+    def _obs_phase_tap(self, old: Phase, new: Phase) -> None:
+        self.obs.record("lifecycle", frm=old.value, to=new.value)
+        self.obs.tracer.instant(f"phase:{new.value}")
 
     # ------------------------------------------------------------------
     # protocol: SendTrainingData (TrainerRouterActor.scala:77-81)
@@ -408,7 +439,9 @@ class Orchestrator:
         # K=1 exact path below. _build_step leaves _mega_fn None for the
         # host-side step_override seam.
         mega = rt.megachunk_factor if self._mega_fn is not None else 1
-        timer = StepTimer(rt.chunk_steps, self.cfg.parallel.num_workers)
+        timer = StepTimer(rt.chunk_steps, self.cfg.parallel.num_workers,
+                          max_history=self.cfg.obs.max_timer_history or None)
+        obs = self.obs
         self.tracer.start()
         # ONE batched readback seeds both the baseline-checkpoint label and
         # the env-step completion bound (formerly two scalar device_gets —
@@ -461,7 +494,21 @@ class Orchestrator:
                          and (last_env_steps + (chunks_since + mega)
                               * rt.chunk_steps) < threshold
                          else 1)
-                    with self.tracer.span(
+                    # Obs spans ride the SAMPLING cadence, not the chunk
+                    # cadence: only the dispatch whose readback will
+                    # materialize this sample is timed, so between samples
+                    # the fast path stays span-free (the <2% overhead
+                    # budget, bench_obs_overhead). The predicate mirrors
+                    # the sample decision below — chunk-count cadence, the
+                    # near-threshold exact path, or a transitions journal
+                    # (journaled runs materialize every chunk).
+                    sampling = obs.enabled and (
+                        chunks_since + k >= metrics_every
+                        or self._transitions_journal is not None
+                        or (last_env_steps + (chunks_since + k)
+                            * rt.chunk_steps) >= threshold)
+                    with (obs.span("dispatch", chunk=chunk_idx, k=k)
+                          if sampling else _NULL_CTX), self.tracer.span(
                             f"train_chunk_{chunk_idx}"
                             + (f"_x{k}" if k > 1 else "")):
                         # The step lock fences evaluate()'s state snapshot
@@ -507,7 +554,13 @@ class Orchestrator:
                     # The span covers the chunks the prefetch advances
                     # (chunk_idx + k onward) so the trace keeps one
                     # train_chunk_* entry per dispatch, not just the first.
-                    with self.tracer.span(f"train_chunk_{chunk_idx + k}_x{k}"):
+                    # The obs dispatch span mirrors that (this block only
+                    # runs at materialization boundaries, so it is already
+                    # on the sampled path).
+                    with (obs.span("dispatch", chunk=chunk_idx + k, k=k,
+                                   prefetch=True)
+                          if obs.enabled else _NULL_CTX), self.tracer.span(
+                            f"train_chunk_{chunk_idx + k}_x{k}"):
                         with self._step_lock:
                             ts, ahead = self._mega_fn(self._ts)
                             self._ts = ts
@@ -517,36 +570,48 @@ class Orchestrator:
                 # transition batch cross to the host together, replacing the
                 # per-chunk float(np.asarray(...)) scalar round-trips
                 # (tools/lint_hot_loop.py pins this).
-                host, host_tr = jax.device_get((metrics, transitions))  # hot-loop-sync-ok: THE batched megachunk readback
-                rows = _metric_rows(host, k)
-                base = chunk_idx
-                for i, row in enumerate(rows):
-                    if host_tr is not None:
-                        self._journal_transitions(
-                            jax.tree.map(lambda a: a[i], host_tr)
-                            if k > 1 else host_tr,
-                            int(row["env_steps"]))
-                    if self._fault_hook is not None:
-                        # Per inner chunk with its TRUE chunk index: a fault
-                        # landing mid-megachunk surfaces at the boundary but
-                        # is attributed (and, on raise, retried) at the
-                        # chunk that raised it.
-                        self._fault_hook(base + i, row)
-                    chunk_idx = base + i + 1
-                    if i + 1 < k:
-                        # Inner (non-boundary) rows keep the per-chunk
-                        # metric stream complete — delivered late, at the
-                        # boundary; snapshot/supervision/cadence below read
-                        # the boundary row, which subsumes them (quarantine
-                        # and counters are monotone within a megachunk).
-                        self.metrics.record_many(row)
-                metrics = rows[-1]
-                metrics.update(timer.tick(chunks_since))
-                last_env_steps = int(metrics["env_steps"])
-                chunks_since = 0
-                with self._snapshot_lock:
-                    self._snapshot = metrics
-                self.metrics.record_many(metrics)
+                with (obs.span("readback", chunk=chunk_idx, k=k)
+                      if obs.enabled else _NULL_CTX):
+                    host, host_tr = jax.device_get((metrics, transitions))  # hot-loop-sync-ok: THE batched megachunk readback
+                with (obs.span("host_process", chunk=chunk_idx, k=k)
+                      if obs.enabled else _NULL_CTX):
+                    rows = _metric_rows(host, k)
+                    base = chunk_idx
+                    for i, row in enumerate(rows):
+                        if obs.enabled:
+                            # Into the flight ring BEFORE the fault hook /
+                            # health checks that can raise on this row: at
+                            # dump time the ring's newest chunk_metrics
+                            # entry IS the failing chunk.
+                            obs.record("chunk_metrics", chunk=base + i,
+                                       **row)
+                        if host_tr is not None:
+                            self._journal_transitions(
+                                jax.tree.map(lambda a: a[i], host_tr)
+                                if k > 1 else host_tr,
+                                int(row["env_steps"]))
+                        if self._fault_hook is not None:
+                            # Per inner chunk with its TRUE chunk index: a
+                            # fault landing mid-megachunk surfaces at the
+                            # boundary but is attributed (and, on raise,
+                            # retried) at the chunk that raised it.
+                            self._fault_hook(base + i, row)
+                        chunk_idx = base + i + 1
+                        if i + 1 < k:
+                            # Inner (non-boundary) rows keep the per-chunk
+                            # metric stream complete — delivered late, at
+                            # the boundary; snapshot/supervision/cadence
+                            # below read the boundary row, which subsumes
+                            # them (quarantine and counters are monotone
+                            # within a megachunk).
+                            self.metrics.record_many(row)
+                    metrics = rows[-1]
+                    metrics.update(timer.tick(chunks_since))
+                    last_env_steps = int(metrics["env_steps"])
+                    chunks_since = 0
+                    with self._snapshot_lock:
+                        self._snapshot = metrics
+                    self.metrics.record_many(metrics)
 
                 workers = self.cfg.parallel.num_workers
                 if (rt.partial_recovery
@@ -602,6 +667,7 @@ class Orchestrator:
                     # count past horizon-per-episode.
                     self.checkpoints.save_async(
                         updates, self._ts, metadata={"episode": self.episode})
+                    self.metrics.inc("checkpoints_total")
                     self.events.emit("checkpoint", updates=updates)
                 last_ckpt_updates = updates
 
@@ -626,6 +692,7 @@ class Orchestrator:
                                + stranded >= workers)
                 if done_steps and all_trained:
                     self.episode += 1
+                    self.metrics.inc("episodes_completed_total")
                     if self.episode < rt.episodes:
                         # Re-arm for another pass over the history, keeping
                         # learned parameters (the Initialise→Train cycle,
@@ -644,6 +711,7 @@ class Orchestrator:
                                      env_steps=int(metrics["env_steps"]),
                                      episodes=self.episode,
                                      **timer.summary())
+                    obs.flush()   # trace + final metrics drain durable now
                     log.info("training completed at %d env steps", horizon)
                     return
                 if (not rt.partial_recovery
@@ -666,6 +734,14 @@ class Orchestrator:
                 verb = self._decide(exc)
                 self.events.emit("worker_failed", error=repr(exc), verb=verb,
                                  restarts=self.restarts + 1)
+                # Forensic bundle BEFORE any recovery mutates state: the
+                # ring holds the last-capacity chunk rows (its newest
+                # chunk_metrics entry is the failing chunk — rows are
+                # recorded before the hooks that raise on them),
+                # lifecycle transitions, run events and WARNING+ logs.
+                obs.dump_flight(reason="supervision", error=repr(exc),
+                                verb=verb, restarts=self.restarts,
+                                episode=self.episode, next_chunk=chunk_idx)
                 if verb == RESUME:
                     log.warning("resuming after %r (policy: resume)", exc)
                     self._ensure_live_state()
@@ -674,16 +750,20 @@ class Orchestrator:
                 if verb == STOP:
                     self.lifecycle.force(Phase.FAILED)
                     self.tracer.stop()
+                    obs.flush()
                     log.error("stopping after %r (policy: stop)", exc)
                     return
                 if verb == ESCALATE:
                     self.lifecycle.force(Phase.FAILED)
                     self.tracer.stop()
+                    obs.flush()
                     raise
                 self.restarts += 1
+                self.metrics.inc("restarts_total")
                 if self.restarts > rt.max_restarts:
                     self.lifecycle.force(Phase.FAILED)
                     self.tracer.stop()
+                    obs.flush()
                     log.error("restart budget exhausted: %r", exc)
                     return
                 delay = min(rt.backoff_initial_s * 2 ** (self.restarts - 1),
@@ -692,9 +772,12 @@ class Orchestrator:
                                               rt.backoff_jitter)
                 log.warning("chunk failed (%r); restart %d/%d in %.2fs",
                             exc, self.restarts, rt.max_restarts, delay)
-                if self._stop.wait(delay):
-                    return
-                self._restore_or_reinit()
+                with obs.span("supervision_recovery",
+                              restart=self.restarts) \
+                        if obs.enabled else _NULL_CTX:
+                    if self._stop.wait(delay):
+                        return
+                    self._restore_or_reinit()
                 # Exclude the failed chunk + backoff + restore from the
                 # next throughput sample.
                 timer.rebase()
@@ -797,6 +880,7 @@ class Orchestrator:
             env_state=jax.tree.map(splice, ts.env_state, fresh_env),
             carry=jax.tree.map(splice, ts.carry, fresh_carry)))
         self.agent_heals += 1
+        self.metrics.inc("heals_total")
         idx = [int(i) for i in np.flatnonzero(bad)]
         log.warning("respawned poisoned agent row(s) %s in place "
                     "(heal %d; params untouched)", idx, self.agent_heals)
@@ -1095,6 +1179,9 @@ class Orchestrator:
         if self._transitions_journal is not None:
             self._transitions_journal.close()
             self._transitions_journal = None
+        # Telemetry teardown LAST: the final exporter drain and trace flush
+        # see everything the run wrote, including its shutdown events.
+        self.obs.close()
 
     def _snapshot_ts(self) -> TrainState:
         """Copy the live TrainState under the step lock. Both step paths

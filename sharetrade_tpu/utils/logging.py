@@ -56,14 +56,25 @@ class EventLog:
     Used by the runtime for lifecycle transitions, restarts, checkpoints —
     the machine-readable counterpart of the reference's lifecycle log lines
     (e.g. TrainerRouterActor.scala:70,87,128).
+
+    ``mirror`` (settable post-construction) receives every emitted event as
+    ``mirror(kind, payload)`` even when no file is attached — the tap the
+    obs flight recorder rides so supervision/lifecycle events land in the
+    crash ring without a second emit call at every site.
     """
 
     def __init__(self, path: str | None):
         self._path = path
         self._lock = threading.Lock()
         self._fh = open(path, "a", buffering=1) if path else None
+        self.mirror: Any | None = None
 
     def emit(self, kind: str, **payload: Any) -> None:
+        if self.mirror is not None:
+            try:
+                self.mirror(kind, payload)
+            except Exception:
+                pass        # a broken tap must never block the event log
         if self._fh is None:
             return
         record = {"ts": time.time(), "kind": kind, **payload}

@@ -5,6 +5,15 @@ The reference's only "metrics" are the final avg/std portfolio aggregations
 thread-safe scalar series with snapshot reads, so the orchestrator can answer
 status queries mid-run without stopping the device loop (the reference answers
 GetAvg mid-run from trained workers, TrainerRouterActorSpec.scala:81-95).
+
+Two kinds of values:
+
+- **gauges** (``record``/``record_many``) — point-in-time series, each
+  bounded by a per-series ring (``max_points``; soak runs can no longer grow
+  the host heap without limit, short runs never reach the cap);
+- **counters** (``inc``/``counters``) — monotonic totals (``restarts_total``,
+  ``heals_total``, ...), the Prometheus-counter half of the obs exporter's
+  output.
 """
 
 from __future__ import annotations
@@ -12,15 +21,27 @@ from __future__ import annotations
 import math
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Any
+
+#: Default per-series ring size: far beyond any short run (a full
+#: reference-shape episode samples ~30 rows), small enough that a week-long
+#: soak holds megabytes, not the run's whole history, in memory.
+DEFAULT_MAX_POINTS = 65536
 
 
 class MetricsRegistry:
-    def __init__(self) -> None:
+    def __init__(self, *, max_points: int | None = DEFAULT_MAX_POINTS) -> None:
         self._lock = threading.Lock()
-        self._series: dict[str, list[tuple[float, float]]] = defaultdict(list)
+        # None/0 = unbounded (the pre-cap behavior, opt-in via config).
+        self._maxlen = int(max_points) if max_points else None
+        self._series: dict[str, deque[tuple[float, float]]] = defaultdict(
+            self._new_series)
         self._latest: dict[str, float] = {}
+        self._counters: dict[str, float] = {}
+
+    def _new_series(self) -> deque:
+        return deque(maxlen=self._maxlen)
 
     def record(self, name: str, value: float, *, ts: float | None = None) -> None:
         ts = time.time() if ts is None else ts
@@ -30,9 +51,30 @@ class MetricsRegistry:
             self._latest[name] = value
 
     def record_many(self, values: dict[str, float]) -> None:
+        """Record a whole metrics row under ONE lock acquisition (the
+        per-sample hot-loop write path: a lock round-trip per key showed up
+        once rows grew to ~10 keys x K megachunk rows per sample)."""
         ts = time.time()
-        for name, value in values.items():
-            self.record(name, value, ts=ts)
+        with self._lock:
+            for name, value in values.items():
+                value = float(value)
+                self._series[name].append((ts, value))
+                self._latest[name] = value
+
+    # ---- counters (monotonic) ----
+
+    def inc(self, name: str, amount: float = 1.0) -> float:
+        """Increment a monotonic counter; returns the new total."""
+        with self._lock:
+            total = self._counters.get(name, 0.0) + float(amount)
+            self._counters[name] = total
+            return total
+
+    def counters(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._counters)
+
+    # ---- reads ----
 
     def latest(self, name: str, default: float | None = None) -> float | None:
         with self._lock:
@@ -48,7 +90,8 @@ class MetricsRegistry:
 
     def summary(self, name: str) -> dict[str, float]:
         """Mean/std/min/max/count over a series — the avg/std aggregation the
-        reference computes over worker portfolios, generalized."""
+        reference computes over worker portfolios, generalized. (Over the
+        RETAINED ring when the series has been capped.)"""
         values = [v for _, v in self.series(name)]
         if not values:
             return {"count": 0.0}

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextlib
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 import jax
@@ -74,8 +75,17 @@ class StepTimer:
     _last: float | None = None
     # (elapsed seconds, chunks covered) per tick: the orchestrator's sampled
     # metrics cadence ticks once per SAMPLE, covering several dispatched
-    # chunks, so each entry carries its own chunk count.
+    # chunks, so each entry carries its own chunk count. Bounded by
+    # ``max_history`` (a ring; soak runs previously grew this without
+    # limit) — summary() stays EXACT under eviction via the running totals.
     history: list[tuple[float, int]] = field(default_factory=list)
+    max_history: int | None = None
+    _total_seconds: float = 0.0
+    _total_chunks: int = 0
+
+    def __post_init__(self) -> None:
+        if self.max_history:
+            self.history = deque(self.history, maxlen=int(self.max_history))
 
     def tick(self, chunks: int = 1) -> dict[str, float]:
         """Call once per completed chunk — or once per metrics sample with
@@ -88,6 +98,8 @@ class StepTimer:
         dt = now - self._last
         self._last = now
         self.history.append((dt, chunks))
+        self._total_seconds += dt
+        self._total_chunks += chunks
         agent_steps = self.chunk_steps * self.num_agents * chunks
         return {
             "chunk_seconds": dt / chunks,
@@ -104,10 +116,12 @@ class StepTimer:
         self._last = time.perf_counter()
 
     def summary(self) -> dict[str, float]:
-        if not self.history:
+        if not self._total_chunks:
             return {}
-        total = sum(dt for dt, _ in self.history)
-        chunks = sum(n for _, n in self.history)
+        # Running totals, not the (possibly ring-evicted) history: the
+        # whole-run aggregates stay exact no matter how long the soak.
+        total = self._total_seconds
+        chunks = self._total_chunks
         return {
             "chunks_timed": float(chunks),
             "total_seconds": total,

@@ -1,20 +1,33 @@
 #!/usr/bin/env python
-"""Grep-lint for the orchestrator's training hot loop.
+"""Grep-lint for the orchestrator's training hot loop and the device code.
 
-The megachunk refactor (runtime/orchestrator.py _run_supervised) replaced
-the per-chunk scalar device round-trips — ``jax.device_get(ts.updates)``,
-``float(np.asarray(v))`` per metric key — with ONE batched readback per
-(mega)chunk sample; each stray scalar sync costs a full device round-trip
-that serializes the dispatch pipeline (~0.1 s on tunneled links, about the
-price of an entire flagship chunk, BASELINE.md). This lint keeps the loop
-clean: it FAILS when a bare ``device_get(`` / ``float(np.asarray`` /
-``block_until_ready(`` reappears inside the hot-loop functions without the
-explicit ``hot-loop-sync-ok`` marker naming why that sync is off the
-per-chunk path (pre-loop seed, once-per-recovery resync, or THE batched
-megachunk readback itself).
+Two checks, both run by ``make check``/``make lint`` and the tier-1 guard
+in tests/test_megachunk.py:
 
-Run directly, via ``make check``, or through the tier-1 guard in
-tests/test_megachunk.py.
+1. **Hot-loop syncs** — the megachunk refactor (runtime/orchestrator.py
+   _run_supervised) replaced the per-chunk scalar device round-trips —
+   ``jax.device_get(ts.updates)``, ``float(np.asarray(v))`` per metric key
+   — with ONE batched readback per (mega)chunk sample; each stray scalar
+   sync costs a full device round-trip that serializes the dispatch
+   pipeline (~0.1 s on tunneled links, about the price of an entire
+   flagship chunk, BASELINE.md). FAILS when a bare ``device_get(`` /
+   ``float(np.asarray`` / ``block_until_ready(`` reappears inside the
+   hot-loop functions without the explicit ``hot-loop-sync-ok`` marker
+   naming why that sync is off the per-chunk path.
+
+2. **Host calls in traced step code** (the obs PR's guard) — inside the
+   device packages (agents/env/models/ops) the traced step bodies are
+   NESTED functions (closures handed to ``jax.jit``/``lax.scan``). A
+   ``time.time()`` / ``time.perf_counter()`` / ``log.*()`` / ``print()``
+   there does not do what it reads as doing: it runs ONCE at trace time,
+   freezing its value into the compiled program (a timestamp constant, a
+   once-per-retrace log line) — never the per-step signal the author
+   expected, and a retrace-cadence host side effect besides. Telemetry
+   belongs on the host side of the chunk boundary (obs/), keyed off the
+   batched readback. FAILS on such calls inside any nested function of
+   those packages unless the line carries ``jit-host-call-ok`` naming why
+   it is trace-time-only on purpose (``jax.debug.print`` is exempt — the
+   dotted call never matches).
 """
 
 from __future__ import annotations
@@ -35,8 +48,19 @@ PATTERN = re.compile(
 #: its sync is not a per-chunk cost.
 MARKER = "hot-loop-sync-ok"
 
+#: Device-code packages whose NESTED functions are the jit/scan-traced step
+#: bodies (closures built by module-level factories).
+DEVICE_PACKAGES = ("agents", "env", "models", "ops")
+#: Host side effects that silently become trace-time constants inside a
+#: compiled program. ``jax.debug.print(`` stays legal: the dotted call
+#: never matches the lookbehind-guarded bare ``print(``.
+JIT_PATTERN = re.compile(
+    r"time\.time\(|time\.perf_counter\(|\blog\.\w+\s*\(|(?<![\w.])print\s*\(")
+#: Escape hatch for intentionally-trace-time host calls in device code.
+JIT_MARKER = "jit-host-call-ok"
 
-def main() -> int:
+
+def lint_hot_loop_syncs() -> tuple[list[tuple[str, int, str]], set[str]]:
     src = TARGET.read_text()
     lines = src.splitlines()
     bad: list[tuple[str, int, str]] = []
@@ -53,6 +77,44 @@ def main() -> int:
                     continue
                 if PATTERN.search(text) and MARKER not in text:
                     bad.append((node.name, ln, text.strip()))
+    return bad, found
+
+
+def lint_device_host_calls() -> list[tuple[str, int, str, str]]:
+    """Flag time/log/print host calls inside nested (= traced) functions of
+    the device packages; returns (relpath, line, function, text) hits."""
+    root = TARGET.parent.parent     # sharetrade_tpu/
+    bad: list[tuple[str, int, str, str]] = []
+    for pkg in DEVICE_PACKAGES:
+        for path in sorted((root / pkg).glob("*.py")):
+            src = path.read_text()
+            lines = src.splitlines()
+            seen: set[tuple[int, int]] = set()
+            for node in ast.walk(ast.parse(src)):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for child in ast.walk(node):
+                    if (child is node
+                            or not isinstance(child, (ast.FunctionDef,
+                                                      ast.AsyncFunctionDef))):
+                        continue
+                    span = (child.lineno, child.end_lineno)
+                    if span in seen:
+                        continue
+                    seen.add(span)
+                    for ln in range(child.lineno, child.end_lineno + 1):
+                        text = lines[ln - 1]
+                        if text.lstrip().startswith("#"):
+                            continue
+                        if JIT_PATTERN.search(text) and JIT_MARKER not in text:
+                            bad.append((f"{pkg}/{path.name}", ln,
+                                        child.name, text.strip()))
+    return bad
+
+
+def main() -> int:
+    bad, found = lint_hot_loop_syncs()
     missing = set(HOT_FUNCS) - found
     if missing:
         # A rename must update this lint, not silently un-guard the loop.
@@ -67,7 +129,18 @@ def main() -> int:
               "reads through the batched megachunk readback, or tag the "
               f"line '# {MARKER}: <why this is not a per-chunk cost>'")
         return 1
-    print(f"hot-loop sync lint OK ({', '.join(sorted(found))})")
+    jit_bad = lint_device_host_calls()
+    if jit_bad:
+        print("device-code host-call lint FAILED:")
+        for rel, ln, fn, text in jit_bad:
+            print(f"  {rel}:{ln} (in {fn}): {text}")
+        print("time/log/print inside a traced step body runs ONCE at trace "
+              "time, not per step; move telemetry to the host side of the "
+              "chunk boundary (obs/), or tag the line "
+              f"'# {JIT_MARKER}: <why trace-time-only is intended>'")
+        return 1
+    print(f"hot-loop sync lint OK ({', '.join(sorted(found))}); "
+          f"device-code host-call lint OK ({', '.join(DEVICE_PACKAGES)})")
     return 0
 
 

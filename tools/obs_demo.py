@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""``make obs-demo``: the telemetry zero-to-summary loop, end to end.
+
+Runs a short obs-enabled training (tiny qlearn config, seconds on CPU),
+verifies the run dir contains every artifact the obs contract promises
+(manifest, Perfetto-loadable trace, metrics JSONL + Prometheus textfile),
+then prints the ``cli obs`` summary of that dir — the same command an
+operator runs against a production run dir. Wired into ``make check`` so
+the whole surface (orchestrator instrumentation → files → CLI reader)
+breaks loudly, not silently.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from sharetrade_tpu import cli
+    from sharetrade_tpu.config import FrameworkConfig
+    from sharetrade_tpu.obs import read_trace
+    from sharetrade_tpu.runtime import Orchestrator, ReplyState
+
+    with tempfile.TemporaryDirectory() as d:
+        cfg = FrameworkConfig()
+        cfg.learner.algo = "qlearn"
+        cfg.env.window = 8
+        cfg.model.hidden_dim = 8
+        cfg.parallel.num_workers = 4
+        cfg.runtime.chunk_steps = 16
+        cfg.runtime.checkpoint_every_updates = 32
+        cfg.runtime.checkpoint_dir = os.path.join(d, "ckpts")
+        cfg.obs.enabled = True
+        cfg.obs.dir = os.path.join(d, "obs")
+        cfg.obs.export_interval_s = 0.2
+
+        orch = Orchestrator(cfg)
+        orch.send_training_data(np.linspace(10.0, 20.0, 72,
+                                            dtype=np.float32))
+        orch.start_training(background=False)
+        done = orch.is_everything_done()
+        orch.stop()
+        if done.state is not ReplyState.COMPLETED:
+            print(f"obs-demo: training did not complete: {done}")
+            return 1
+        expected = ["manifest.json", "metrics.jsonl", "metrics.prom",
+                    "trace.jsonl"]
+        missing = [n for n in expected
+                   if not os.path.isfile(os.path.join(cfg.obs.dir, n))]
+        if missing:
+            print(f"obs-demo: missing artifacts {missing} in {cfg.obs.dir}")
+            return 1
+        events = read_trace(os.path.join(cfg.obs.dir, "trace.jsonl"))
+        if not any(e.get("ph") == "X" for e in events):
+            print("obs-demo: trace.jsonl holds no complete spans")
+            return 1
+        return cli.main(["obs", "--dir", cfg.obs.dir])
+
+
+if __name__ == "__main__":
+    sys.exit(main())
