@@ -8,12 +8,13 @@
 
 PYTHON ?= python
 
-.PHONY: check test slow native bench bench-dispatch bench-obs obs-demo lint clean
+.PHONY: check test slow native bench bench-dispatch bench-obs bench-reshard obs-demo lint shard-audit clean
 
 check: native lint
 	$(PYTHON) -m pytest tests/ -q -m "not slow" -x
 	$(PYTHON) tools/smoke_compile.py
 	$(PYTHON) tools/obs_demo.py
+	$(PYTHON) tools/shard_audit.py
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -46,6 +47,21 @@ bench-obs:
 # checks, then the `cli obs` summary of the run dir (also part of check).
 obs-demo:
 	$(PYTHON) tools/obs_demo.py
+
+# Compile-time shard audit (also part of check): every mesh-config in the
+# matrix must compile with zero XLA "Involuntary full rematerialization"
+# warnings and collective counts within tools/shard_audit_manifest.json.
+# Regenerate the manifest after an intentional change with
+# `python tools/shard_audit.py --update`.
+shard-audit:
+	$(PYTHON) tools/shard_audit.py
+
+# The resharding-constraint row alone (parallel.shard_constraints on vs off
+# on the forced-8-device host mesh): steps/s + per-dispatch collective
+# bytes, recorded in BASELINE.md "Multichip resharding".
+bench-reshard:
+	$(PYTHON) -c "import json, bench; \
+	print(json.dumps(bench.bench_reshard(), indent=2))"
 
 # Static guard: no bare scalar device syncs in the orchestrator hot loop.
 lint:

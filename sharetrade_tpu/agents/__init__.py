@@ -72,6 +72,13 @@ def build_agent(cfg: FrameworkConfig, env: TradingEnv | trading.EnvParams,
     kwargs = {}
     if algo == "dqn" and cfg.learner.journal_replay:
         kwargs["collect_transitions"] = True
+    if algo == "ppo":
+        # PPO's minibatch phase gathers PERMUTED agent rows out of the
+        # dp-sharded rollout products; with the mesh in hand it marks that
+        # layout change explicitly (one planned all-gather at the
+        # rollout→update seam) instead of leaving GSPMD an involuntary
+        # full rematerialization per gather (agents/ppo.py).
+        kwargs["mesh"] = mesh
     return _FACTORIES[algo](
         model, env, cfg.learner,
         num_agents=cfg.parallel.num_workers,

@@ -85,6 +85,12 @@ def megachunk_step(step_fn: Callable[[TrainState],
     scattered scalar round-trips. The scanned body is the same traced
     function as the single-chunk program, so K fused chunks are bit-identical
     to K host-dispatched chunks (pinned by tests/test_megachunk.py parity).
+
+    On a mesh, ``parallel/sharding.py`` composes the carry-sharding pin
+    UNDER this wrapper (``step_fn`` arrives already constrained), so each
+    of the K-1 inner-chunk seams — which have no jit in/out shardings of
+    their own — keeps the TrainState on its canonical specs instead of
+    letting GSPMD re-derive (and involuntarily reshard) the scan carry.
     """
     if factor < 1:
         raise ValueError(f"megachunk factor must be >= 1, got {factor}")

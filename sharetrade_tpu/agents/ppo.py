@@ -27,13 +27,32 @@ from sharetrade_tpu.agents.rollout import (
 from sharetrade_tpu.config import LearnerConfig
 from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models.core import Model
+from sharetrade_tpu.parallel.mesh import has_shard_map_axis
 from sharetrade_tpu.utils.logging import get_logger
+
+
+def _replicated(seam_mesh):
+    """The canonical replicated NamedSharding for the seam pins — resolved
+    through parallel.sharding's cache (lazily: sharding.py imports
+    agents.base, so a module-level import here would cycle)."""
+    from sharetrade_tpu.parallel.sharding import canonical_sharding
+    return canonical_sharding(seam_mesh)
 
 
 def make_ppo_agent(model: Model, env: TradingEnv,
                    cfg: LearnerConfig, *, num_agents: int = 10,
-                   steps_per_chunk: int | None = None) -> Agent:
+                   steps_per_chunk: int | None = None, mesh=None) -> Agent:
     optimizer = build_optimizer(cfg)
+    # The rollout→update replicate seam applies ONLY on meshes with a
+    # shard_map-partitioned axis (mesh.has_shard_map_axis): there, the
+    # epoch scans' permuted minibatch gathers over dp-sharded rollout
+    # products collide with the partitioned paths' transposed-mesh specs
+    # and GSPMD bridges them with an involuntary full rematerialization
+    # PER GATHER (the MULTICHIP_r01..r05 warnings; see
+    # tools/shard_audit.py). Pure dp/tp meshes compile those gathers
+    # cleanly already and keep their exact pre-seam programs — measured
+    # byte-identical in the shard-audit manifest.
+    seam_mesh = mesh if has_shard_map_axis(mesh) else None
     unroll = steps_per_chunk or cfg.unroll_len
     # Largest divisor of num_agents not exceeding the configured count keeps
     # minibatch SGD meaningful when the two don't divide evenly (e.g. 10
@@ -88,6 +107,19 @@ def make_ppo_agent(model: Model, env: TradingEnv,
         advantages = gae_advantages(traj.reward, traj.value, traj.active,
                                     bootstrap, cfg.gamma, cfg.gae_lambda)
         returns = advantages + traj.value
+        if seam_mesh is not None:
+            # The rollout→update seam (sp/ep meshes only — see seam_mesh
+            # above): marking the rollout products replicated makes the
+            # epoch scans' permuted-gather data movement ONE planned
+            # all-gather per chunk instead of an involuntary full
+            # rematerialization per gather; the updated params/opt and the
+            # carried TrainState keep their canonical specs via the jit
+            # in/out shardings and the parallel layer's seam pins
+            # (parallel/sharding.py constrain_train_state).
+            replicated = _replicated(seam_mesh)
+            traj, init_carry, advantages, returns = jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(x, replicated),
+                (traj, init_carry, advantages, returns))
 
         def epoch_body(carry, _):
             params, opt_state, rng = carry
@@ -100,10 +132,23 @@ def make_ppo_agent(model: Model, env: TradingEnv,
                     perm, mb_idx * mb_size, mb_size)
                 traj_mb = jax.tree.map(lambda x: x[:, idx], traj)
                 carry_mb = jax.tree.map(lambda x: x[idx], init_carry)
+                adv_mb, ret_mb = advantages[:, idx], returns[:, idx]
+                if seam_mesh is not None:
+                    # Pin the GATHERED slices replicated as well: GSPMD
+                    # otherwise re-derives a dp layout for the tiny
+                    # minibatch tensors (mb_size rows can't even tile the
+                    # dp axis) and the episode trunk's sp/ep attention
+                    # spec then forces the involuntary remat this module
+                    # exists to avoid — on carry_mb['hist'] specifically,
+                    # the MULTICHIP logs' signature warning.
+                    replicated = _replicated(seam_mesh)
+                    traj_mb, carry_mb, adv_mb, ret_mb = jax.tree.map(
+                        lambda x: jax.lax.with_sharding_constraint(
+                            x, replicated),
+                        (traj_mb, carry_mb, adv_mb, ret_mb))
                 (loss, aux), grads = jax.value_and_grad(
                     minibatch_loss, has_aux=True)(
-                    params, traj_mb, carry_mb,
-                    advantages[:, idx], returns[:, idx])
+                    params, traj_mb, carry_mb, adv_mb, ret_mb)
                 updates, opt_state = optimizer.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 return (params, opt_state), (loss, *aux)

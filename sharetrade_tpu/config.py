@@ -186,6 +186,14 @@ class ParallelConfig:
     pipeline_axis: str = "pp"
     expert_axis: str = "ep"
     mesh_shape: dict[str, int] = field(default_factory=dict)  # {} -> all devices on dp
+    # Re-pin the step's output TrainState to its canonical shardings inside
+    # the compiled program (jax.lax.with_sharding_constraint at the chunk /
+    # inner-megachunk seams). Keeps GSPMD from re-deriving a transposed-mesh
+    # layout for the carry around the sp/pp/ep shard_map regions — the
+    # "Involuntary full rematerialization" replicate-and-repartition the
+    # shard audit (tools/shard_audit.py) gates on. Off exists ONLY for the
+    # bench_reshard with/without comparison; leave it on in production.
+    shard_constraints: bool = True
 
 
 @dataclass

@@ -94,8 +94,10 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
             "dp" if mesh is not None and "dp" in mesh.axis_names else None)
         # A non-TPU mesh (the virtual-CPU test/dryrun client) can't lower the
         # Pallas kernel; the XLA reference path is numerically identical.
+        from sharetrade_tpu.parallel.mesh import (
+            has_shard_map_axis as _has_shard_map_axis, mesh_platform)
         use_pallas = (False if mesh is not None
-                      and mesh.devices.flat[0].platform != "tpu" else None)
+                      and mesh_platform(mesh) != "tpu" else None)
         if cfg.seq_mode == "episode":
             if num_assets > 1:
                 raise ConfigError(
@@ -148,7 +150,15 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
                 moe_top_k=cfg.moe_top_k,
                 moe_capacity_factor=cfg.moe_capacity_factor,
                 moe_dispatch=cfg.moe_dispatch,
-                remat_blocks=cfg.remat_blocks)
+                remat_blocks=cfg.remat_blocks,
+                # The carry→series seam pin applies exactly where a
+                # shard_map-partitioned path (sp halo attention, ep MoE
+                # dispatch) can propagate a transposed-mesh spec backward
+                # onto the dp-sharded hist carry; meshes without those
+                # axes compile clean already and keep their exact
+                # programs (mesh.has_shard_map_axis — the same scope
+                # predicate as PPO's rollout→update seam).
+                seam_mesh=(mesh if _has_shard_map_axis(mesh) else None))
         if cfg.attention in ("ring", "ulysses"):
             if mesh is None or "sp" not in mesh.axis_names:
                 raise ConfigError(

@@ -96,7 +96,8 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                                ep_axis: str = "ep", moe_top_k: int = 0,
                                moe_capacity_factor: float = 1.25,
                                moe_dispatch: str = "psum",
-                               remat_blocks: bool = False) -> Model:
+                               remat_blocks: bool = False,
+                               seam_mesh=None) -> Model:
     """Build the episode-mode policy (``ModelConfig.seq_mode="episode"``).
 
     ``attention_fn(q, k, v, window) -> out`` overrides the local banded
@@ -126,6 +127,27 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         raise ConfigError(f"RoPE needs an even head_dim, got {head_dim}")
     window = obs_dim - 2                    # ticks per observation window
     hist_len = (num_layers - 1) * (window - 1)
+
+    def _pin_hist(hist):
+        # The carry→series seam (round 8, the MULTICHIP involuntary-remat
+        # fix): the replay/trunk passes concatenate the carry's history
+        # rows into the tick series, and on an sp/ep mesh the partitioned
+        # attention's sequence-sharded (transposed-mesh) spec propagates
+        # BACKWARD through that concat onto the dp-sharded
+        # ``ts.carry['hist']`` program input — XLA then bridges the two
+        # with a full replicate-and-repartition per step ("Involuntary
+        # full rematerialization", the [4,1,2]→[1,2,4] warning in
+        # MULTICHIP_r01..r05). Pinning the (B, hist_len) slice replicated
+        # here — bytes, not megabytes — turns that into one planned,
+        # warning-free all-gather and stops the backward propagation at
+        # an explicit seam; the TrainState's own carry keeps its
+        # canonical dp spec via the jit in/out shardings
+        # (parallel/sharding.py).
+        if seam_mesh is None:
+            return hist
+        from sharetrade_tpu.parallel.sharding import canonical_sharding
+        return jax.lax.with_sharding_constraint(
+            hist, canonical_sharding(seam_mesh))
     d_model = num_heads * head_dim
     sm_scale = head_dim ** -0.5
     def local_attention(q, k, v, w):
@@ -628,7 +650,8 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         # At episode start the carry's history is the init_carry zeros the
         # prefill never saw; substitute the first-price padding the prefill
         # actually used so both paths read the same series.
-        hist = jnp.where((t0 == 0)[:, None], first_win[:, :1], carry["hist"])
+        hist = _pin_hist(
+            jnp.where((t0 == 0)[:, None], first_win[:, :1], carry["hist"]))
         series = jnp.concatenate([hist, first_win, newer], axis=1)
         s_len = hist_len + window + t_len - 1
         positions = (t0[:, None] - hist_len
@@ -693,8 +716,8 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         first_win = obs1[0, :, :window]                 # (1, W)
         newer = obs1[1:, :, window - 1].T               # (1, T-1)
         t0 = carry1["t"].astype(jnp.int32)              # (1,)
-        hist = jnp.where((t0 == 0)[:, None], first_win[:, :1],
-                         carry1["hist"])
+        hist = _pin_hist(jnp.where((t0 == 0)[:, None], first_win[:, :1],
+                                   carry1["hist"]))
         series = jnp.concatenate([hist, first_win, newer], axis=1)
         s_len = hist_len + window + t_len - 1
         positions = (t0[:, None] - hist_len
@@ -740,7 +763,8 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         first_win = obs[:, :window]
         # Episode start: substitute the prefill's first-price padding for
         # the init_carry zeros (same rule as apply_unroll).
-        hist = jnp.where((t0 == 0)[:, None], first_win[:, :1], carry["hist"])
+        hist = _pin_hist(
+            jnp.where((t0 == 0)[:, None], first_win[:, :1], carry["hist"]))
         series = jnp.concatenate(
             [hist, first_win, future_ticks.astype(jnp.float32)], axis=1)
         s_len = hist_len + window + t_len

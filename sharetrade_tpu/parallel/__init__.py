@@ -4,7 +4,13 @@ Replaces the reference's Akka Router + mailbox parameter server (SURVEY.md
 §2.2-2.3) with jax.sharding meshes and XLA collectives over ICI/DCN.
 """
 
-from sharetrade_tpu.parallel.mesh import AXIS_ORDER, build_mesh, init_distributed  # noqa: F401
+from sharetrade_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_ORDER,
+    build_mesh,
+    init_distributed,
+    is_cpu_mesh,
+    mesh_platform,
+)
 from sharetrade_tpu.parallel.moe import (  # noqa: F401
     init_moe_params,
     moe_apply,
@@ -29,6 +35,9 @@ from sharetrade_tpu.parallel.ulysses import (  # noqa: F401
 )
 from sharetrade_tpu.parallel.sharding import (  # noqa: F401
     batch_axis_sharding,
+    canonical_sharding,
+    constrain_train_state,
+    jit_parallel_step,
     make_parallel_step,
     mlp_tp_rules,
     param_shardings,

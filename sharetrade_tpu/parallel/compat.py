@@ -1,0 +1,26 @@
+"""jax API compatibility for the parallel layer.
+
+The partitioned paths target the current jax surface — ``jax.shard_map``
+with the ``check_vma`` keyword. Older toolchains (jax 0.4.x, still common
+on CPU-only CI hosts) ship the SAME primitive as
+``jax.experimental.shard_map.shard_map`` with the keyword spelled
+``check_rep``. One resolver here so every sp/pp/ep path — and the shard
+audit that compiles them on a forced-8-device host platform — runs on
+both, instead of each call site growing its own try/except.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` when the toolchain has it, else the 0.4.x
+    ``jax.experimental.shard_map`` spelling (``check_vma`` → ``check_rep``:
+    same per-output replication check, renamed upstream)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)

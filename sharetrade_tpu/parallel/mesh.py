@@ -21,6 +21,42 @@ log = get_logger("parallel.mesh")
 AXIS_ORDER = ("dp", "tp", "sp", "pp", "ep")
 
 
+def mesh_platform(mesh: Mesh) -> str:
+    """THE platform probe for a mesh ("cpu" | "tpu" | "gpu" | ...).
+
+    One definition so every platform-keyed carve-out — the CPU no-donation
+    seam in ``parallel/sharding.py``, the Pallas-kernel gate in
+    ``models/__init__.py`` — keys off the same predicate and can never
+    drift (a probe that checked ``jax.default_backend()`` instead of the
+    MESH's devices would misfire exactly on the forced-8-device host
+    platform the shard audit and the multichip dryrun run on)."""
+    return next(iter(mesh.devices.flat)).platform
+
+
+def is_cpu_mesh(mesh: Mesh) -> bool:
+    """True when the mesh is backed by (possibly virtual) CPU devices —
+    the forced-8-device host platform of tests/the shard audit, or the
+    orchestrator's CPU fallback."""
+    return mesh_platform(mesh) == "cpu"
+
+
+#: Mesh axes whose code paths run shard_map-partitioned programs (sp
+#: sequence parallelism, ep expert dispatch) — the axes that can propagate
+#: a transposed-mesh spec back onto dp-sharded state.
+SHARD_MAP_AXES = ("sp", "ep")
+
+
+def has_shard_map_axis(mesh: Mesh | None) -> bool:
+    """THE scope predicate for the round-8 replicate seams (PPO's
+    rollout→update seam, the episode transformer's carry→series pin):
+    True when the mesh carries a >1-sized shard_map axis. One definition
+    so the two seams can never silently diverge; meshes without such an
+    axis compile the permuted gathers clean already and must keep their
+    exact (byte-identical) programs."""
+    return (mesh is not None
+            and any(dict(mesh.shape).get(a, 1) > 1 for a in SHARD_MAP_AXES))
+
+
 def build_mesh(cfg: ParallelConfig | None = None, devices=None) -> Mesh:
     """Build a mesh from ``cfg.mesh_shape`` (e.g. ``{"dp": 4, "tp": 2}``).
 

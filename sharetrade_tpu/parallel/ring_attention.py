@@ -39,6 +39,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sharetrade_tpu.config import ConfigError
+from sharetrade_tpu.parallel.compat import shard_map
 
 _NEG_INF = -1e30
 
@@ -109,7 +110,7 @@ def ring_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "sp",
         return (acc / l_safe[..., None]).astype(q_loc.dtype)
 
     spec = P(batch_axis, None, seq_axis, None)
-    shmap = jax.shard_map(
+    shmap = shard_map(
         local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return shmap(q, k, v)
 

@@ -12,6 +12,16 @@ TF session owning the only parameter copy) with explicit PartitionSpecs:
 With these in/out shardings on a jitted step, XLA turns the loss mean over
 the dp-sharded batch into an ICI all-reduce — the parameter-server mailbox
 (QDecisionPolicyActor.scala:54-77) become a collective (SURVEY.md §7.2).
+
+Consistency contract (the anti-resharding tentpole): every path that places,
+restores, heals, or steps a TrainState on a mesh resolves its shardings
+through :func:`canonical_sharding`, and the compiled step re-pins its output
+carry/env_state with ``jax.lax.with_sharding_constraint`` at the chunk seam.
+Without the pin, program regions introduced by the sp/pp/ep shard_maps leave
+GSPMD free to pick a transposed-mesh layout for the carry mid-program, and
+the partitioner then falls back to replicate-then-repartition ("Involuntary
+full rematerialization" in the SPMD log) on every chunk — the failure mode
+``tools/shard_audit.py`` compiles the whole config matrix to keep out.
 """
 
 from __future__ import annotations
@@ -22,11 +32,40 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sharetrade_tpu.agents.base import TrainState, megachunk_step
+from sharetrade_tpu.parallel.mesh import is_cpu_mesh
+
+#: One NamedSharding OBJECT per (mesh, spec): every layer that places or
+#: constrains state asks here, so "the same sharding" is identity, not an
+#: equality the reader must verify across call sites.
+_CANONICAL: dict[tuple[Mesh, P], NamedSharding] = {}
+
+
+def canonical_sharding(mesh: Mesh, spec: P = P()) -> NamedSharding:
+    """THE NamedSharding for (mesh, spec).
+
+    Memoized so the sharding trees built by :func:`train_state_shardings`,
+    the orchestrator's place/restore/heal paths, and the in-step
+    ``with_sharding_constraint`` pins all hold the identical object — a
+    path that constructed its own would still compare equal today, but the
+    cache makes the canonical-spec contract structural instead of
+    conventional."""
+    got = _CANONICAL.get((mesh, spec))
+    if got is None:
+        if len(_CANONICAL) >= 4096:
+            # Ephemeral-mesh processes (the test suite, shard-audit
+            # children) would otherwise pin every mesh they ever built for
+            # the process lifetime; a flush preserves identity within any
+            # live working set (production owns ONE mesh) while bounding
+            # retention. (A weak cache doesn't work here: the value holds
+            # its mesh, so weak-keying by mesh never collects.)
+            _CANONICAL.clear()
+        got = _CANONICAL[(mesh, spec)] = NamedSharding(mesh, spec)
+    return got
 
 
 def batch_axis_sharding(mesh: Mesh, data_axis: str = "dp"):
     """P(dp, None, ...) for arrays whose leading dim is the agent batch."""
-    return NamedSharding(mesh, P(data_axis))
+    return canonical_sharding(mesh, P(data_axis))
 
 
 def param_shardings(params: Any, mesh: Mesh, rules: dict[str, P] | None = None):
@@ -42,8 +81,8 @@ def param_shardings(params: Any, mesh: Mesh, rules: dict[str, P] | None = None):
         key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
         for suffix, spec in rules.items():
             if key.endswith(suffix):
-                return NamedSharding(mesh, spec)
-        return NamedSharding(mesh, P())
+                return canonical_sharding(mesh, spec)
+        return canonical_sharding(mesh, P())
 
     return jax.tree_util.tree_map_with_path(leaf_sharding, params)
 
@@ -72,8 +111,8 @@ def train_state_shardings(ts: TrainState, mesh: Mesh, *,
                           data_axis: str = "dp",
                           param_rules: dict[str, P] | None = None) -> TrainState:
     """Build the TrainState-shaped pytree of NamedShardings for jit in/out."""
-    replicate = NamedSharding(mesh, P())
-    batch = NamedSharding(mesh, P(data_axis))
+    replicate = canonical_sharding(mesh, P())
+    batch = canonical_sharding(mesh, P(data_axis))
 
     p_shard = param_shardings(ts.params, mesh, param_rules)
 
@@ -136,9 +175,90 @@ def train_state_shardings(ts: TrainState, mesh: Mesh, *,
     )
 
 
+def constrain_train_state(ts: TrainState, shardings: TrainState) -> TrainState:
+    """Pin the BATCH-CARRIED TrainState leaves — ``carry`` (notably the
+    episode transformer's ``hist`` buffer) and ``env_state`` — to their
+    canonical shardings INSIDE a traced program
+    (``jax.lax.with_sharding_constraint``). The seam this serves: between
+    the shard_map regions of the sp/ring/pipeline/MoE paths and the
+    surrounding dataflow, GSPMD may otherwise re-derive a transposed-mesh
+    layout for the carry (e.g. ``carry['hist']`` [dp,1,sp] → [1,sp,dp]) and
+    bridge it with a full replicate-then-repartition per chunk.
+
+    Deliberately NOT the whole state: params/opt_state are loop-invariant
+    inside a megachunk scan and already pinned by the outer jit's in/out
+    shardings — re-constraining them mid-scan makes GSPMD materialize the
+    constraint (measured +8 all-gathers on the dp4×tp2 bench_reshard
+    workload) instead of leaving the tp-sharded layout untouched."""
+    return ts.replace(
+        carry=jax.lax.with_sharding_constraint(ts.carry, shardings.carry),
+        env_state=jax.lax.with_sharding_constraint(ts.env_state,
+                                                   shardings.env_state))
+
+
+def _constrained(step_fn, shardings: TrainState):
+    """Wrap a chunk step so its OUTPUT TrainState is re-pinned to the
+    canonical specs. Composed UNDER ``megachunk_step``, this pins the
+    lax.scan carry at every inner-chunk seam — the K-1 seams that have no
+    jit in/out shardings of their own and where an involuntary reshard
+    would otherwise be paid K times per dispatch."""
+
+    def step(ts: TrainState):
+        new_ts, metrics = step_fn(ts)
+        return constrain_train_state(new_ts, shardings), metrics
+
+    return step
+
+
+def jit_parallel_step(agent, mesh: Mesh, ts: TrainState, *,
+                      data_axis: str = "dp",
+                      param_rules: dict[str, P] | None = None,
+                      megachunk_factor: int = 1,
+                      constrain: bool = True):
+    """Build the jitted (uncalled) partitioned chunk program and its
+    sharding tree: ``(shardings, jitted_fn)``.
+
+    The ONE construction shared by :func:`make_parallel_step` (which
+    executes it) and ``tools/shard_audit.py`` / ``bench.py bench_reshard``
+    (which ``.lower(...).compile()`` it to inspect SPMD warnings, HLO
+    collectives and memory) — so what the audit certifies is byte-for-byte
+    the program the orchestrator dispatches.
+
+    Sharding decisions:
+
+    - in_shardings: the canonical TrainState tree (params by rule, batch-
+      leading leaves over ``data_axis``, scalars replicated).
+    - out_shardings: the same tree for the TrainState; ``None`` (GSPMD-
+      chosen) for the metrics. Forcing the metrics to replicate — the old
+      behavior — inserted an all-gather INSIDE the fused program for any
+      batch-shaped metric leaf (DQN's journaled ``(K, T, B, ...)``
+      transitions); leaving them unspecified keeps them shard-resident
+      until the orchestrator's single batched ``device_get`` readback,
+      which assembles on the host for free.
+    - ``constrain`` (``parallel.shard_constraints``): re-pin the output
+      state inside the program (see :func:`_constrained`); off only for
+      the bench's with/without comparison.
+    """
+    sh = train_state_shardings(ts, mesh, data_axis=data_axis,
+                               param_rules=param_rules)
+    step_fn = _constrained(agent.step, sh) if constrain else agent.step
+    if megachunk_factor > 1:
+        step_fn = megachunk_step(step_fn, megachunk_factor)
+    # NO donation for a fused megachunk on CPU devices: donating the
+    # TrainState into the lax.scan corrupts the heap on the CPU runtime
+    # (use-after-free once checkpoint restores interleave with megachunk
+    # dispatches — same hazard the orchestrator's CPU-fallback seam avoids).
+    # Accelerator meshes keep donation, where HBM double-buffering matters.
+    donate = (() if megachunk_factor > 1 and is_cpu_mesh(mesh) else (0,))
+    fn = jax.jit(step_fn, in_shardings=(sh,), out_shardings=(sh, None),
+                 donate_argnums=donate)
+    return sh, fn
+
+
 def make_parallel_step(agent, mesh: Mesh, *, data_axis: str = "dp",
                        param_rules: dict[str, P] | None = None,
-                       megachunk_factor: int = 1):
+                       megachunk_factor: int = 1,
+                       constrain: bool = True):
     """jit the agent's chunk step with mesh shardings.
 
     Returns ``(place, step)``: ``place(ts)`` device_puts a freshly-initialized
@@ -151,30 +271,18 @@ def make_parallel_step(agent, mesh: Mesh, *, data_axis: str = "dp",
     K-chunk ``lax.scan`` is one partitioned program, so the ICI collectives
     of consecutive inner chunks stay fused (no host round-trip re-dispatches
     them) and the host pays one dispatch per K chunks. Metrics return
-    stacked ``(K, ...)``, replicated — the out-sharding spec is rank-
-    agnostic, so the same replicate spec covers both shapes.
-    """
-    replicate = NamedSharding(mesh, P())
-    step_fn = (agent.step if megachunk_factor <= 1
-               else megachunk_step(agent.step, megachunk_factor))
-    # NO donation for a fused megachunk on CPU devices: donating the
-    # TrainState into the lax.scan corrupts the heap on the CPU runtime
-    # (use-after-free once checkpoint restores interleave with megachunk
-    # dispatches — same hazard the orchestrator's CPU-fallback path avoids).
-    # Accelerator meshes keep donation, where HBM double-buffering matters.
-    donate = (() if megachunk_factor > 1
-              and next(iter(mesh.devices.flat)).platform == "cpu"
-              else (0,))
+    stacked ``(K, ...)`` with GSPMD-chosen (shard-resident) layouts; see
+    :func:`jit_parallel_step` for the sharding contract, including the
+    per-inner-chunk carry pin that keeps the scan free of involuntary
+    resharding."""
     cache: dict[str, Any] = {}  # sharding pytree + jitted fn, built once
 
     def _ensure(ts):
         if "fn" not in cache:
-            sh = train_state_shardings(ts, mesh, data_axis=data_axis,
-                                       param_rules=param_rules)
-            cache["sh"] = sh
-            cache["fn"] = jax.jit(step_fn, in_shardings=(sh,),
-                                  out_shardings=(sh, replicate),
-                                  donate_argnums=donate)
+            cache["sh"], cache["fn"] = jit_parallel_step(
+                agent, mesh, ts, data_axis=data_axis,
+                param_rules=param_rules, megachunk_factor=megachunk_factor,
+                constrain=constrain)
         return cache
 
     def place(ts: TrainState) -> TrainState:

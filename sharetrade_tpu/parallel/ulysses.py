@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from sharetrade_tpu.config import ConfigError
+from sharetrade_tpu.parallel.compat import shard_map
 
 from sharetrade_tpu.ops.attention import flash_attention
 
@@ -68,7 +69,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "sp",
                                   concat_axis=1, tiled=True)
 
     spec = P(batch_axis, None, seq_axis, None)
-    return jax.shard_map(
+    return shard_map(
         local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(
         q, k, v)
 

@@ -334,9 +334,16 @@ class Orchestrator:
             model_axis = self.cfg.parallel.model_axis
             rules = (mlp_tp_rules(model_axis)
                      if model_axis in self.mesh.axis_names else None)
+            # Both programs (and _place, _reset_episode, _heal_agents and
+            # the checkpoint-restore path through it) resolve their specs
+            # from the same canonical train_state_shardings tree, so a
+            # restored or warm-started state lands on exactly the layout
+            # the compiled step's in_shardings expect — no involuntary
+            # reshard on the first chunk after a recovery.
+            constrain = self.cfg.parallel.shard_constraints
             self._place, self._step_fn = make_parallel_step(
                 self.agent, self.mesh, data_axis=self.cfg.parallel.data_axis,
-                param_rules=rules)
+                param_rules=rules, constrain=constrain)
             if factor > 1:
                 # The K-chunk scan composes INSIDE the pjit boundary (one
                 # partitioned program), so ICI collectives stay fused across
@@ -345,7 +352,8 @@ class Orchestrator:
                 _, self._mega_fn = make_parallel_step(
                     self.agent, self.mesh,
                     data_axis=self.cfg.parallel.data_axis,
-                    param_rules=rules, megachunk_factor=factor)
+                    param_rules=rules, megachunk_factor=factor,
+                    constrain=constrain)
         else:
             self._place = lambda ts: ts
             # Donated input, matching the mesh path: the previous chunk's

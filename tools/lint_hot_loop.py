@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Grep-lint for the orchestrator's training hot loop and the device code.
 
-Two checks, both run by ``make check``/``make lint`` and the tier-1 guard
+Three checks, all run by ``make check``/``make lint`` and the tier-1 guard
 in tests/test_megachunk.py:
 
 1. **Hot-loop syncs** — the megachunk refactor (runtime/orchestrator.py
@@ -15,7 +15,18 @@ in tests/test_megachunk.py:
    hot-loop functions without the explicit ``hot-loop-sync-ok`` marker
    naming why that sync is off the per-chunk path.
 
-2. **Host calls in traced step code** (the obs PR's guard) — inside the
+2. **Bare device_put in the parallel layer** (the shard-audit PR's guard) —
+   inside ``sharetrade_tpu/parallel/`` a ``jax.device_put(x)`` WITHOUT an
+   explicit sharding lands the array wherever the default device is, and
+   the first partitioned program that consumes it pays an involuntary
+   reshard to pull it onto its canonical spec — exactly the class of
+   silent data movement the shard audit (tools/shard_audit.py) gates out
+   of the compiled step. FAILS on any ``device_put`` call in the parallel
+   package that passes neither a second positional argument nor a
+   ``device=`` keyword, unless the line carries ``device-put-ok`` naming
+   why placement is intentionally unspecified.
+
+3. **Host calls in traced step code** (the obs PR's guard) — inside the
    device packages (agents/env/models/ops) the traced step bodies are
    NESTED functions (closures handed to ``jax.jit``/``lax.scan``). A
    ``time.time()`` / ``time.perf_counter()`` / ``log.*()`` / ``print()``
@@ -58,6 +69,35 @@ JIT_PATTERN = re.compile(
     r"time\.time\(|time\.perf_counter\(|\blog\.\w+\s*\(|(?<![\w.])print\s*\(")
 #: Escape hatch for intentionally-trace-time host calls in device code.
 JIT_MARKER = "jit-host-call-ok"
+
+#: Escape hatch for a parallel-layer device_put that intentionally leaves
+#: placement to jax.
+PUT_MARKER = "device-put-ok"
+
+
+def lint_parallel_device_put() -> list[tuple[str, int, str]]:
+    """Flag ``device_put`` calls without an explicit sharding inside
+    ``sharetrade_tpu/parallel/``; returns (relpath, line, text) hits."""
+    root = TARGET.parent.parent / "parallel"
+    bad: list[tuple[str, int, str]] = []
+    for path in sorted(root.glob("*.py")):
+        src = path.read_text()
+        lines = src.splitlines()
+        for node in ast.walk(ast.parse(src)):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else getattr(fn, "id", None))
+            if name != "device_put":
+                continue
+            explicit = (len(node.args) >= 2
+                        or any(kw.arg == "device" for kw in node.keywords))
+            if explicit or PUT_MARKER in lines[node.lineno - 1]:
+                continue
+            bad.append((f"parallel/{path.name}", node.lineno,
+                        lines[node.lineno - 1].strip()))
+    return bad
 
 
 def lint_hot_loop_syncs() -> tuple[list[tuple[str, int, str]], set[str]]:
@@ -129,6 +169,17 @@ def main() -> int:
               "reads through the batched megachunk readback, or tag the "
               f"line '# {MARKER}: <why this is not a per-chunk cost>'")
         return 1
+    put_bad = lint_parallel_device_put()
+    if put_bad:
+        print("parallel-layer device_put lint FAILED:")
+        for rel, ln, text in put_bad:
+            print(f"  {rel}:{ln}: {text}")
+        print("a bare device_put in the parallel layer places data off its "
+              "canonical sharding and the next partitioned program pays an "
+              "involuntary reshard; pass the NamedSharding (see "
+              "sharding.canonical_sharding), or tag the line "
+              f"'# {PUT_MARKER}: <why placement is intentionally default>'")
+        return 1
     jit_bad = lint_device_host_calls()
     if jit_bad:
         print("device-code host-call lint FAILED:")
@@ -140,6 +191,7 @@ def main() -> int:
               f"'# {JIT_MARKER}: <why trace-time-only is intended>'")
         return 1
     print(f"hot-loop sync lint OK ({', '.join(sorted(found))}); "
+          f"parallel device_put lint OK; "
           f"device-code host-call lint OK ({', '.join(DEVICE_PACKAGES)})")
     return 0
 
