@@ -8,7 +8,7 @@
 
 PYTHON ?= python
 
-.PHONY: check test slow native bench bench-dispatch bench-obs bench-reshard obs-demo lint shard-audit clean
+.PHONY: check test slow native bench bench-async bench-dispatch bench-obs bench-reshard obs-demo lint shard-audit clean
 
 check: native lint
 	$(PYTHON) -m pytest tests/ -q -m "not slow" -x
@@ -33,6 +33,14 @@ bench:
 bench-dispatch:
 	$(PYTHON) -c "import json, bench; \
 	print(json.dumps(bench.bench_dispatch_floor(), indent=2))"
+
+# The host-offload pipeline alone (runtime.async_pipeline off vs on at
+# K in {1, 8}): inter-dispatch gap p50/p99 from the obs trace's dispatch
+# spans plus steps/s — the async-readback lever, recorded in BASELINE.md
+# "Host-offload pipeline". Runnable on CPU in ~a minute.
+bench-async:
+	$(PYTHON) -c "import json, bench; \
+	print(json.dumps(bench.bench_async_pipeline(), indent=2))"
 
 # Telemetry overhead alone (obs.enabled off vs on at K in {1, 8}, with an
 # A/A noise-floor control, plus the direct per-sample cost): the <2%

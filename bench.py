@@ -30,6 +30,11 @@ object, with the reference-shape row nested under ``"reference_shape"``.
    loop with ``obs.enabled`` false vs true at K ∈ {1, 8} — the span trace /
    metrics export / flight recorder must cost <2% (BASELINE.md "Telemetry
    overhead").
+6. **Host-offload pipeline** (``bench_async_pipeline``): the orchestrator
+   loop with ``runtime.async_pipeline`` off vs on at K ∈ {1, 8} —
+   inter-dispatch gap p50/p99 (from the obs trace's dispatch spans) and
+   steps/s; the pipeline must take the host_process block out of the
+   megachunk dispatch gap (BASELINE.md "Host-offload pipeline").
 
 Baseline derivation (the reference publishes NO numbers — BASELINE.md): its
 driver polls up to 201 × 5 s ≈ 1,005 s for a complete run
@@ -357,6 +362,135 @@ def bench_obs_overhead(factors: tuple[int, ...] = (1, 8), *,
     return out
 
 
+def bench_async_pipeline(factors: tuple[int, ...] = (1, 8), *,
+                         chunks: int = 64, trials: int = 3) -> dict:
+    """Dispatch-gap ladder: the ORCHESTRATOR hot loop with
+    ``runtime.async_pipeline`` off (synchronous readback + host processing
+    between dispatches) vs on (bounded-queue consumer thread), at megachunk
+    K ∈ ``factors`` over an identical chunk budget with per-chunk metrics
+    (``metrics_every_chunks=1`` — the maximal host-work regime, where every
+    chunk pays metric-row conversion, snapshot and registry writes).
+
+    The workload is deliberately HOST-dominated (tiny model, short chunks):
+    on a compute-bound chunk the gap of BOTH modes is pinned by device time
+    — the sync path absorbs it in the (donating, synchronously-executing)
+    dispatch call while the pipeline meets it as backpressure — and the
+    comparison measures the backend's execution style instead of the host
+    work this lever removes. Short chunks put the host share in the
+    driver's seat, which is exactly the dispatch-floor regime the ROADMAP
+    targets (tunneled links, many small dispatches).
+
+    Two readings per row, both from the same runs:
+
+    - ``agent_steps_per_sec`` — end-to-end throughput (median of trials);
+    - ``gap_p50_us``/``gap_p99_us`` — the INTER-DISPATCH GAP, measured from
+      the obs trace's ``dispatch`` spans (end of span N to start of span
+      N+1, pooled across trials). The sync path's gap contains the batched
+      ``device_get`` plus the whole host_process block; the pipeline's gap
+      is the enqueue cost, so its p50 must sit strictly below the sync
+      p50 — the acceptance reading recorded in BASELINE.md "Host-offload
+      pipeline".
+
+    Modes are interleaved per trial and each mode reuses one orchestrator
+    across episodes (compile once, dispatch cached program), the
+    bench_obs_overhead discipline."""
+    import os
+    import statistics
+    import tempfile
+
+    from sharetrade_tpu.obs.trace import read_trace
+    from sharetrade_tpu.runtime.orchestrator import Orchestrator
+
+    def dispatch_spans(trace_path: str) -> list[dict]:
+        if not os.path.isfile(trace_path):
+            return []
+        return sorted(
+            (e for e in read_trace(trace_path)
+             if e.get("ph") == "X" and e.get("name") == "dispatch"),
+            key=lambda e: e["ts"])
+
+    def gaps_us(spans: list[dict]) -> list[float]:
+        return [max(0.0, b["ts"] - (a["ts"] + a["dur"]))
+                for a, b in zip(spans, spans[1:])]
+
+    def pct(sorted_vals: list[float], q: float) -> float:
+        if not sorted_vals:
+            return float("nan")
+        return sorted_vals[min(len(sorted_vals) - 1,
+                               int(q * len(sorted_vals)))]
+
+    out: dict = {
+        "metric": "async_pipeline_qlearn",
+        "chunk_steps": 10,
+        "chunks_per_episode": chunks,
+        "metrics_every_chunks": 1,
+        "rows": {},
+    }
+    for k in factors:
+        with tempfile.TemporaryDirectory() as d:
+            orchs: dict[str, Orchestrator] = {}
+            traces: dict[str, str] = {}
+            for mode in ("sync", "async"):
+                cfg = FrameworkConfig()
+                cfg.learner.algo = "qlearn"
+                cfg.parallel.num_workers = 10  # reference noOfChildren
+                cfg.env.window = 8
+                cfg.model.hidden_dim = 8       # host-dominated, see above
+                cfg.runtime.chunk_steps = 10
+                cfg.runtime.metrics_every_chunks = 1
+                cfg.runtime.megachunk_factor = k
+                cfg.runtime.async_pipeline = mode == "async"
+                # Checkpoint/eval cadences off: measure the chunk loop.
+                cfg.runtime.checkpoint_every_updates = 0
+                cfg.runtime.keep_best_eval = False
+                cfg.runtime.checkpoint_dir = os.path.join(d, f"ck-{mode}")
+                cfg.obs.enabled = True          # dispatch spans = the probe
+                cfg.obs.metrics_export = False
+                cfg.obs.flight_recorder = False
+                cfg.obs.dir = os.path.join(d, f"obs-{mode}")
+                series = synthetic_price_series(
+                    length=cfg.env.window + chunks * cfg.runtime.chunk_steps
+                    + 8)
+                orch = Orchestrator(cfg)
+                orch.send_training_data(series.prices)
+                # Episode 1: compile + warm; later episodes reuse the step.
+                orch.start_training(background=False)
+                orchs[mode] = orch
+                traces[mode] = os.path.join(cfg.obs.dir, "trace.jsonl")
+            times: dict[str, list[float]] = {m: [] for m in orchs}
+            all_gaps: dict[str, list[float]] = {m: [] for m in orchs}
+            for _ in range(max(1, trials)):
+                for mode, orch in orchs.items():
+                    before = len(dispatch_spans(traces[mode]))
+                    t0 = time.perf_counter()
+                    orch.start_training(background=False)
+                    times[mode].append(time.perf_counter() - t0)
+                    spans = dispatch_spans(traces[mode])[before:]
+                    all_gaps[mode].extend(gaps_us(spans))
+            for orch in orchs.values():
+                orch.stop()
+            env_steps = chunks * 10
+            row: dict = {"megachunk_factor": k}
+            for mode in orchs:
+                med = statistics.median(times[mode])
+                g = sorted(all_gaps[mode])
+                row[mode] = {
+                    "agent_steps_per_sec": round(env_steps * 10 / med, 2),
+                    "dispatch_gaps": len(g),
+                    "gap_p50_us": round(pct(g, 0.50), 2),
+                    "gap_p99_us": round(pct(g, 0.99), 2),
+                }
+            if row["async"]["gap_p50_us"] > 0:
+                row["gap_p50_speedup"] = round(
+                    row["sync"]["gap_p50_us"] / row["async"]["gap_p50_us"],
+                    2)
+            row["steps_ratio_async_vs_sync"] = round(
+                row["async"]["agent_steps_per_sec"]
+                / row["sync"]["agent_steps_per_sec"], 3)
+            out["rows"][f"k{k}"] = row
+    return out
+
+
 def bench_obs_sample_cost(samples: int = 20000) -> dict:
     """Structural per-sample telemetry cost, measured directly: the exact
     obs operations the orchestrator adds at ONE sampled metrics boundary
@@ -660,6 +794,7 @@ def main() -> None:
     result["reshard"] = bench_reshard()
     result["obs_overhead"] = bench_obs_overhead()
     result["obs_overhead"]["per_sample"] = bench_obs_sample_cost()
+    result["async_pipeline"] = bench_async_pipeline()
     print(json.dumps(result), flush=True)
 
 

@@ -47,6 +47,21 @@ class DataConfig:
     # bounded queue; falls back to synchronous appends when the native
     # library isn't built.
     async_transition_writer: bool = True
+    # Group-commit watermarks for the PYTHON transitions-journal backend
+    # (data/journal.py): appends batch in memory and hit the disk — one
+    # write + one fsync — when the batch reaches this many records, or on
+    # the first append after this many seconds since the last commit
+    # (watermarks are evaluated AT APPEND TIME; there is no background
+    # timer, so a batch below both watermarks persists only at the next
+    # append, a read, completion, or close. 0 disables that watermark;
+    # both 0/1 = the legacy flush-per-append behavior).
+    # Durability window = the unflushed batch; the CRC-framed torn-tail
+    # recovery contract is unchanged (a crash between watermark commits
+    # loses at most the batch, never the prefix). The C++ async writer
+    # (async_transition_writer) batches in its own background thread and
+    # ignores these knobs.
+    journal_fsync_every_records: int = 64
+    journal_fsync_interval_s: float = 0.5
     # Auto-compact the price-event journal once its REDUNDANCY — events
     # beyond the one snapshot per symbol a compaction would leave — exceeds
     # this count (events replayed at recovery included, so a bloated
@@ -277,6 +292,34 @@ class RuntimeConfig:
     # in-flight megachunk. Inert at megachunk_factor=1 on the single-chunk
     # exact path near episode ends.
     double_buffer_dispatch: bool = False
+    # Async readback & host-offload pipeline (the host-side half of the
+    # dispatch-floor work): the orchestrator's dispatch loop issues
+    # megachunks back-to-back and hands each materialization boundary's
+    # device buffers (stacked (K, ...) metrics + DQN transitions) to a
+    # background consumer thread via a bounded queue. Readback starts with
+    # a non-blocking copy_to_host_async (device_get on the consumer thread
+    # as fallback) and the consumer performs the ENTIRE host-processing
+    # block — metric rows, flight recorder, journaling, fault hooks,
+    # snapshot updates — strictly in chunk order, so the inter-megachunk
+    # dispatch gap no longer includes host time (bench.py
+    # bench_async_pipeline). Semantics preserved exactly: backpressure
+    # when the queue is full (HBM held by in-flight buffers stays bounded),
+    # a drain barrier before the exact-completion K=1 fallback,
+    # get_avg/get_std snapshots and checkpoint/eval cadence decisions, and
+    # supervision parity — a consumer-raised fault is attributed to its
+    # true chunk index and propagates to the dispatcher before the next
+    # megachunk commits state (restart/backoff/heal behavior unchanged,
+    # tests/test_async_pipeline.py). Forced off under the step_override
+    # test seam (lockstep semantics); turn off to recover the pre-pipeline
+    # synchronous loop byte-for-byte.
+    async_pipeline: bool = True
+    # Bounded queue depth of the async pipeline: how many materialization
+    # boundaries may be in flight between dispatcher and consumer before
+    # dispatch blocks (the pipeline_stall span/counter). Each in-flight
+    # boundary pins one megachunk's metric buffers (+ transition batch when
+    # journaling) in device memory, so the knob is also the HBM bound for
+    # readback buffers. Must be >= 1 (validated at construction).
+    pipeline_depth: int = 2
     # Periodic greedy evaluation DURING training: every this many updates
     # the orchestrator runs evaluate() between chunks (one argmax episode
     # replay; the jitted program is cached), feeding the event-log learning

@@ -239,8 +239,15 @@ class PriceDataService:
             log.info("recovered %d fetch events for %s", count, self.cached_symbols())
 
 
-def _open_journal(path: str, *, prefer_native: bool = True) -> Journal:
-    """Open the event journal, preferring the C++ backend when built."""
+def _open_journal(path: str, *, prefer_native: bool = True,
+                  fsync_every_records: int = 1,
+                  fsync_interval_s: float = 0.0) -> Journal:
+    """Open the event journal, preferring the C++ backend when built.
+
+    The group-commit watermarks (``data.journal_fsync_*``) apply to the
+    pure-Python backend only — the C++ journal batches through stdio (and
+    the async writer through its background thread) already; passing them
+    does not change the native backends' durability model."""
     if prefer_native:
         try:
             from sharetrade_tpu.data.native import NativeJournal, native_available
@@ -248,4 +255,5 @@ def _open_journal(path: str, *, prefer_native: bool = True) -> Journal:
                 return NativeJournal(path)  # type: ignore[return-value]
         except ImportError:
             pass
-    return Journal(path)
+    return Journal(path, fsync_every_records=fsync_every_records,
+                   fsync_interval_s=fsync_interval_s)

@@ -83,11 +83,17 @@ def append_transitions(journal, obs, action, reward, next_obs,
 
 
 def read_tail_transitions(path: str, max_rows: int, *,
-                          cutoff_env_steps: int = 0):
+                          cutoff_env_steps: int = 0, journal=None):
     """Read the journal's recovery tail: the most recent records covering at
     most ``max_rows`` rows, skipping records with env_steps beyond
     ``cutoff_env_steps`` (0 = no cutoff), oldest-first so circular-buffer
     "newest wins" pushes are deterministic.
+
+    ``journal`` (optional): the live journal object backing ``path``; when
+    given it is quiesced first (``flush()``) so appends still buffered by a
+    group-commit batch or the C++ async writer are visible to the tail walk
+    — reading the path under a live buffering writer would silently treat
+    the buffered tail as not-yet-written.
 
     Returns ``(obs, action, reward, next_obs, high_water)`` — high_water is
     the max env_steps over ALL intact transition records (the resume-time
@@ -96,6 +102,9 @@ def read_tail_transitions(path: str, max_rows: int, *,
     rows but high_water is still recovered (losing it would re-journal the
     excluded chunks with duplicate stamps and double-fill the next recovery).
     """
+    flush = getattr(journal, "flush", None)
+    if flush is not None:
+        flush()
     native = _native_read_tail(path, max_rows, cutoff_env_steps)
     if native is not NotImplemented:
         return native

@@ -5,7 +5,13 @@ gated by ``ObsConfig`` (everything off by default — zero files, near-zero
 hot-loop cost when disabled):
 
 - :mod:`trace` — host span tracer → ``trace.jsonl`` (Chrome trace events;
-  open in Perfetto / chrome://tracing);
+  open in Perfetto / chrome://tracing). Under ``runtime.async_pipeline``
+  the timeline splits across threads: ``dispatch`` spans stay on the
+  dispatcher tid while ``readback``/``host_process`` move to the consumer
+  tid, joined by ``queue_wait`` (consumer starved — healthy) and
+  ``pipeline_stall`` (dispatcher blocked on the bounded queue — host-bound)
+  spans, with ``pipeline_stalls_total``/``pipeline_queue_depth`` in the
+  metrics export;
 - :mod:`exporter` — background drain of :class:`MetricsRegistry` →
   ``metrics.jsonl`` + Prometheus textfile ``metrics.prom``;
 - :mod:`flight` — bounded ring of recent chunk metrics / lifecycle /

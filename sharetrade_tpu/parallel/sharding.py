@@ -214,7 +214,8 @@ def jit_parallel_step(agent, mesh: Mesh, ts: TrainState, *,
                       data_axis: str = "dp",
                       param_rules: dict[str, P] | None = None,
                       megachunk_factor: int = 1,
-                      constrain: bool = True):
+                      constrain: bool = True,
+                      donate: bool = True):
     """Build the jitted (uncalled) partitioned chunk program and its
     sharding tree: ``(shardings, jitted_fn)``.
 
@@ -248,17 +249,23 @@ def jit_parallel_step(agent, mesh: Mesh, ts: TrainState, *,
     # TrainState into the lax.scan corrupts the heap on the CPU runtime
     # (use-after-free once checkpoint restores interleave with megachunk
     # dispatches — same hazard the orchestrator's CPU-fallback seam avoids).
+    # ``donate=False`` extends the same carve-out to the async-pipeline
+    # orchestrator on CPU meshes: a consumer-thread device_get concurrent
+    # with a donating dispatch segfaults the CPU runtime the same way.
     # Accelerator meshes keep donation, where HBM double-buffering matters.
-    donate = (() if megachunk_factor > 1 and is_cpu_mesh(mesh) else (0,))
+    argnums = ((0,) if donate
+               and not (megachunk_factor > 1 and is_cpu_mesh(mesh))
+               else ())
     fn = jax.jit(step_fn, in_shardings=(sh,), out_shardings=(sh, None),
-                 donate_argnums=donate)
+                 donate_argnums=argnums)
     return sh, fn
 
 
 def make_parallel_step(agent, mesh: Mesh, *, data_axis: str = "dp",
                        param_rules: dict[str, P] | None = None,
                        megachunk_factor: int = 1,
-                       constrain: bool = True):
+                       constrain: bool = True,
+                       donate: bool = True):
     """jit the agent's chunk step with mesh shardings.
 
     Returns ``(place, step)``: ``place(ts)`` device_puts a freshly-initialized
@@ -282,7 +289,7 @@ def make_parallel_step(agent, mesh: Mesh, *, data_axis: str = "dp",
             cache["sh"], cache["fn"] = jit_parallel_step(
                 agent, mesh, ts, data_axis=data_axis,
                 param_rules=param_rules, megachunk_factor=megachunk_factor,
-                constrain=constrain)
+                constrain=constrain, donate=donate)
         return cache
 
     def place(ts: TrainState) -> TrainState:

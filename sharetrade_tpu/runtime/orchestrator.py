@@ -55,6 +55,7 @@ from sharetrade_tpu.env.portfolio import make_portfolio_env
 from sharetrade_tpu.obs import build_obs
 from sharetrade_tpu.parallel import build_mesh, make_parallel_step
 from sharetrade_tpu.runtime.lifecycle import Lifecycle, Phase, QueryReply, ReplyState
+from sharetrade_tpu.runtime.pipeline import AsyncPipeline, Boundary
 from sharetrade_tpu.utils.logging import EventLog, get_logger
 from sharetrade_tpu.utils.metrics import MetricsRegistry
 from sharetrade_tpu.utils.profiling import StepTimer, Tracer
@@ -96,6 +97,22 @@ def _metric_rows(host: dict, k: int) -> list[dict[str, float]]:
     return [{key: float(v[i]) for key, v in host.items()} for i in range(k)]
 
 
+def _start_readback(*trees) -> None:
+    """Kick off non-blocking device→host DMA for every array leaf
+    (``copy_to_host_async`` — the async-checkpoint D2H trick applied to the
+    metric/transition buffers). By the time the pipeline consumer calls its
+    blocking ``device_get``, the bytes are usually already on the host; on
+    backends without the method the consumer's device_get simply blocks on
+    the CONSUMER thread — still off the dispatch critical path."""
+    for tree in trees:
+        for leaf in jax.tree.leaves(tree):
+            if hasattr(leaf, "copy_to_host_async"):
+                try:
+                    leaf.copy_to_host_async()
+                except Exception:   # fallback documented above
+                    return
+
+
 class Orchestrator:
     def __init__(self, cfg: FrameworkConfig, *,
                  mesh=None,
@@ -113,6 +130,12 @@ class Orchestrator:
             raise ConfigError(
                 "runtime.megachunk_factor must be >= 1, got "
                 f"{cfg.runtime.megachunk_factor}")
+        if cfg.runtime.pipeline_depth < 1:
+            # Same class as a bad megachunk factor: an impossible
+            # composition that restarting can never heal — STOP territory.
+            raise ConfigError(
+                "runtime.pipeline_depth must be >= 1, got "
+                f"{cfg.runtime.pipeline_depth}")
         if (cfg.runtime.megachunk_factor > 1
                 and cfg.runtime.metrics_every_chunks
                 % cfg.runtime.megachunk_factor != 0):
@@ -172,6 +195,17 @@ class Orchestrator:
         self._best_eval_lock = threading.Lock()
         self.episode = 0
         self.last_error: BaseException | None = None
+        # Async readback pipeline (runtime.async_pipeline): live only while
+        # a supervised run is in flight; _committed_idx is the consumer's
+        # per-row progress cursor (== the synchronous loop's chunk_idx),
+        # read by the dispatcher for fault attribution and drain math.
+        self._pl: AsyncPipeline | None = None
+        self._committed_idx = 0
+        self._timer: StepTimer | None = None
+        self._last_ckpt_updates = 0
+        #: Stats of the most recent run's pipeline (max queue depth seen,
+        #: dispatcher stalls) — kept after shutdown for tests/benchmarks.
+        self.pipeline_stats: dict[str, int] = {}
         self._transitions_journal = None
         self._journal_high_water = 0  # env_steps already journaled
         self._journal_rows_since_compact = 0
@@ -188,8 +222,16 @@ class Orchestrator:
                 if async_writer_available():
                     self._transitions_journal = AsyncNativeJournal(path)
             if self._transitions_journal is None:
+                # Group-commit knobs (data.journal_fsync_*): consumer-side
+                # appends batch in memory and hit the disk (write + fsync)
+                # on a count/interval watermark instead of one flush per
+                # chunk — the Python-backend half of taking journaling off
+                # the dispatch critical path (the C++ async writer above
+                # already batches in its background thread).
                 self._transitions_journal = _open_journal(
-                    path, prefer_native=cfg.data.use_native_journal)
+                    path, prefer_native=cfg.data.use_native_journal,
+                    fsync_every_records=cfg.data.journal_fsync_every_records,
+                    fsync_interval_s=cfg.data.journal_fsync_interval_s)
 
     # ------------------------------------------------------------------
     # telemetry taps (obs/): wired only when cfg.obs.enabled
@@ -318,6 +360,17 @@ class Orchestrator:
     def _build_step(self) -> None:
         factor = self.cfg.runtime.megachunk_factor
         self._mega_fn = None
+        # Async-pipeline donation carve-out, CPU runtime only: the pipeline
+        # consumer's device_get runs CONCURRENTLY with the dispatcher's
+        # donating dispatch, and on the CPU runtime that combination
+        # corrupts the heap (segfaults in unrelated threads once restores
+        # interleave — the exact hazard the CPU megachunk carve-out below
+        # already documents; reproduced by the supervision tests with the
+        # pipeline on). Accelerator backends keep donation: concurrent D2H
+        # against a donating dispatch is the designed overlap there (same
+        # pattern as CheckpointManager.save_async).
+        async_on = (self.cfg.runtime.async_pipeline
+                    and self._step_override is None)
         if self._step_override is not None:
             # Host-side test seam: an arbitrary Python callable cannot be
             # traced into a lax.scan, so megachunks are unavailable and the
@@ -341,9 +394,11 @@ class Orchestrator:
             # the compiled step's in_shardings expect — no involuntary
             # reshard on the first chunk after a recovery.
             constrain = self.cfg.parallel.shard_constraints
+            from sharetrade_tpu.parallel.mesh import is_cpu_mesh
+            donate = not (async_on and is_cpu_mesh(self.mesh))
             self._place, self._step_fn = make_parallel_step(
                 self.agent, self.mesh, data_axis=self.cfg.parallel.data_axis,
-                param_rules=rules, constrain=constrain)
+                param_rules=rules, constrain=constrain, donate=donate)
             if factor > 1:
                 # The K-chunk scan composes INSIDE the pjit boundary (one
                 # partitioned program), so ICI collectives stay fused across
@@ -353,7 +408,7 @@ class Orchestrator:
                     self.agent, self.mesh,
                     data_axis=self.cfg.parallel.data_axis,
                     param_rules=rules, megachunk_factor=factor,
-                    constrain=constrain)
+                    constrain=constrain, donate=donate)
         else:
             self._place = lambda ts: ts
             # Donated input, matching the mesh path: the previous chunk's
@@ -368,8 +423,14 @@ class Orchestrator:
             # donated — so it recovers via checkpoint restore, losing at
             # most checkpoint_every_updates updates instead of none (the
             # bound holds from chunk 0: _run_supervised writes a baseline
-            # checkpoint before the first chunk).
-            self._step_fn = jax.jit(self.agent.step, donate_argnums=0)
+            # checkpoint before the first chunk). Under the async pipeline
+            # on the CPU backend donation is carved out (see above) — the
+            # cost is one extra live TrainState, on the host-memory
+            # fallback path only.
+            donate = ((0,) if not (async_on
+                                   and jax.default_backend() == "cpu")
+                      else ())
+            self._step_fn = jax.jit(self.agent.step, donate_argnums=donate)
             if factor > 1:
                 # NO donation on the CPU-fallback megachunk: donating the
                 # TrainState into the fused lax.scan corrupts the heap on
@@ -420,10 +481,24 @@ class Orchestrator:
     # ------------------------------------------------------------------
 
     def _run_supervised(self) -> None:
+        """The dispatcher: issues (mega)chunks and makes state-mutating
+        decisions. With ``runtime.async_pipeline`` on, EVERY blocking host
+        sync of the steady state — the batched ``device_get`` readback and
+        the whole host_process block (metric rows, flight recorder,
+        journaling, fault hooks, snapshot) — runs on the pipeline's
+        consumer thread (:meth:`_host_process`), so the inter-megachunk
+        dispatch gap no longer includes host time; the dispatcher drains
+        the pipeline (a strict barrier) before the exact-completion K=1
+        fallback, episode completion, heal/NaN supervision and
+        checkpoint/eval cadence actions (:meth:`_boundary_actions`), and a
+        consumer fault propagates here before the next megachunk commits
+        state. With the knob off (or under ``step_override``) the same two
+        methods run inline — the pre-pipeline synchronous path, byte-
+        identical behavior."""
         rt = self.cfg.runtime
         horizon = self.env.num_steps
         chunk_idx = 0
-        last_ckpt_updates = 0  # reference guards iteration != 0 (:74)
+        self._last_ckpt_updates = 0  # reference guards iteration != 0 (:74)
         # Sampled metrics (config.RuntimeConfig.metrics_every_chunks): a
         # per-chunk float(np.asarray(v)) is a device round-trip that
         # serializes the dispatch pipeline — bench.py documents that exact
@@ -449,6 +524,7 @@ class Orchestrator:
         mega = rt.megachunk_factor if self._mega_fn is not None else 1
         timer = StepTimer(rt.chunk_steps, self.cfg.parallel.num_workers,
                           max_history=self.cfg.obs.max_timer_history or None)
+        self._timer = timer   # the consumer's tick handle (_host_process)
         obs = self.obs
         self.tracer.start()
         # ONE batched readback seeds both the baseline-checkpoint label and
@@ -471,21 +547,75 @@ class Orchestrator:
                 updates0, self._ts, metadata={"episode": self.episode})
         timer.tick()
         last_env_steps: int | None = env_steps0
-        chunks_since = 0
-        # Double-buffered dispatch (runtime.double_buffer_dispatch): the
-        # (metrics, K, agent_heals-at-dispatch) of a megachunk already
-        # issued while its predecessor's rows are read back and processed.
-        # The heals mark lets the health check below recognize a STALE
-        # unhealthy_workers report: rows computed before a boundary heal
-        # still carry the quarantined row, and re-healing it would find no
-        # bad rows and spuriously escalate to a full restart.
+        chunks_since = 0   # chunks since the last materialization decision
+        chunks_ahead = 0   # chunks dispatched past the last boundary row SEEN
+        self._committed_idx = 0
+        # Double-buffered dispatch (runtime.double_buffer_dispatch; sync
+        # path only — the async pipeline subsumes it): the (metrics, K,
+        # agent_heals-at-dispatch) of a megachunk already issued while its
+        # predecessor's rows are read back and processed. The heals mark
+        # lets the health check recognize a STALE unhealthy_workers report:
+        # rows computed before a boundary heal still carry the quarantined
+        # row, and re-healing it would find no bad rows and spuriously
+        # escalate to a full restart.
         pending: tuple[dict, int, int] | None = None
-        while not self._stop.is_set():
+        # Async readback pipeline (runtime.async_pipeline, default on): the
+        # dispatcher below never blocks on a readback — each materialization
+        # boundary's device buffers go to the consumer thread, which runs
+        # _host_process strictly in chunk order. Forced off under the
+        # step_override test seam (lockstep semantics) alongside megachunks.
+        pl: AsyncPipeline | None = None
+        if rt.async_pipeline and self._step_override is None:
+            self.pipeline_stats = {}
+            pl = AsyncPipeline(
+                rt.pipeline_depth, self._host_process,
+                attn_check=self._row_needs_attention,
+                span=obs.span if obs.enabled else None)
+        self._pl = pl
+        # Chunk position of the boundary row _boundary_actions is acting on
+        # in the attention path — a supervision raise from there (NaN loss,
+        # heal escalation) is attributed to ITS boundary, not to however
+        # far ahead the dispatcher has dispatched (sync-path parity).
+        acting_chunk: int | None = None
+        try:
+          while not self._stop.is_set():
             try:
+                acting_chunk = None
+                if pl is not None and (pl.error is not None
+                                       or pl.attention.is_set()):
+                    # A consumer fault, or a boundary row that needs a
+                    # dispatcher-side action (heal / cadence / completion):
+                    # drain so every queued readback lands in order, then
+                    # act on the newest boundary row — the drain barrier
+                    # that keeps supervision and completion exact.
+                    pl.drain()
+                    pl.attention.clear()
+                    if pl.error is not None:
+                        # True-chunk attribution: the consumer's committed
+                        # cursor stopped AT the failing chunk, exactly where
+                        # the synchronous loop's chunk_idx would be.
+                        chunk_idx = self._committed_idx
+                        raise pl.error
+                    if pl.last_row is not None:
+                        last_env_steps = int(pl.last_row["env_steps"])
+                        chunks_ahead = chunk_idx - self._committed_idx
+                    # Act on EVERY flagged row, in chunk order — cadence
+                    # crossings on consecutive boundaries each get their
+                    # action (eval/checkpoint), exactly like the
+                    # synchronous path's per-boundary decision block.
+                    for row, mark, end_idx in pl.take_attention():
+                        acting_chunk = end_idx
+                        ret = self._boundary_actions(row, mark, horizon)
+                        if ret == "completed":
+                            return
+                        if ret == "rearmed":
+                            break   # later rows predate the re-arm
+                    continue
                 if last_env_steps is None:  # after any recovery path
                     last_env_steps = int(
                         jax.device_get(self._ts.env_steps))  # hot-loop-sync-ok: once per recovery, not per chunk
                     chunks_since = 0
+                    chunks_ahead = 0
                 threshold = horizon * (self.episode + 1)
                 if pending is not None:
                     metrics, k, heals_mark = pending
@@ -498,10 +628,29 @@ class Orchestrator:
                     # chunk_steps): no inner chunk can hit the completion
                     # gate, so near episode ends the loop degrades to K=1
                     # dispatches and the gate keeps its exact semantics.
-                    k = (mega if mega > 1
-                         and (last_env_steps + (chunks_since + mega)
-                              * rt.chunk_steps) < threshold
-                         else 1)
+                    can_fuse = (mega > 1
+                                and (last_env_steps + (chunks_ahead + mega)
+                                     * rt.chunk_steps) < threshold)
+                    if (pl is not None and mega > 1 and not can_fuse
+                            and chunks_ahead > 0):
+                        # Drain barrier BEFORE the K=1 exact fallback: the
+                        # fusion guard ran on an upper bound that staled
+                        # while boundaries were in flight; refresh from the
+                        # drained consumer row — often fusion is still
+                        # legal, and the completion math is exact again.
+                        # Only a refresh that actually MOVED the bound
+                        # re-enters the loop: un-materialized fast-path
+                        # chunks have no row to reclaim, and looping on
+                        # them would spin forever — they fall through to
+                        # the K=1 exact path below.
+                        if (pl.drain() and pl.error is None
+                                and pl.last_row is not None):
+                            refreshed = (int(pl.last_row["env_steps"]),
+                                         chunk_idx - self._committed_idx)
+                            if refreshed != (last_env_steps, chunks_ahead):
+                                last_env_steps, chunks_ahead = refreshed
+                                continue    # re-enter: attention first
+                    k = mega if can_fuse else 1
                     # Obs spans ride the SAMPLING cadence, not the chunk
                     # cadence: only the dispatch whose readback will
                     # materialize this sample is timed, so between samples
@@ -513,7 +662,7 @@ class Orchestrator:
                     sampling = obs.enabled and (
                         chunks_since + k >= metrics_every
                         or self._transitions_journal is not None
-                        or (last_env_steps + (chunks_since + k)
+                        or (last_env_steps + (chunks_ahead + k)
                             * rt.chunk_steps) >= threshold)
                     with (obs.span("dispatch", chunk=chunk_idx, k=k)
                           if sampling else _NULL_CTX), self.tracer.span(
@@ -537,28 +686,67 @@ class Orchestrator:
                             self._ts = ts
                 transitions = metrics.pop("transitions", None)
                 chunks_since += k
+                chunks_ahead += k
                 est_env_steps = min(
-                    last_env_steps + chunks_since * rt.chunk_steps, threshold)
+                    last_env_steps + chunks_ahead * rt.chunk_steps, threshold)
                 if (chunks_since < metrics_every and transitions is None
                         and est_env_steps < threshold):
                     chunk_idx += k
                     continue        # fast path: no host materialization
+                if pl is not None:
+                    # Hand the boundary to the consumer: start the D2H copy
+                    # without blocking, enqueue (backpressure when the
+                    # bounded queue is full — in-flight HBM stays bounded),
+                    # and keep dispatching. Readback + the entire
+                    # host_process block happen on the consumer thread.
+                    _start_readback(metrics, transitions)
+                    boundary = Boundary(chunk_idx, k, metrics, transitions,
+                                        heals_mark, chunks_since)
+                    if not pl.try_put(boundary):
+                        with (obs.span("pipeline_stall", chunk=chunk_idx,
+                                       depth=pl.depth)
+                              if obs.enabled else _NULL_CTX):
+                            ok = pl.put(boundary, stop=self._stop)
+                        self.metrics.inc("pipeline_stalls_total")
+                        if not ok:
+                            continue   # fault/stop while blocked: top of
+                                       # loop takes over
+                    self.metrics.record("pipeline_queue_depth", pl.qsize())
+                    chunk_idx += k
+                    chunks_since = 0
+                    if (est_env_steps >= threshold
+                            or self._fault_hook is not None):
+                        # Drain barrier for the exact completion gate: the
+                        # upper bound says this boundary MAY finish the
+                        # episode; wait for its true row (the consumer
+                        # flags attention when it actually completes).
+                        # A fault_hook keeps the SAME barrier on every
+                        # boundary — the chaos seam's contract is dispatch-
+                        # synchronous state (hooks mutate self._ts in the
+                        # supervision tests), so the hook still runs on the
+                        # consumer (fault propagation is exercised) but the
+                        # dispatcher never runs ahead of it.
+                        if (pl.drain() and pl.error is None
+                                and pl.last_row is not None):
+                            last_env_steps = int(pl.last_row["env_steps"])
+                            chunks_ahead = chunk_idx - self._committed_idx
+                    continue
                 if (rt.double_buffer_dispatch and k > 1
                         and transitions is None and self._fault_hook is None
-                        and (last_env_steps + (chunks_since + k)
+                        and (last_env_steps + (chunks_ahead + k)
                              * rt.chunk_steps) < threshold):
-                    # Cruise-regime double buffering: issue megachunk k+1
-                    # BEFORE blocking on this one's readback, so the D2H
-                    # metric transfer below overlaps device compute (the
-                    # async-checkpoint D2H overlap applied to the metrics
-                    # path). Guarded exactly like the fused dispatch (no
-                    # inner chunk of the in-flight program can complete the
-                    # episode), and off when transitions are journaled
-                    # (durability) or a fault_hook is installed (the chaos
-                    # seam needs dispatch-synchronous state). Consequence,
-                    # documented in config.py: fault detection and the
-                    # checkpoint/eval cadence act on a state one in-flight
-                    # megachunk ahead of the rows being read.
+                    # Cruise-regime double buffering (sync path): issue
+                    # megachunk k+1 BEFORE blocking on this one's readback,
+                    # so the D2H metric transfer below overlaps device
+                    # compute (the async-checkpoint D2H overlap applied to
+                    # the metrics path). Guarded exactly like the fused
+                    # dispatch (no inner chunk of the in-flight program can
+                    # complete the episode), and off when transitions are
+                    # journaled (durability) or a fault_hook is installed
+                    # (the chaos seam needs dispatch-synchronous state).
+                    # Consequence, documented in config.py: fault detection
+                    # and the checkpoint/eval cadence act on a state one
+                    # in-flight megachunk ahead of the rows being read.
                     # The span covers the chunks the prefetch advances
                     # (chunk_idx + k onward) so the trace keeps one
                     # train_chunk_* entry per dispatch, not just the first.
@@ -573,171 +761,46 @@ class Orchestrator:
                             ts, ahead = self._mega_fn(self._ts)
                             self._ts = ts
                     pending = (ahead, k, self.agent_heals)
-                # ONE batched readback for the whole megachunk: the stacked
-                # (K, ...) metric rows and (for DQN journaling) the stacked
-                # transition batch cross to the host together, replacing the
-                # per-chunk float(np.asarray(...)) scalar round-trips
-                # (tools/lint_hot_loop.py pins this).
-                with (obs.span("readback", chunk=chunk_idx, k=k)
-                      if obs.enabled else _NULL_CTX):
-                    host, host_tr = jax.device_get((metrics, transitions))  # hot-loop-sync-ok: THE batched megachunk readback
-                with (obs.span("host_process", chunk=chunk_idx, k=k)
-                      if obs.enabled else _NULL_CTX):
-                    rows = _metric_rows(host, k)
-                    base = chunk_idx
-                    for i, row in enumerate(rows):
-                        if obs.enabled:
-                            # Into the flight ring BEFORE the fault hook /
-                            # health checks that can raise on this row: at
-                            # dump time the ring's newest chunk_metrics
-                            # entry IS the failing chunk.
-                            obs.record("chunk_metrics", chunk=base + i,
-                                       **row)
-                        if host_tr is not None:
-                            self._journal_transitions(
-                                jax.tree.map(lambda a: a[i], host_tr)
-                                if k > 1 else host_tr,
-                                int(row["env_steps"]))
-                        if self._fault_hook is not None:
-                            # Per inner chunk with its TRUE chunk index: a
-                            # fault landing mid-megachunk surfaces at the
-                            # boundary but is attributed (and, on raise,
-                            # retried) at the chunk that raised it.
-                            self._fault_hook(base + i, row)
-                        chunk_idx = base + i + 1
-                        if i + 1 < k:
-                            # Inner (non-boundary) rows keep the per-chunk
-                            # metric stream complete — delivered late, at
-                            # the boundary; snapshot/supervision/cadence
-                            # below read the boundary row, which subsumes
-                            # them (quarantine and counters are monotone
-                            # within a megachunk).
-                            self.metrics.record_many(row)
-                    metrics = rows[-1]
-                    metrics.update(timer.tick(chunks_since))
-                    last_env_steps = int(metrics["env_steps"])
-                    chunks_since = 0
-                    with self._snapshot_lock:
-                        self._snapshot = metrics
-                    self.metrics.record_many(metrics)
-
-                workers = self.cfg.parallel.num_workers
-                if (rt.partial_recovery
-                        and metrics.get("unhealthy_workers", 0) > 0
-                        # Stale report from a pre-heal in-flight megachunk
-                        # (double buffering): the row was already respawned
-                        # at the previous boundary; the next fresh megachunk
-                        # re-reports if the fault actually persists.
-                        and heals_mark == self.agent_heals):
-                    # Quarantined rows detected: respawn just those agents
-                    # (the reference's one-dead-child heal). Raising falls
-                    # through to the supervision decider -> full restore.
-                    # A recurring fault must not heal->re-poison->heal
-                    # forever: past the heal budget it escalates to the
-                    # restart path, whose max_restarts bounds availability.
-                    if (self.agent_heals >= rt.max_agent_heals
-                            or not self._heal_agents()):
-                        raise RuntimeError(
-                            f"{int(metrics['unhealthy_workers'])} agent(s) "
-                            "non-finite and beyond row respawn "
-                            f"(heals used: {self.agent_heals}/"
-                            f"{rt.max_agent_heals})")
-                if (rt.partial_recovery
-                        and not np.isfinite(metrics.get("loss", 0.0))):
-                    # Poison reached the shared loss (and so the params on
-                    # the next update): beyond any row respawn — full
-                    # checkpoint restore via the supervision path.
-                    raise RuntimeError("non-finite training loss "
-                                       "(shared state poisoned)")
-
-                updates = int(metrics.get("updates", 0))
-                if (rt.eval_every_updates > 0
-                        and updates // rt.eval_every_updates
-                        > last_ckpt_updates // rt.eval_every_updates):
-                    # Periodic greedy eval between chunks: feeds the
-                    # event-log learning curve and (keep_best_eval) the
-                    # retained-best checkpoint during long unattended runs.
-                    # Contained: an eval/retention failure (e.g. disk full
-                    # in save_tagged) is an observability loss, not a
-                    # training fault — it must not consume a restart or
-                    # roll the healthy run back to a checkpoint.
-                    try:
-                        self.evaluate()
-                    except Exception:
-                        log.exception("periodic evaluation failed; "
-                                      "training continues")
-                if (rt.checkpoint_every_updates > 0
-                        and updates // rt.checkpoint_every_updates
-                        > last_ckpt_updates // rt.checkpoint_every_updates):
-                    # Async: device->host DMA overlaps the next chunk.
-                    # The episode index rides the metadata: env_steps alone
-                    # can't recover it once per-agent heals inflate the step
-                    # count past horizon-per-episode.
-                    self.checkpoints.save_async(
-                        updates, self._ts, metadata={"episode": self.episode})
-                    self.metrics.inc("checkpoints_total")
-                    self.events.emit("checkpoint", updates=updates)
-                last_ckpt_updates = updates
-
-                # env_steps is cumulative across episodes (the epsilon ramp
-                # input), so episode N completes at (N+1) x horizon. With
-                # per-agent healing, a respawned row restarts its episode
-                # mid-run and may still be training when the step count
-                # crosses the threshold — completion additionally waits for
-                # every worker's cursor to reach the horizon (the reference
-                # completes only when all 10 children report Trained,
-                # including replacements, TrainerRouterActor.scala:114,125).
-                done_steps = (int(metrics.get("env_steps", 0))
-                              >= horizon * (self.episode + 1))
-                # With partial_recovery off, a quarantined row can never be
-                # respawned: it would strand the all-trained gate forever
-                # (the learners' on-device quarantine is unconditional), so
-                # stranded rows count as excluded — the run completes
-                # without them, like a dead child nobody respawns.
-                stranded = (0.0 if rt.partial_recovery
-                            else metrics.get("unhealthy_workers", 0.0))
-                all_trained = (metrics.get("trained_workers", float(workers))
-                               + stranded >= workers)
-                if done_steps and all_trained:
-                    self.episode += 1
-                    self.metrics.inc("episodes_completed_total")
-                    if self.episode < rt.episodes:
-                        # Re-arm for another pass over the history, keeping
-                        # learned parameters (the Initialise→Train cycle,
-                        # TrainerChildActor.scala:57-59).
-                        self.events.emit("episode_completed",
-                                         episode=self.episode)
-                        self._reset_episode()
-                        continue
-                    self.checkpoints.wait_pending(timeout=60)
-                    self.checkpoints.save(updates, self._ts,
-                                          metadata={"episode": self.episode})
-                    self.lifecycle.to(Phase.TRAINED)
-                    self.lifecycle.to(Phase.COMPLETED)
-                    self.tracer.stop()
-                    self.events.emit("training_completed",
-                                     env_steps=int(metrics["env_steps"]),
-                                     episodes=self.episode,
-                                     **timer.summary())
-                    obs.flush()   # trace + final metrics drain durable now
-                    log.info("training completed at %d env steps", horizon)
+                # Synchronous path: readback + host processing inline (the
+                # pre-pipeline behavior, byte-identical).
+                metrics = self._host_process(Boundary(
+                    chunk_idx, k, metrics, transitions, heals_mark,
+                    chunks_since))
+                chunk_idx = self._committed_idx
+                last_env_steps = int(metrics["env_steps"])
+                chunks_since = 0
+                chunks_ahead = 0
+                ret = self._boundary_actions(metrics, heals_mark, horizon)
+                if ret == "completed":
                     return
-                if (not rt.partial_recovery
-                        and metrics.get("unhealthy_workers", 0) >= workers):
-                    # Every row non-finite with healing disabled AND the run
-                    # not complete: the unconditional on-device quarantine
-                    # freezes every cursor, so no further progress is
-                    # possible — route through the supervision path instead
-                    # of spinning chunks forever. (Checked AFTER the
-                    # completion gate: a run whose last chunk both finishes
-                    # the episode and poisons every row still completes via
-                    # the stranded-rows-excluded path above.)
-                    raise RuntimeError(
-                        "all agent rows non-finite (partial_recovery off); "
-                        "no further progress is possible")
             except Exception as exc:  # supervision decider
                 last_env_steps = None   # resync after any recovery path
                 pending = None          # in-flight megachunk is now stale
+                pipeline_fault = pl is not None and exc is pl.error
+                if pl is not None:
+                    # Quiesce and replace the pipeline: boundaries still
+                    # queued were computed from state the restore below
+                    # rewinds — they are stale, and the fresh run segment
+                    # re-materializes those chunks.
+                    pl.shutdown()
+                    self._record_pipeline_stats(pl)
+                    pl = AsyncPipeline(
+                        rt.pipeline_depth, self._host_process,
+                        attn_check=self._row_needs_attention,
+                        span=obs.span if obs.enabled else None)
+                    self._pl = pl
+                # Attribution: a consumer fault belongs to the chunk the
+                # consumer committed last; a supervision raise from the
+                # attention path belongs to the boundary row it was acting
+                # on (the dispatcher may be several megachunks ahead of
+                # both); any other dispatcher-local fault keeps its own
+                # position (the consumer can only be behind it).
+                if pipeline_fault:
+                    chunk_idx = self._committed_idx
+                elif acting_chunk is not None:
+                    chunk_idx = acting_chunk
+                else:
+                    chunk_idx = max(chunk_idx, self._committed_idx)
                 self.last_error = exc
                 verb = self._decide(exc)
                 self.events.emit("worker_failed", error=repr(exc), verb=verb,
@@ -789,6 +852,229 @@ class Orchestrator:
                 # Exclude the failed chunk + backoff + restore from the
                 # next throughput sample.
                 timer.rebase()
+        finally:
+            self._pl = None
+            if pl is not None:
+                pl.shutdown()
+                self._record_pipeline_stats(pl)
+
+    def _record_pipeline_stats(self, pl: AsyncPipeline) -> None:
+        self.pipeline_stats = {
+            "max_depth_seen": max(
+                self.pipeline_stats.get("max_depth_seen", 0),
+                pl.max_depth_seen),
+            "boundaries": (self.pipeline_stats.get("boundaries", 0)
+                           + pl.processed),
+        }
+
+    def _host_process(self, b: Boundary) -> dict[str, float]:
+        """The consumer half: ONE batched readback for the whole megachunk
+        (the stacked (K, ...) metric rows and, for DQN journaling, the
+        stacked transition batch cross together), then the per-row host
+        work — flight-ring records, journal appends, fault hooks, metric
+        stream, snapshot — strictly in chunk order. Runs on the pipeline's
+        consumer thread under ``runtime.async_pipeline`` (every blocking
+        call here is off the dispatch critical path), inline on the
+        dispatcher otherwise. ``self._committed_idx`` advances per row and
+        is the fault-attribution cursor either way."""
+        obs = self.obs
+        self._committed_idx = b.base
+        with (obs.span("readback", chunk=b.base, k=b.k)
+              if obs.enabled else _NULL_CTX):
+            host, host_tr = jax.device_get((b.metrics, b.transitions))  # hot-loop-sync-ok: consumer-side batched megachunk readback, off the dispatch path
+        with (obs.span("host_process", chunk=b.base, k=b.k)
+              if obs.enabled else _NULL_CTX):
+            rows = _metric_rows(host, b.k)
+            for i, row in enumerate(rows):
+                if obs.enabled:
+                    # Into the flight ring BEFORE the fault hook / health
+                    # checks that can raise on this row: at dump time the
+                    # ring's newest chunk_metrics entry IS the failing
+                    # chunk.
+                    obs.record("chunk_metrics", chunk=b.base + i, **row)
+                if host_tr is not None:
+                    self._journal_transitions(
+                        jax.tree.map(lambda a: a[i], host_tr)
+                        if b.k > 1 else host_tr,
+                        int(row["env_steps"]))
+                if self._fault_hook is not None:
+                    # Per inner chunk with its TRUE chunk index: a fault
+                    # landing mid-megachunk surfaces at the boundary but is
+                    # attributed (and, on raise, retried) at the chunk that
+                    # raised it.
+                    self._fault_hook(b.base + i, row)
+                self._committed_idx = b.base + i + 1
+                if i + 1 < b.k:
+                    # Inner (non-boundary) rows keep the per-chunk metric
+                    # stream complete — delivered late, at the boundary;
+                    # snapshot/supervision/cadence read the boundary row,
+                    # which subsumes them (quarantine and counters are
+                    # monotone within a megachunk).
+                    self.metrics.record_many(row)
+            metrics = rows[-1]
+            metrics.update(self._timer.tick(b.chunks_covered))
+            with self._snapshot_lock:
+                self._snapshot = metrics
+            self.metrics.record_many(metrics)
+            return metrics
+
+    def _row_needs_attention(self, row: dict[str, float]) -> bool:
+        """Consumer-side hint: does this boundary row need a DISPATCHER
+        action (heal, NaN supervision, eval/checkpoint cadence, episode
+        completion)? Over-triggering is harmless — the dispatcher drains
+        and re-evaluates the exact conditions in _boundary_actions — so the
+        reads here tolerate benign races with dispatcher-owned state."""
+        rt = self.cfg.runtime
+        unhealthy = row.get("unhealthy_workers", 0)
+        if rt.partial_recovery and unhealthy > 0:
+            return True
+        if rt.partial_recovery and not np.isfinite(row.get("loss", 0.0)):  # hot-loop-sync-ok: consumer thread, host floats
+            return True
+        if (not rt.partial_recovery
+                and unhealthy >= self.cfg.parallel.num_workers):
+            return True
+        updates = int(row.get("updates", 0))
+        last = self._last_ckpt_updates
+        for every in (rt.eval_every_updates, rt.checkpoint_every_updates):
+            if every > 0 and updates // every > last // every:
+                return True
+        return (int(row.get("env_steps", 0))
+                >= self.env.num_steps * (self.episode + 1))
+
+    def _boundary_actions(self, metrics: dict[str, float], heals_mark: int,
+                          horizon: int) -> str | None:
+        """Dispatcher-side decisions on a boundary row: per-agent healing,
+        NaN supervision (raises feed the decider), eval/checkpoint cadence,
+        and the episode-completion gate. Runs inline on the synchronous
+        path; under the async pipeline it runs only after a drain barrier,
+        so the row is the newest and the live state corresponds to it.
+        Returns "completed" (terminal — caller returns), "rearmed" (episode
+        re-armed), or None."""
+        rt = self.cfg.runtime
+        timer = self._timer
+        obs = self.obs
+        workers = self.cfg.parallel.num_workers
+        if (rt.partial_recovery
+                and metrics.get("unhealthy_workers", 0) > 0
+                # Stale report from a pre-heal in-flight megachunk (double
+                # buffering / pipeline depth): the row was already respawned
+                # at the previous boundary; the next fresh megachunk
+                # re-reports if the fault actually persists.
+                and heals_mark == self.agent_heals):
+            # Quarantined rows detected: respawn just those agents
+            # (the reference's one-dead-child heal). Raising falls
+            # through to the supervision decider -> full restore.
+            # A recurring fault must not heal->re-poison->heal
+            # forever: past the heal budget it escalates to the
+            # restart path, whose max_restarts bounds availability.
+            if (self.agent_heals >= rt.max_agent_heals
+                    or not self._heal_agents()):
+                raise RuntimeError(
+                    f"{int(metrics['unhealthy_workers'])} agent(s) "
+                    "non-finite and beyond row respawn "
+                    f"(heals used: {self.agent_heals}/"
+                    f"{rt.max_agent_heals})")
+        if (rt.partial_recovery
+                and not np.isfinite(metrics.get("loss", 0.0))):
+            # Poison reached the shared loss (and so the params on
+            # the next update): beyond any row respawn — full
+            # checkpoint restore via the supervision path.
+            raise RuntimeError("non-finite training loss "
+                               "(shared state poisoned)")
+
+        updates = int(metrics.get("updates", 0))
+        if (rt.eval_every_updates > 0
+                and updates // rt.eval_every_updates
+                > self._last_ckpt_updates // rt.eval_every_updates):
+            # Periodic greedy eval between chunks: feeds the
+            # event-log learning curve and (keep_best_eval) the
+            # retained-best checkpoint during long unattended runs.
+            # Contained: an eval/retention failure (e.g. disk full
+            # in save_tagged) is an observability loss, not a
+            # training fault — it must not consume a restart or
+            # roll the healthy run back to a checkpoint.
+            try:
+                self.evaluate()
+            except Exception:
+                log.exception("periodic evaluation failed; "
+                              "training continues")
+        if (rt.checkpoint_every_updates > 0
+                and updates // rt.checkpoint_every_updates
+                > self._last_ckpt_updates // rt.checkpoint_every_updates):
+            # Async: device->host DMA overlaps the next chunk.
+            # The episode index rides the metadata: env_steps alone
+            # can't recover it once per-agent heals inflate the step
+            # count past horizon-per-episode.
+            self.checkpoints.save_async(
+                updates, self._ts, metadata={"episode": self.episode})
+            self.metrics.inc("checkpoints_total")
+            self.events.emit("checkpoint", updates=updates)
+        self._last_ckpt_updates = updates
+
+        # env_steps is cumulative across episodes (the epsilon ramp
+        # input), so episode N completes at (N+1) x horizon. With
+        # per-agent healing, a respawned row restarts its episode
+        # mid-run and may still be training when the step count
+        # crosses the threshold — completion additionally waits for
+        # every worker's cursor to reach the horizon (the reference
+        # completes only when all 10 children report Trained,
+        # including replacements, TrainerRouterActor.scala:114,125).
+        done_steps = (int(metrics.get("env_steps", 0))
+                      >= horizon * (self.episode + 1))
+        # With partial_recovery off, a quarantined row can never be
+        # respawned: it would strand the all-trained gate forever
+        # (the learners' on-device quarantine is unconditional), so
+        # stranded rows count as excluded — the run completes
+        # without them, like a dead child nobody respawns.
+        stranded = (0.0 if rt.partial_recovery
+                    else metrics.get("unhealthy_workers", 0.0))
+        all_trained = (metrics.get("trained_workers", float(workers))
+                       + stranded >= workers)
+        if done_steps and all_trained:
+            self.episode += 1
+            self.metrics.inc("episodes_completed_total")
+            if self.episode < rt.episodes:
+                # Re-arm for another pass over the history, keeping
+                # learned parameters (the Initialise→Train cycle,
+                # TrainerChildActor.scala:57-59).
+                self.events.emit("episode_completed",
+                                 episode=self.episode)
+                self._reset_episode()
+                return "rearmed"
+            self.checkpoints.wait_pending(timeout=60)
+            self.checkpoints.save(updates, self._ts,
+                                  metadata={"episode": self.episode})
+            # Completion is a durability point: group-commit batches (and
+            # the C++ async writer's queue) drain to disk before the run
+            # reports COMPLETED, so a reader of the journal file sees every
+            # journaled chunk the moment the lifecycle says done.
+            flush = getattr(self._transitions_journal, "flush", None)
+            if flush is not None:
+                flush()
+            self.lifecycle.to(Phase.TRAINED)
+            self.lifecycle.to(Phase.COMPLETED)
+            self.tracer.stop()
+            self.events.emit("training_completed",
+                             env_steps=int(metrics["env_steps"]),
+                             episodes=self.episode,
+                             **timer.summary())
+            obs.flush()   # trace + final metrics drain durable now
+            log.info("training completed at %d env steps", horizon)
+            return "completed"
+        if (not rt.partial_recovery
+                and metrics.get("unhealthy_workers", 0) >= workers):
+            # Every row non-finite with healing disabled AND the run
+            # not complete: the unconditional on-device quarantine
+            # freezes every cursor, so no further progress is
+            # possible — route through the supervision path instead
+            # of spinning chunks forever. (Checked AFTER the
+            # completion gate: a run whose last chunk both finishes
+            # the episode and poisons every row still completes via
+            # the stranded-rows-excluded path above.)
+            raise RuntimeError(
+                "all agent rows non-finite (partial_recovery off); "
+                "no further progress is possible")
+        return None
 
     def _reset_episode(self) -> None:
         """Fresh env cursors/carry/RNG for the next episode; parameters,
@@ -974,10 +1260,13 @@ class Orchestrator:
         # between checkpoint and crash re-run with restored RNG and push
         # identical transitions themselves — filling them here too would
         # double-count them in the live buffer. cutoff=0 (fresh init) keeps
-        # nothing but still recovers the high-water mark.
+        # nothing but still recovers the high-water mark. journal= makes
+        # the reader quiesce group-commit/async-writer buffers first, so
+        # every append that returned is visible to the tail walk.
         tail = read_tail_transitions(self._transitions_journal.path,
                                      capacity if cutoff > 0 else 1,
-                                     cutoff_env_steps=cutoff)
+                                     cutoff_env_steps=cutoff,
+                                     journal=self._transitions_journal)
         # Recover the journaling high-water mark so chunks replayed between
         # the restored checkpoint and the crash point aren't re-journaled.
         self._journal_high_water = max(
@@ -1010,7 +1299,20 @@ class Orchestrator:
             return QueryReply(ReplyState.NOT_COMPUTED)
         return QueryReply(ReplyState.COMPLETED)
 
+    def _drain_pipeline(self) -> None:
+        """Barrier for external readers: wait until every boundary enqueued
+        so far has been consumed, so ``get_avg``/``get_std``/``snapshot``
+        answer from the newest processed chunk — the async pipeline must
+        not make queries staler than the synchronous path's sampling
+        cadence already allows. No-op when no pipeline is live, from the
+        consumer thread itself, or after a consumer fault (the supervision
+        path owns recovery)."""
+        pl = self._pl
+        if pl is not None:
+            pl.drain(timeout_s=30.0)
+
     def _stat(self, key: str, *, trained_only: bool = False) -> QueryReply:
+        self._drain_pipeline()
         phase = self.lifecycle.phase
         if phase is Phase.AWAITING_DATA:
             return QueryReply(ReplyState.NO_TRAINING_DATA)
@@ -1049,6 +1351,7 @@ class Orchestrator:
         return self._stat("portfolio_std", trained_only=trained_only)
 
     def snapshot(self) -> dict[str, float]:
+        self._drain_pipeline()
         with self._snapshot_lock:
             return dict(self._snapshot)
 

@@ -1,0 +1,319 @@
+"""Async readback & host-offload pipeline tests (runtime.async_pipeline).
+
+The contract under test: with the pipeline ON, the dispatcher hands every
+materialization boundary to a background consumer thread — readback, metric
+rows, journaling, fault hooks and snapshots all run off the dispatch
+critical path — while the OBSERVABLE run is unchanged: bit-identical
+TrainState, metric stream and journal contents vs the synchronous path on a
+fixed seed; bounded queue depth (backpressure, HBM in flight); consumer
+faults attributed to their true chunk index with the same restart/backoff
+sequence and flight-recorder forensics; and drain barriers that keep
+``get_avg``/``get_std`` and episode completion exact.
+"""
+
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from sharetrade_tpu.config import ConfigError, FrameworkConfig
+from sharetrade_tpu.runtime import Orchestrator, ReplyState, run_end_to_end
+from sharetrade_tpu.runtime.pipeline import AsyncPipeline, Boundary
+
+WINDOW = 8
+#: 256-step episode: long enough that a K=8 megachunk cruises for the first
+#: half and the loop then falls back to K=1 near the completion threshold.
+PRICES = np.linspace(10.0, 20.0, 264, dtype=np.float32)
+#: Deterministic metric keys (the throughput keys from StepTimer are
+#: wall-clock and differ between any two runs, sync or not).
+DETERMINISTIC_KEYS = ("loss", "env_steps", "updates", "reward_sum",
+                      "portfolio_mean", "portfolio_std")
+
+
+def fast_cfg(tmp_path, *, megachunk=1, algo="qlearn", async_on=True, tag=""):
+    cfg = FrameworkConfig()
+    cfg.learner.algo = algo
+    cfg.env.window = WINDOW
+    cfg.model.hidden_dim = 8
+    cfg.parallel.num_workers = 4
+    cfg.runtime.chunk_steps = 16
+    cfg.runtime.checkpoint_every_updates = 64
+    cfg.runtime.checkpoint_dir = str(tmp_path / f"ckpts_{tag or async_on}")
+    cfg.runtime.backoff_initial_s = 0.01
+    cfg.runtime.backoff_max_s = 0.05
+    cfg.runtime.max_restarts = 3
+    cfg.runtime.metrics_every_chunks = 1   # per-chunk stream for parity
+    cfg.runtime.megachunk_factor = megachunk
+    cfg.runtime.async_pipeline = async_on
+    return cfg
+
+
+def _assert_states_identical(a, b):
+    for la, lb in zip(jax.tree.leaves(jax.device_get(a)),
+                      jax.tree.leaves(jax.device_get(b))):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+class TestAsyncSyncParity:
+    def test_async_bit_identical_to_sync(self, tmp_path):
+        """The acceptance row: the same fixed-seed run with the pipeline on
+        produces the SAME TrainState, the SAME ordered per-chunk metric
+        stream and the same query answers as the synchronous path — the
+        pipeline reorders host work, never device results."""
+        runs = {}
+        for mode in (False, True):
+            orch = run_end_to_end(
+                fast_cfg(tmp_path, megachunk=8, async_on=mode,
+                         tag=f"par_{mode}"), PRICES)
+            assert orch.is_everything_done().state is ReplyState.COMPLETED
+            assert orch.restarts == 0
+            runs[mode] = orch
+        _assert_states_identical(runs[False].train_state,
+                                 runs[True].train_state)
+        for key in DETERMINISTIC_KEYS:
+            s_sync = [v for _, v in runs[False].metrics.series(key)]
+            s_async = [v for _, v in runs[True].metrics.series(key)]
+            assert s_sync == s_async, f"metric stream diverged for {key!r}"
+        assert runs[False].get_avg().value == runs[True].get_avg().value
+        assert runs[False].get_std().value == runs[True].get_std().value
+        # The run actually went through the pipeline, within its depth.
+        stats = runs[True].pipeline_stats
+        assert stats["boundaries"] > 0
+        assert stats["max_depth_seen"] <= 2
+
+    def test_async_dqn_journal_contents_identical(self, tmp_path):
+        """DQN journaling through the consumer thread: record-for-record
+        identical journal payloads (same framing, same env_steps stamps,
+        same transition bytes) as the synchronous path, and the file is
+        fully flushed — group-commit batches included — the moment the run
+        reports COMPLETED."""
+        from sharetrade_tpu.data.journal import iter_framed_records
+        payloads = {}
+        for mode in (False, True):
+            cfg = fast_cfg(tmp_path, megachunk=4, algo="dqn",
+                           async_on=mode, tag=f"dqn_{mode}")
+            cfg.runtime.chunk_steps = 8
+            cfg.learner.journal_replay = True
+            cfg.learner.replay_capacity = 4096
+            cfg.learner.replay_batch = 8
+            cfg.data.journal_dir = str(tmp_path / f"journal_{mode}")
+            prices = np.linspace(10.0, 20.0, 72, dtype=np.float32)
+            orch = run_end_to_end(cfg, prices)
+            assert orch.is_everything_done().state is ReplyState.COMPLETED
+            # Read the file BEFORE stop(): completion itself must have
+            # flushed every journaled chunk (the durability point).
+            payloads[mode] = [
+                p for _off, p in iter_framed_records(
+                    f"{cfg.data.journal_dir}/transitions.journal")]
+            orch.stop()
+        assert payloads[True] == payloads[False]
+        assert len(payloads[True]) > 0
+
+    def test_invalid_depth_rejected_at_construction(self, tmp_path):
+        cfg = fast_cfg(tmp_path)
+        cfg.runtime.pipeline_depth = 0
+        with pytest.raises(ConfigError, match="pipeline_depth"):
+            Orchestrator(cfg)
+
+
+class TestBackpressure:
+    def test_bounded_queue_blocks_producer(self):
+        """Unit contract: a producer faster than the consumer parks in
+        ``put`` (backpressure) instead of growing the queue — occupancy
+        never exceeds the configured depth, and every boundary is still
+        consumed exactly once, in order."""
+        release = threading.Event()
+        seen = []
+
+        def consume(b):
+            release.wait(2.0)
+            seen.append(b.base)
+            return {"env_steps": float(b.base)}
+
+        pl = AsyncPipeline(2, consume)
+        producer_done = threading.Event()
+
+        def produce():
+            for i in range(8):
+                b = Boundary(i, 1, None, None, 0, 1)
+                if not pl.try_put(b):
+                    pl.put(b)
+            producer_done.set()
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        # Consumer parked: at most depth items queued (+1 in-hand), and the
+        # producer is blocked well short of 8.
+        assert pl.max_depth_seen <= 2
+        assert not producer_done.is_set()
+        release.set()
+        t.join(5.0)
+        assert producer_done.is_set()
+        assert pl.drain()
+        assert seen == list(range(8))        # strict chunk order
+        assert pl.processed == pl.enqueued == 8
+        pl.shutdown()
+
+    def test_drain_is_a_strict_barrier(self):
+        """drain() returns only after every boundary enqueued at call time
+        was consumed — the exactness gate the orchestrator puts in front of
+        completion checks and query snapshots."""
+        gate = threading.Event()
+        done = []
+
+        def consume(b):
+            gate.wait(2.0)
+            done.append(b.base)
+            return {"env_steps": float(b.base)}
+
+        pl = AsyncPipeline(4, consume)
+        for i in range(3):
+            assert pl.try_put(Boundary(i, 1, None, None, 0, 1))
+        assert len(done) == 0
+        gate.set()
+        assert pl.drain()
+        assert done == [0, 1, 2]
+        pl.shutdown()
+
+    def test_consumer_fault_surfaces_not_hangs(self):
+        """A consumer exception parks the pipeline in the error state: the
+        original exception object is preserved, drain() reports failure
+        instead of blocking, and later boundaries are discarded."""
+        boom = RuntimeError("consumer boom")
+
+        def consume(b):
+            raise boom
+
+        pl = AsyncPipeline(2, consume)
+        assert pl.try_put(Boundary(0, 1, None, None, 0, 1))
+        assert not pl.drain(timeout_s=5.0)
+        assert pl.error is boom
+        assert pl.attention.is_set()
+        # Error state: puts are accepted-and-dropped, nothing deadlocks.
+        assert pl.try_put(Boundary(1, 1, None, None, 0, 1))
+        pl.shutdown()
+
+
+class TestConsumerFaultParity:
+    def _run_chaos(self, tmp_path, *, async_on):
+        cfg = fast_cfg(tmp_path, megachunk=4, async_on=async_on,
+                       tag=f"chaos_{async_on}")
+        cfg.obs.enabled = True
+        cfg.obs.dir = str(tmp_path / f"obs_{async_on}")
+        seen, fired = [], []
+
+        def chaos(chunk_idx, metrics):
+            seen.append(chunk_idx)
+            if chunk_idx == 2 and not fired:
+                fired.append(1)
+                raise RuntimeError("injected mid-megachunk PoisonPill")
+
+        orch = Orchestrator(cfg, fault_hook=chaos)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        with open(f"{cfg.obs.dir}/flight_recorder.json") as f:
+            bundle = json.load(f)
+        orch.stop()
+        return {
+            "restarts": orch.restarts,
+            "seen_head": seen[:4],
+            "next_chunk": bundle["context"]["next_chunk"],
+            "failing_chunk": bundle.get("failing_chunk"),
+            "reason": bundle.get("reason"),
+        }
+
+    def test_fault_attribution_matches_sync(self, tmp_path):
+        """The acceptance row: a fault injected at an inner megachunk index
+        via fault_hook — which under the pipeline RAISES ON THE CONSUMER
+        THREAD — produces the same flight-recorder dump (failing chunk,
+        next_chunk), the same true-chunk attribution/retry order and the
+        same restart count as the synchronous path."""
+        sync = self._run_chaos(tmp_path, async_on=False)
+        asyn = self._run_chaos(tmp_path, async_on=True)
+        assert asyn == sync
+        assert asyn["restarts"] == 1
+        # Inner chunks 0-1 processed from the stacked rows, the fault fired
+        # at TRUE index 2, and the restarted loop retried chunk 2.
+        assert asyn["seen_head"] == [0, 1, 2, 2]
+        assert asyn["next_chunk"] == 2
+
+    def test_restart_budget_parity_under_pipeline(self, tmp_path):
+        """A persistent consumer fault consumes the SAME restart budget as
+        the synchronous path and lands in the same FAILED terminal."""
+        outcomes = {}
+        for mode in (False, True):
+            cfg = fast_cfg(tmp_path, async_on=mode, tag=f"budget_{mode}")
+
+            def always_fail(chunk_idx, metrics):
+                raise RuntimeError("persistent fault")
+
+            orch = Orchestrator(cfg, fault_hook=always_fail)
+            orch.send_training_data(PRICES)
+            orch.start_training(background=False)
+            outcomes[mode] = (orch.is_everything_done().state,
+                              orch.restarts)
+            orch.stop()
+        assert outcomes[True] == outcomes[False]
+        assert outcomes[True] == (ReplyState.NOT_COMPUTED,
+                                  fast_cfg(tmp_path).runtime.max_restarts + 1)
+
+
+class TestDrainBarrier:
+    def test_completion_exact_under_async(self, tmp_path):
+        """Two episodes, K=8, sampling coarser than the run: the pipeline's
+        drain barrier near each episode threshold keeps the completion gate
+        exact — the run finishes at EXACTLY episodes x horizon env steps
+        with exactly the K=1 chunk count, no fused overshoot."""
+        from sharetrade_tpu.utils.logging import EventLog
+        cfg = fast_cfg(tmp_path, megachunk=8, async_on=True, tag="exact")
+        cfg.runtime.metrics_every_chunks = 1000
+        cfg.runtime.episodes = 2
+        events_path = str(tmp_path / "events.jsonl")
+        orch = Orchestrator(cfg, event_log=EventLog(events_path))
+        orch.send_training_data(PRICES)
+        orch.start_training(background=False)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.restarts == 0
+        horizon = len(PRICES) - WINDOW
+        done = [json.loads(l) for l in open(events_path)
+                if json.loads(l)["kind"] == "training_completed"][0]
+        assert done["env_steps"] == 2 * horizon       # exact, no overshoot
+        chunks_per_episode = -(-horizon // cfg.runtime.chunk_steps)
+        assert done["chunks_timed"] == 2 * chunks_per_episode
+
+    def test_queries_drain_to_final_row(self, tmp_path):
+        """get_avg/get_std after (and during) an async run answer from a
+        drained snapshot: the final values equal the synchronous path's,
+        and a completed run's snapshot is the last chunk's row, not a
+        stale in-flight one."""
+        orch = run_end_to_end(
+            fast_cfg(tmp_path, megachunk=8, async_on=True, tag="query"),
+            PRICES)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        snap = orch.snapshot()
+        assert snap["env_steps"] == len(PRICES) - WINDOW
+        avg = orch.get_avg()
+        assert avg.ok and np.isfinite(avg.value)
+        assert avg.value == snap["portfolio_mean"]
+
+
+@pytest.mark.slow
+class TestAsyncSoak:
+    def test_k8_512_chunk_soak_completes_exactly(self, tmp_path):
+        """The long variant: 512 tiny chunks through the pipeline at K=8 —
+        hours of queue churn compressed into one run; completion must stay
+        exact and the queue bounded."""
+        cfg = fast_cfg(tmp_path, megachunk=8, async_on=True, tag="soak")
+        cfg.runtime.chunk_steps = 4
+        cfg.runtime.metrics_every_chunks = 8
+        prices = np.linspace(10.0, 20.0, 2056, dtype=np.float32)
+        orch = run_end_to_end(cfg, prices)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        assert orch.restarts == 0
+        assert int(orch.train_state.env_steps) == len(prices) - WINDOW
+        assert orch.pipeline_stats["max_depth_seen"] <= 2

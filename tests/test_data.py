@@ -96,6 +96,89 @@ def test_journal_survives_torn_tail(tmp_journal_path):
         assert [e["n"] for e in j.replay()] == [1, 3]
 
 
+class TestGroupCommit:
+    """Journal group commit (journal_fsync_every_records /
+    fsync_interval_s): appends batch in memory and land as ONE write+fsync
+    at the watermark; the torn-tail recovery contract survives a crash at
+    ANY byte position, including between watermark commits."""
+
+    def test_count_watermark_batches_writes(self, tmp_journal_path):
+        import os
+        j = Journal(tmp_journal_path, fsync_every_records=4)
+        for n in range(3):
+            j.append({"n": n})
+        # Below the watermark: nothing on disk yet (the durability window).
+        assert os.path.getsize(tmp_journal_path) == 0
+        j.append({"n": 3})
+        # Watermark hit: the whole batch committed in one write.
+        assert os.path.getsize(tmp_journal_path) > 0
+        assert [e["n"] for e in j.replay()] == [0, 1, 2, 3]
+        j.close()
+
+    def test_interval_watermark_commits_on_time(self, tmp_journal_path):
+        import os
+        import time
+        j = Journal(tmp_journal_path, fsync_every_records=1000,
+                    fsync_interval_s=0.05)
+        j.append({"n": 0})
+        time.sleep(0.08)
+        j.append({"n": 1})     # interval elapsed: this append commits both
+        assert os.path.getsize(tmp_journal_path) > 0
+        assert [e["n"] for e in j.replay()] == [0, 1]
+        j.close()
+
+    def test_readers_see_buffered_appends(self, tmp_journal_path):
+        """replay()/flush() quiesce the batch — an acked append is never
+        invisible to the process that wrote it."""
+        j = Journal(tmp_journal_path, fsync_every_records=1000)
+        j.append({"n": 0})
+        assert [e["n"] for e in j.replay()] == [0]
+        j.close()
+
+    def test_close_commits_pending_batch(self, tmp_journal_path):
+        with Journal(tmp_journal_path, fsync_every_records=1000) as j:
+            j.append({"n": 7})
+        with Journal(tmp_journal_path) as j:
+            assert [e["n"] for e in j.replay()] == [7]
+
+    def test_append_after_close_raises_not_swallows(self, tmp_journal_path):
+        """Group mode must not ACK records into a buffer that can never
+        reach the disk — same contract as the legacy path's closed-handle
+        write error."""
+        j = Journal(tmp_journal_path, fsync_every_records=1000)
+        j.close()
+        with pytest.raises(ValueError, match="closed"):
+            j.append({"n": 1})
+
+    def test_torn_tail_property_under_group_commit(self, tmp_journal_path):
+        """Property: crash the journal at EVERY byte offset of a
+        group-committed log — including offsets that fall between watermark
+        commits — and recovery must always yield an exact event prefix,
+        never garbage, never a lost committed prefix, and appends must
+        continue cleanly after the truncation."""
+        import os
+        events = [{"n": n, "pad": "x" * (n * 7 % 23)} for n in range(12)]
+        with Journal(tmp_journal_path, fsync_every_records=5) as j:
+            for e in events:
+                j.append(e)
+        blob = open(tmp_journal_path, "rb").read()
+        # A committed log: every event present after close().
+        with Journal(tmp_journal_path) as j:
+            assert list(j.replay()) == events
+        for cut in range(len(blob) + 1):
+            with open(tmp_journal_path, "wb") as f:
+                f.write(blob[:cut])
+            with Journal(tmp_journal_path,
+                         fsync_every_records=5) as j:
+                recovered = list(j.replay())
+                # Exact prefix property — order preserved, nothing invented.
+                assert recovered == events[:len(recovered)]
+                # The journal stays appendable from the clean boundary.
+                j.append({"n": "post-crash"})
+                j.flush()
+                assert list(j.replay())[-1] == {"n": "post-crash"}
+
+
 # ---- service ----
 
 def test_service_caches_and_persists(tmp_journal_path):

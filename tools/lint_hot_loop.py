@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Grep-lint for the orchestrator's training hot loop and the device code.
 
-Three checks, all run by ``make check``/``make lint`` and the tier-1 guard
+Four checks, all run by ``make check``/``make lint`` and the tier-1 guard
 in tests/test_megachunk.py:
 
 1. **Hot-loop syncs** — the megachunk refactor (runtime/orchestrator.py
@@ -39,6 +39,19 @@ in tests/test_megachunk.py:
    those packages unless the line carries ``jit-host-call-ok`` naming why
    it is trace-time-only on purpose (``jax.debug.print`` is exempt — the
    dotted call never matches).
+
+4. **Blocking host work in the DISPATCHER** (the async-pipeline PR's
+   guard) — with ``runtime.async_pipeline`` the orchestrator's dispatch
+   loop (``_run_supervised``) and its boundary-decision block
+   (``_boundary_actions``) must never block on a device readback or host
+   IO: that work belongs to the pipeline's consumer thread
+   (``_host_process`` / ``_journal_transitions``), where the same calls
+   are expected and carry the ``hot-loop-sync-ok`` marker naming the
+   consumer-side exemption. FAILS when ``jax.device_get`` /
+   ``np.asarray`` / ``os.fsync`` / ``block_until_ready`` appears unmarked
+   in a dispatcher-section function, and when the consumer-side functions
+   this split relies on disappear (a rename must update this lint, not
+   silently un-guard the seam).
 """
 
 from __future__ import annotations
@@ -73,6 +86,17 @@ JIT_MARKER = "jit-host-call-ok"
 #: Escape hatch for a parallel-layer device_put that intentionally leaves
 #: placement to jax.
 PUT_MARKER = "device-put-ok"
+
+#: Dispatcher-section functions: with runtime.async_pipeline these run on
+#: the dispatch critical path and must not block on readback or host IO.
+DISPATCHER_FUNCS = ("_run_supervised", "_boundary_actions")
+#: Consumer-side functions the dispatcher/consumer split moves the blocking
+#: work INTO — they must exist, or the split silently un-guarded itself.
+CONSUMER_FUNCS = ("_host_process", "_journal_transitions")
+#: Blocking host calls that stall the dispatch pipeline when they appear in
+#: dispatcher-section code (consumer-side occurrences carry MARKER).
+DISPATCH_BLOCK_PATTERN = re.compile(
+    r"device_get\(|np\.asarray\(|os\.fsync\(|block_until_ready\(")
 
 
 def lint_parallel_device_put() -> list[tuple[str, int, str]]:
@@ -117,6 +141,31 @@ def lint_hot_loop_syncs() -> tuple[list[tuple[str, int, str]], set[str]]:
                     continue
                 if PATTERN.search(text) and MARKER not in text:
                     bad.append((node.name, ln, text.strip()))
+    return bad, found
+
+
+def lint_dispatcher_blocking() -> tuple[list[tuple[str, int, str]], set[str]]:
+    """Check 4: no unmarked blocking host calls in the dispatcher section;
+    the consumer-side functions must still exist. Returns (hits, found
+    function names over DISPATCHER_FUNCS + CONSUMER_FUNCS)."""
+    src = TARGET.read_text()
+    lines = src.splitlines()
+    bad: list[tuple[str, int, str]] = []
+    found: set[str] = set()
+    for node in ast.walk(ast.parse(src)):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in CONSUMER_FUNCS:
+            found.add(node.name)
+        if node.name not in DISPATCHER_FUNCS:
+            continue
+        found.add(node.name)
+        for ln in range(node.lineno, node.end_lineno + 1):
+            text = lines[ln - 1]
+            if text.lstrip().startswith("#"):
+                continue
+            if DISPATCH_BLOCK_PATTERN.search(text) and MARKER not in text:
+                bad.append((node.name, ln, text.strip()))
     return bad, found
 
 
@@ -190,9 +239,28 @@ def main() -> int:
               "chunk boundary (obs/), or tag the line "
               f"'# {JIT_MARKER}: <why trace-time-only is intended>'")
         return 1
+    disp_bad, disp_found = lint_dispatcher_blocking()
+    disp_missing = (set(DISPATCHER_FUNCS) | set(CONSUMER_FUNCS)) - disp_found
+    if disp_missing:
+        print(f"dispatcher lint: function(s) {sorted(disp_missing)} not "
+              f"found in {TARGET} — the async-pipeline dispatcher/consumer "
+              "split was renamed; update tools/lint_hot_loop.py "
+              "DISPATCHER_FUNCS/CONSUMER_FUNCS")
+        return 1
+    if disp_bad:
+        print(f"dispatcher blocking-call lint FAILED ({TARGET.name}):")
+        for fn, ln, text in disp_bad:
+            print(f"  {fn}:{ln}: {text}")
+        print("a blocking device_get/np.asarray/os.fsync in the dispatcher "
+              "section stalls the dispatch pipeline; move it to the "
+              "readback consumer (_host_process), or tag the line "
+              f"'# {MARKER}: <why this blocks the dispatcher on purpose>'")
+        return 1
     print(f"hot-loop sync lint OK ({', '.join(sorted(found))}); "
           f"parallel device_put lint OK; "
-          f"device-code host-call lint OK ({', '.join(DEVICE_PACKAGES)})")
+          f"device-code host-call lint OK ({', '.join(DEVICE_PACKAGES)}); "
+          f"dispatcher blocking-call lint OK "
+          f"({', '.join(DISPATCHER_FUNCS)})")
     return 0
 
 
