@@ -8,7 +8,7 @@
 
 PYTHON ?= python
 
-.PHONY: check test slow native bench bench-async bench-dispatch bench-obs bench-reshard obs-demo lint shard-audit clean
+.PHONY: check test slow native bench bench-async bench-ckpt bench-dispatch bench-obs bench-reshard crash-soak obs-demo lint shard-audit clean
 
 check: native lint
 	$(PYTHON) -m pytest tests/ -q -m "not slow" -x
@@ -70,6 +70,21 @@ shard-audit:
 bench-reshard:
 	$(PYTHON) -c "import json, bench; \
 	print(json.dumps(bench.bench_reshard(), indent=2))"
+
+# The checkpoint durability tax alone (checkpoint.fsync on vs off, two
+# payload sizes): the numbers behind the fsync-on default, recorded in
+# BASELINE.md "Checkpoint fsync".
+bench-ckpt:
+	$(PYTHON) -c "import json, bench; \
+	print(json.dumps(bench.bench_ckpt_fsync(), indent=2))"
+
+# Process-kill chaos soak: >= 20 seeded SIGKILL/SIGTERM injections into real
+# training subprocesses (journaled DQN config), each followed by --resume,
+# plus the bit-flip walk-back scenario — the crash-safety invariants end to
+# end (tools/crash_soak.py; the 2-kill quick profile runs in tier-1 via
+# tests/test_crash_soak.py).
+crash-soak:
+	$(PYTHON) tools/crash_soak.py --kills 20
 
 # Static guard: no bare scalar device syncs in the orchestrator hot loop.
 lint:

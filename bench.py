@@ -530,6 +530,60 @@ def bench_obs_sample_cost(samples: int = 20000) -> dict:
     }
 
 
+def bench_ckpt_fsync(saves: int = 20) -> dict:
+    """Durability cost of ``checkpoint.fsync`` (default on): wall time of
+    ``CheckpointManager.save`` with the fsync barrier on vs off, at two
+    payload sizes — the reference-shape TrainState (~hundreds of KB) and a
+    32 MB synthetic parameter blob (the d>=1024 tier's scale). This is the
+    number behind the default: the fsync tax is paid per SAVE on the async
+    writer thread (one save per ``checkpoint_every_updates``), never per
+    chunk, so even a multi-ms cost is invisible to training throughput —
+    but it must be measured, not assumed (BASELINE.md "Checkpoint fsync")."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from sharetrade_tpu.checkpoint import CheckpointManager
+
+    def time_saves(state, fsync: bool) -> dict:
+        with tempfile.TemporaryDirectory() as d:
+            mgr = CheckpointManager(os.path.join(d, "ckpts"), keep=2,
+                                    fsync=fsync)
+            mgr.save(0, state)          # warm: dir creation, first alloc
+            times = []
+            for i in range(saves):
+                t0 = time.perf_counter()
+                mgr.save(i + 1, state)
+                times.append((time.perf_counter() - t0) * 1e3)
+            times.sort()
+            return {
+                "mean_ms": round(sum(times) / len(times), 3),
+                "p50_ms": round(times[len(times) // 2], 3),
+                "p99_ms": round(times[min(len(times) - 1,
+                                          int(len(times) * 0.99))], 3),
+            }
+
+    cfg = FrameworkConfig()
+    cfg.env.window = 32
+    env = trading.env_from_prices(
+        synthetic_price_series(length=256, seed=0).prices,
+        window=cfg.env.window)
+    agent = build_agent(cfg, env)
+    small = agent.init(jax.random.PRNGKey(0))
+    big = {"params": np.random.default_rng(0).standard_normal(
+        (8, 1024, 1024), dtype=np.float32)}      # 32 MiB
+    out = {"metric": "ckpt_fsync_cost", "saves": saves}
+    for name, state in (("reference_state", small), ("blob_32mb", big)):
+        on = time_saves(state, True)
+        off = time_saves(state, False)
+        out[name] = {
+            "fsync_on": on, "fsync_off": off,
+            "tax_ms": round(on["mean_ms"] - off["mean_ms"], 3),
+        }
+    return out
+
+
 def _bench_reshard_child(chunks: int = 32, trials: int = 2) -> dict:
     """Child body of :func:`bench_reshard` — MUST run under the forced-8-
     device host platform (the parent sets the env). Times the dp4×tp2
@@ -795,6 +849,7 @@ def main() -> None:
     result["obs_overhead"] = bench_obs_overhead()
     result["obs_overhead"]["per_sample"] = bench_obs_sample_cost()
     result["async_pipeline"] = bench_async_pipeline()
+    result["ckpt_fsync"] = bench_ckpt_fsync()
     print(json.dumps(result), flush=True)
 
 

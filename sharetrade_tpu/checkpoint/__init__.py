@@ -7,4 +7,9 @@ model params, optimizer state, RNG, env cursors, algorithm extras — persists
 atomically and restores bit-exact (SURVEY.md §7.1 item 7).
 """
 
-from sharetrade_tpu.checkpoint.manager import CheckpointManager  # noqa: F401
+from sharetrade_tpu.checkpoint.manager import (  # noqa: F401
+    CheckpointCorruptError,
+    CheckpointIntegrityError,
+    CheckpointManager,
+    verify_checkpoint_files,
+)

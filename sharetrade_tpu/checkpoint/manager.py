@@ -1,15 +1,35 @@
-"""Atomic, retained, resumable checkpoints of the full TrainState.
+"""Atomic, checksummed, retained, resumable checkpoints of the full TrainState.
 
 Layout (one directory per checkpoint, like an orbax step dir):
 
     <dir>/ckpt_0000000500/state.msgpack   flax-serialized TrainState pytree
-    <dir>/ckpt_0000000500/meta.json       step, wall time, user metadata
+    <dir>/ckpt_0000000500/meta.json       step, wall time, sha256s, user metadata
 
 Write protocol: serialize into ``<dir>/tmp-<step>-<pid>`` then ``os.replace``
 to the final name — a torn write can never look like a complete checkpoint
-(the same crash-safety contract as the framed journal, data/journal.py). The
-newest ``keep`` checkpoints are retained; older ones are pruned after a
-successful save, never before.
+(the same crash-safety contract as the framed journal, data/journal.py).
+With ``fsync`` on (the default — gated by ``checkpoint.fsync``), both payload
+files AND the directories are fsynced around the rename, so a complete-looking
+checkpoint is also a DURABLE one: without the fsyncs, a power loss after
+``os.replace`` can surface a fully-named directory whose data blocks never hit
+the platter (torn bytes behind an atomic-looking rename — the failure mode the
+framed journal already closes with its own fsync). ``meta.json`` records a
+SHA-256 per payload file plus its own, so torn bytes are detectable at restore
+even when they slipped past the rename barrier (pre-fsync checkpoints, bit
+rot, external truncation).
+
+Restore protocol: every candidate is VERIFIED — checksums, deserializability
+against the caller's template, finite shared leaves — before it is accepted.
+A failing candidate is quarantined (renamed ``corrupt_<step>_<reason>``,
+never deleted — the bytes stay for forensics) and restore walks back to the
+next-oldest intact step, counting the fallback in the optional metrics hook
+(``ckpt_restore_fallbacks_total`` / ``ckpt_quarantined_total``). One corrupt
+newest checkpoint therefore costs one save cadence of progress, not the run.
+
+The newest ``keep`` checkpoints are retained; older ones are pruned after a
+successful save, never before. Stale ``tmp-*`` directories from crashed
+writers are swept at construction (pid-liveness-checked, so a concurrent
+saver's live tmp dir is never touched).
 
 Host-side Python is the right tool here (checkpointing is host IO —
 SURVEY.md §2.4); arrays are fetched with ``jax.device_get`` and restored with
@@ -20,6 +40,7 @@ the caller's ``device_put``/shardings dictate.
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import json
 import os
 import queue
@@ -37,12 +58,133 @@ from sharetrade_tpu.utils.logging import get_logger
 log = get_logger("checkpoint")
 
 _PREFIX = "ckpt_"
+_CORRUPT_PREFIX = "corrupt_"
+_STATE = "state.msgpack"
+_META = "meta.json"
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """One checkpoint directory failed verification; ``reason`` is the
+    machine-readable slug that lands in the quarantine directory name."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(f"{reason}: {detail}")
+        self.reason = reason
+
+
+class CheckpointCorruptError(FileNotFoundError):
+    """No intact checkpoint could be restored (everything quarantined, or an
+    explicitly-requested step failed verification). Subclasses
+    FileNotFoundError so every existing restore-or-reinit fallback treats
+    "all corrupt" exactly like "none saved yet"."""
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True       # exists, owned by someone else
+    except (OverflowError, ValueError, OSError):
+        return False
+    return True
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so its entries (the renamed checkpoint name) are
+    durable — the half of crash safety ``os.replace`` alone doesn't give."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return              # platform without directory fds: best effort
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _canonical_meta_bytes(meta: dict[str, Any]) -> bytes:
+    """The byte string ``meta_sha256`` is computed over: the meta dict minus
+    its own digest field, canonically serialized. Verification re-canonicalizes
+    from the parsed JSON, so formatting on disk is free to differ."""
+    meta = dict(meta)
+    integrity = dict(meta.get("integrity", {}))
+    integrity.pop("meta_sha256", None)
+    meta["integrity"] = integrity
+    return json.dumps(meta, sort_keys=True, separators=(",", ":")).encode()
+
+
+def verify_checkpoint_files(path: str, *,
+                            state_bytes: bytes | None = None
+                            ) -> dict[str, Any]:
+    """File-level integrity of one checkpoint dir: both files present, meta
+    parses, and — when the meta carries checksums (every checkpoint written
+    since they were introduced) — both SHA-256s match. Returns the parsed
+    metadata; raises :class:`CheckpointIntegrityError` with a
+    quarantine-reason slug otherwise. Module-level (no manager needed) so
+    external observers — the crash soak, ops tooling — can audit a
+    checkpoint directory read-only.
+
+    ``state_bytes``: the payload's contents when the caller already read
+    them (restore does — hashing the in-memory bytes halves the file IO of
+    a verified restore); None streams the file instead."""
+    meta_path = os.path.join(path, _META)
+    state_path = os.path.join(path, _STATE)
+    if not os.path.isfile(meta_path):
+        raise CheckpointIntegrityError("meta_missing", f"{meta_path} absent")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+        if not isinstance(meta, dict):
+            raise ValueError("meta.json is not an object")
+    except (ValueError, OSError) as exc:
+        raise CheckpointIntegrityError("meta_garbled", str(exc)) from exc
+    if state_bytes is None and not os.path.isfile(state_path):
+        raise CheckpointIntegrityError("state_missing",
+                                       f"{state_path} absent")
+    integrity = meta.get("integrity")
+    if integrity:       # pre-integrity checkpoints: structural checks only
+        expected_meta = integrity.get("meta_sha256")
+        if expected_meta:
+            actual = hashlib.sha256(_canonical_meta_bytes(meta)).hexdigest()
+            if actual != expected_meta:
+                raise CheckpointIntegrityError(
+                    "meta_checksum",
+                    f"meta.json sha256 {actual} != {expected_meta}")
+        expected_state = integrity.get(_STATE)
+        if expected_state:
+            h = hashlib.sha256()
+            if state_bytes is not None:
+                h.update(state_bytes)
+            else:
+                try:
+                    with open(state_path, "rb") as f:
+                        for block in iter(lambda: f.read(1 << 20), b""):
+                            h.update(block)
+                except OSError as exc:
+                    raise CheckpointIntegrityError(
+                        "state_unreadable",
+                        f"{type(exc).__name__}: {exc}") from exc
+            if h.hexdigest() != expected_state:
+                raise CheckpointIntegrityError(
+                    "state_checksum",
+                    f"{_STATE} sha256 {h.hexdigest()} != {expected_state}")
+    return meta
 
 
 class CheckpointManager:
-    def __init__(self, directory: str, *, keep: int = 3, tracer: Any = None):
+    def __init__(self, directory: str, *, keep: int = 3, tracer: Any = None,
+                 fsync: bool = True, metrics: Any = None):
         self.directory = directory
         self.keep = keep
+        #: Durability gate (``checkpoint.fsync``): fsync payload files, the
+        #: tmp dir, and the parent dir around the atomic rename. Default on —
+        #: the same contract the framed journal honors. Off exists for the
+        #: bench_ckpt_fsync comparison and throwaway test runs.
+        self.fsync = fsync
         os.makedirs(directory, exist_ok=True)
         self._worker: threading.Thread | None = None
         self._queue: queue.Queue | None = None
@@ -52,13 +194,157 @@ class CheckpointManager:
         # phases land in the host trace timeline — including writes on the
         # async worker thread (the tracer is thread-safe).
         self.tracer = tracer
+        # Optional MetricsRegistry-like (settable post-construction): the
+        # restore walk-back counters (``ckpt_restore_fallbacks_total``,
+        # ``ckpt_quarantined_total``) flow through its ``inc``.
+        self.metrics = metrics
+        #: Report of the most recent restore(): step served, how many
+        #: candidates were quarantined-and-skipped — the orchestrator
+        #: surfaces a non-empty fallback list through its event log.
+        self.last_restore_report: dict[str, Any] = {}
+        self._sweep_stale_tmp()
 
     def _span(self, name: str, **args: Any):
         if self.tracer is None:
             return contextlib.nullcontext()
         return self.tracer.span(name, **args)
 
+    def _inc(self, name: str, amount: float = 1.0) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.inc(name, amount)
+            except Exception:
+                pass        # observability never outranks the checkpoint
+
+    def _instant(self, name: str, **args: Any) -> None:
+        if self.tracer is not None:
+            try:
+                self.tracer.instant(name, **args)
+            except Exception:
+                pass
+
+    def _sweep_stale_tmp(self) -> None:
+        """Handle ``tmp-<label>-<pid>`` dirs left by CRASHED writers: a tmp
+        that verifies as a COMPLETE step checkpoint is recovered (published
+        under its ``ckpt_`` name — it only missed its rename; deleting it
+        would discard a durable save, and the same-step re-save window in
+        :meth:`_publish` relies on this recovery); anything else is debris
+        and is removed — without that they accumulate forever, one per
+        crash. A tmp dir whose pid is still alive belongs to a concurrent
+        saver mid-write and is left alone; unparseable names fall back to
+        the age-based sweep in :meth:`_prune`."""
+        for name in os.listdir(self.directory):
+            if not name.startswith("tmp-"):
+                continue
+            pid_part = name.rsplit("-", 1)[-1]
+            try:
+                pid = int(pid_part)
+            except ValueError:
+                continue
+            if pid == os.getpid() or _pid_alive(pid):
+                continue
+            full = os.path.join(self.directory, name)
+            outcome = self._recover_tmp(full, name)
+            if outcome == "debris":
+                shutil.rmtree(full, ignore_errors=True)
+                log.info("swept stale checkpoint tmp dir %s (pid %d dead)",
+                         name, pid)
+            # "recovered": published under its ckpt_ name. "keep": verified
+            # restorable but the publish hit a transient IO error — leave
+            # the ONLY copy in place for the next init to retry; deleting
+            # it would convert a transient error into permanent loss.
+
+    def _recover_tmp(self, full: str, name: str) -> str:
+        """Publish a crashed writer's fully-staged STEP checkpoint (files
+        intact per their own checksums, no published dir for its step).
+        Tagged tmp dirs are never recovered — the ``.old`` dance already
+        covers their crash windows and a stale tag must not clobber a
+        newer one. Returns ``"recovered"`` (published), ``"debris"``
+        (incomplete/duplicate — safe to sweep), or ``"keep"`` (verified
+        bytes whose publish failed transiently — must NOT be deleted)."""
+        try:
+            meta = verify_checkpoint_files(full)
+        except CheckpointIntegrityError:
+            return "debris"
+        step = meta.get("step")
+        if not isinstance(step, int) or "tag" in meta:
+            return "debris"
+        final = os.path.join(self.directory, f"{_PREFIX}{step:010d}")
+        if os.path.exists(final):
+            return "debris"     # that step already has a published copy
+        if self.fsync:
+            # The dead writer may have crashed before ITS fsyncs ran; the
+            # bytes just verified from the page cache must reach the disk
+            # before the name does.
+            for fname in (_STATE, _META):
+                try:
+                    fd = os.open(os.path.join(full, fname), os.O_RDONLY)
+                    try:
+                        os.fsync(fd)
+                    finally:
+                        os.close(fd)
+                except OSError:
+                    return "keep"
+            _fsync_dir(full)
+        try:
+            os.replace(full, final)
+        except OSError:
+            return "keep"
+        if self.fsync:
+            _fsync_dir(self.directory)
+        log.warning("recovered complete checkpoint step=%d from crashed "
+                    "writer tmp dir %s", step, name)
+        return "recovered"
+
     # ---- save ----
+
+    def _write_payload_tmp(self, tmp: str, payload: bytes,
+                           meta: dict[str, Any]) -> None:
+        """Stage payload + checksummed meta into ``tmp`` and make the BYTES
+        durable (file fsyncs + tmp-dir fsync) — no name is published yet, so
+        a crash or IO error here is invisible to every reader."""
+        os.makedirs(tmp, exist_ok=True)
+        meta = dict(meta)
+        meta["integrity"] = {
+            "algo": "sha256",
+            _STATE: hashlib.sha256(payload).hexdigest(),
+        }
+        meta["integrity"]["meta_sha256"] = hashlib.sha256(
+            _canonical_meta_bytes(meta)).hexdigest()
+        with open(os.path.join(tmp, _STATE), "wb") as f:
+            f.write(payload)
+            if self.fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        with open(os.path.join(tmp, _META), "w") as f:
+            json.dump(meta, f, sort_keys=True)
+            if self.fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        if self.fsync:
+            _fsync_dir(tmp)
+
+    def _publish(self, tmp: str, final: str) -> None:
+        """Atomically publish a fully-staged tmp dir under ``final``. The
+        parent fsync AFTER the rename is what makes the new NAME durable;
+        the staging fsyncs BEFORE it (:meth:`_write_payload_tmp`) are what
+        guarantee a visible name never points at torn bytes."""
+        if os.path.isdir(final):
+            # Re-writing an existing dir (same-step re-save): the tmp dir
+            # is complete and durable before the old copy goes away, so a
+            # crash between this rmtree and the rename leaves restorable
+            # bytes on disk — the next manager's _recover_tmp publishes
+            # the staged dir under this very name.
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+        if self.fsync:
+            _fsync_dir(self.directory)
+
+    def _write_checkpoint_dir(self, tmp: str, final: str, payload: bytes,
+                              meta: dict[str, Any]) -> None:
+        """Stage + publish in one step — the write path of step saves."""
+        self._write_payload_tmp(tmp, payload, meta)
+        self._publish(tmp, final)
 
     def save(self, step: int, train_state: Any,
              metadata: dict[str, Any] | None = None) -> str:
@@ -74,14 +360,7 @@ class CheckpointManager:
 
         tmp = os.path.join(self.directory, f"tmp-{step}-{os.getpid()}")
         final = os.path.join(self.directory, f"{_PREFIX}{step:010d}")
-        os.makedirs(tmp, exist_ok=True)
-        with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
-            f.write(payload)
-        with open(os.path.join(tmp, "meta.json"), "w") as f:
-            json.dump(meta, f)
-        if os.path.isdir(final):  # re-saving the same step: replace wholesale
-            shutil.rmtree(final)
-        os.replace(tmp, final)
+        self._write_checkpoint_dir(tmp, final, payload, meta)
         log.info("saved checkpoint step=%d (%d bytes)", step, len(payload))
         self._prune()
         return final
@@ -89,20 +368,20 @@ class CheckpointManager:
     def save_tagged(self, tag: str, train_state: Any,
                     metadata: dict[str, Any] | None = None) -> str:
         """Save under a NAME instead of a step — e.g. the best-greedy-eval
-        policy (``runtime.keep_best_eval``). Tagged checkpoints live in
+        policy (``runtime.keep_best_eval``) or the preemption emergency
+        checkpoint (``tag_preempt``). Tagged checkpoints live in
         ``<dir>/tag_<tag>`` outside the ``ckpt_`` namespace, so retention
         pruning never collects them and ``latest_step`` resume never picks
-        them by accident; same atomic tmp+rename write protocol."""
+        them by accident; same atomic checksummed+fsynced write protocol."""
         host_state = jax.device_get(train_state)
         payload = serialization.to_bytes(host_state)
         meta = {"tag": tag, "saved_at": time.time(), **(metadata or {})}
         tmp = os.path.join(self.directory, f"tmp-{tag}-{os.getpid()}")
         final = os.path.join(self.directory, f"tag_{tag}")
-        os.makedirs(tmp, exist_ok=True)
-        with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
-            f.write(payload)
-        with open(os.path.join(tmp, "meta.json"), "w") as f:
-            json.dump(meta, f)
+        # Stage the NEW payload completely (durable bytes, no name) BEFORE
+        # the old copy moves: an IO error or crash while writing must leave
+        # the live tag untouched.
+        self._write_payload_tmp(tmp, payload, meta)
         if os.path.isdir(final):
             # Unlike step saves, overwriting a tag is the ROUTINE path
             # (every best-eval improvement), so the old copy is renamed
@@ -112,39 +391,53 @@ class CheckpointManager:
             old = final + ".old"
             shutil.rmtree(old, ignore_errors=True)
             os.replace(final, old)
-            os.replace(tmp, final)
+            self._publish(tmp, final)
             shutil.rmtree(old, ignore_errors=True)
         else:
-            os.replace(tmp, final)
+            self._publish(tmp, final)
         log.info("saved tagged checkpoint %r (%d bytes)", tag, len(payload))
         return final
 
     def restore_tagged(self, template: Any, tag: str) -> tuple[Any, dict]:
-        """Restore a tagged checkpoint; returns ``(state, metadata)``."""
-        path = os.path.join(self.directory, f"tag_{tag}")
-        if not os.path.isdir(path):
-            # Crash window fallback: save_tagged renames the previous copy
-            # aside before swapping the new one in.
-            if os.path.isdir(path + ".old"):
-                path = path + ".old"
-            else:
-                raise FileNotFoundError(
-                    f"no {tag!r}-tagged checkpoint under {self.directory}")
-        with open(os.path.join(path, "state.msgpack"), "rb") as f:
-            payload = f.read()
-        state = serialization.from_bytes(jax.device_get(template), payload)
-        with open(os.path.join(path, "meta.json")) as f:
-            meta = json.load(f)
-        log.info("restored tagged checkpoint %r", tag)
-        return state, meta
+        """Restore a tagged checkpoint; returns ``(state, metadata)``. The
+        primary dir is verified like any step checkpoint — a corrupt one is
+        quarantined (``corrupt_tag_<tag>_<reason>``) and the ``.old``
+        crash-window copy is tried next; both bad raises
+        :class:`CheckpointCorruptError`."""
+        primary = os.path.join(self.directory, f"tag_{tag}")
+        candidates = [p for p in (primary, primary + ".old")
+                      if os.path.isdir(p)]
+        if not candidates:
+            raise FileNotFoundError(
+                f"no {tag!r}-tagged checkpoint under {self.directory}")
+        for path in candidates:
+            try:
+                state, meta = self._load_verified(path, template)
+            except CheckpointIntegrityError as exc:
+                self._quarantine(path, f"tag_{tag}", exc.reason)
+                continue
+            if path != primary:
+                self._inc("ckpt_restore_fallbacks_total")
+                log.warning("restored tagged checkpoint %r from its .old "
+                            "crash-window copy", tag)
+            log.info("restored tagged checkpoint %r", tag)
+            return state, meta
+        raise CheckpointCorruptError(
+            f"every {tag!r}-tagged checkpoint under {self.directory} failed "
+            "verification (quarantined, not deleted)")
 
     def tagged_metadata(self, tag: str) -> dict[str, Any] | None:
-        """Metadata of a tagged checkpoint, or None if absent."""
+        """Metadata of a tagged checkpoint, or None if absent/garbled.
+        Unverified (a hint for resume-source selection, not a promise) —
+        ``restore_tagged`` does the real verification."""
         for name in (f"tag_{tag}", f"tag_{tag}.old"):
-            path = os.path.join(self.directory, name, "meta.json")
+            path = os.path.join(self.directory, name, _META)
             if os.path.isfile(path):
-                with open(path) as f:
-                    return json.load(f)
+                try:
+                    with open(path) as f:
+                        return json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    continue
         return None
 
     def save_async(self, step: int, train_state: Any,
@@ -197,13 +490,102 @@ class CheckpointManager:
         with self._cv:
             return self._cv.wait_for(lambda: self._inflight == 0, timeout)
 
+    # ---- verification ----
+
+    def _load_verified(self, path: str, template: Any) -> tuple[Any, dict]:
+        """Checksums, then deserializability against ``template``, then
+        finite SHARED leaves (params/optimizer — the state every agent row
+        depends on; env rows and carries may legitimately hold non-finite
+        values for quarantined-but-checkpointed agent rows, so they are NOT
+        checked). Raises :class:`CheckpointIntegrityError`."""
+        try:
+            with open(os.path.join(path, _STATE), "rb") as f:
+                payload = f.read()
+        except FileNotFoundError:
+            payload = None      # verify below raises the state_missing slug
+        except OSError as exc:
+            # IO-level failure (EIO bad sector, EACCES): route through the
+            # quarantine-and-walk-back machinery like any other damage —
+            # an unhandled OSError would strand the run despite intact
+            # older checkpoints sitting right beside this one.
+            raise CheckpointIntegrityError(
+                "state_unreadable", f"{type(exc).__name__}: {exc}") from exc
+        meta = verify_checkpoint_files(path, state_bytes=payload)
+        try:
+            state = serialization.from_bytes(jax.device_get(template),
+                                             payload)
+        except Exception as exc:
+            if meta.get("integrity", {}).get(_STATE):
+                # The sha256 just verified: these bytes are EXACTLY what was
+                # written, so failing to deserialize into THIS template is a
+                # caller/config mismatch (model shape changed since the
+                # save), not corruption. Raise loudly and leave the store
+                # untouched — quarantining here would rename every
+                # checkpoint aside on a config change + --resume.
+                raise ValueError(
+                    f"checkpoint at {path} is checksum-intact but does not "
+                    f"deserialize into the provided template "
+                    f"({type(exc).__name__}: {exc}); was the model/"
+                    "optimizer config changed since it was saved?") from exc
+            raise CheckpointIntegrityError(
+                "undeserializable", f"{type(exc).__name__}: {exc}") from exc
+        shared = tuple(getattr(state, attr) for attr in ("params",
+                                                         "opt_state")
+                       if hasattr(state, attr))
+        for leaf in jax.tree.leaves(shared):
+            a = np.asarray(leaf)
+            if a.dtype.kind == "f" and not np.isfinite(a).all():
+                raise CheckpointIntegrityError(
+                    "nonfinite", "non-finite value in params/opt_state")
+        return state, meta
+
+    def verify(self, step: int | None = None) -> dict[str, Any]:
+        """Validate one step checkpoint's files + checksums WITHOUT
+        deserializing (no template needed); newest when ``step`` is None.
+        Returns its metadata; raises :class:`CheckpointIntegrityError` on
+        damage, ``FileNotFoundError`` when nothing exists. The full
+        template-aware validation runs inside :meth:`restore`."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}")
+        return verify_checkpoint_files(
+            os.path.join(self.directory, f"{_PREFIX}{step:010d}"))
+
+    def _quarantine(self, path: str, label: Any, reason: str) -> None:
+        """Rename a damaged checkpoint aside — NEVER delete it: the bytes
+        are forensic evidence (what got torn, and how), and deletion would
+        convert a detected fault into a silent one."""
+        base = os.path.join(self.directory,
+                            f"{_CORRUPT_PREFIX}{label}_{reason}")
+        dest = base
+        n = 1
+        while os.path.exists(dest):
+            n += 1
+            dest = f"{base}-{n}"
+        try:
+            os.replace(path, dest)  # replace-fsync-ok: quarantine rename — the payload is already known-corrupt, durability of the new name adds nothing
+        except OSError:
+            log.exception("failed to quarantine corrupt checkpoint %s", path)
+            return
+        self._inc("ckpt_quarantined_total")
+        self._instant("ckpt_quarantined", label=str(label), reason=reason)
+        log.error("quarantined corrupt checkpoint %s -> %s (%s)",
+                  os.path.basename(path), os.path.basename(dest), reason)
+
     # ---- restore ----
 
     def steps(self) -> list[int]:
+        """Every ``ckpt_<step>`` DIRECTORY, intact or not: the atomic write
+        protocol means a listed dir was completely written once, and listing
+        damaged ones is what lets the restore walk-back find, quarantine and
+        step over them (requiring meta.json here would make a damaged newest
+        checkpoint silently invisible instead of accountably quarantined)."""
         out = []
         for name in os.listdir(self.directory):
-            if name.startswith(_PREFIX) and os.path.isfile(
-                    os.path.join(self.directory, name, "meta.json")):
+            if name.startswith(_PREFIX) and os.path.isdir(
+                    os.path.join(self.directory, name)):
                 try:
                     out.append(int(name[len(_PREFIX):]))
                 except ValueError:
@@ -214,25 +596,73 @@ class CheckpointManager:
         steps = self.steps()
         return steps[-1] if steps else None
 
+    def any_intact(self) -> bool:
+        """Does at least one step checkpoint pass file-level verification
+        (checksums, no template)? ``steps()`` deliberately lists DAMAGED
+        dirs too (so the walk-back can quarantine them), which means
+        existence alone must not satisfy guards that need a restorable
+        checkpoint — the orchestrator's baseline-save decision keys off
+        this instead: a store holding only torn dirs still gets its
+        baseline."""
+        for s in reversed(self.steps()):
+            try:
+                verify_checkpoint_files(
+                    os.path.join(self.directory, f"{_PREFIX}{s:010d}"))
+                return True
+            except CheckpointIntegrityError:
+                continue
+        return False
+
     def restore(self, template: Any, step: int | None = None) -> tuple[Any, int]:
         """Restore into the structure of ``template`` (an uninitialized or
-        freshly-initialized TrainState). Returns ``(state, step)``."""
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(
-                    f"no checkpoints under {self.directory}")
-        with self._span("checkpoint_restore", step=int(step)):
-            path = os.path.join(self.directory, f"{_PREFIX}{step:010d}")
-            with open(os.path.join(path, "state.msgpack"), "rb") as f:
-                payload = f.read()
-            state = serialization.from_bytes(
-                jax.device_get(template), payload)
-        log.info("restored checkpoint step=%d", step)
-        return state, step
+        freshly-initialized TrainState). Returns ``(state, step)``.
+
+        Every candidate is verified before acceptance; a damaged one is
+        quarantined and — when ``step`` was not explicitly requested — the
+        walk-back tries the next-oldest, so one corrupt newest checkpoint
+        never strands a run that has older intact ones sitting beside it.
+        An explicitly-requested ``step`` that fails raises
+        :class:`CheckpointCorruptError` instead of silently serving a
+        different step; so does running out of intact candidates."""
+        explicit = step is not None
+        candidates = [step] if explicit else list(reversed(self.steps()))
+        if not candidates:
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory}")
+        skipped: list[tuple[int, str]] = []
+        for s in candidates:
+            path = os.path.join(self.directory, f"{_PREFIX}{s:010d}")
+            if not os.path.isdir(path):
+                raise FileNotFoundError(f"no checkpoint step={s} under "
+                                        f"{self.directory}")
+            with self._span("checkpoint_restore", step=int(s)):
+                try:
+                    state, _meta = self._load_verified(path, template)
+                except CheckpointIntegrityError as exc:
+                    self._quarantine(path, f"{s:010d}", exc.reason)
+                    skipped.append((s, exc.reason))
+                    if explicit:
+                        raise CheckpointCorruptError(
+                            f"checkpoint step={s} failed verification "
+                            f"({exc.reason}); quarantined") from exc
+                    self._inc("ckpt_restore_fallbacks_total")
+                    continue
+            self.last_restore_report = {"step": int(s), "skipped": skipped,
+                                        "meta": _meta}
+            if skipped:
+                log.warning(
+                    "restore fell back to step=%d past %d corrupt "
+                    "checkpoint(s) %s (quarantined, not deleted)",
+                    s, len(skipped), skipped)
+            else:
+                log.info("restored checkpoint step=%d", s)
+            return state, s
+        raise CheckpointCorruptError(
+            f"every checkpoint under {self.directory} failed verification "
+            f"({skipped}); all quarantined, none deleted")
 
     def metadata(self, step: int) -> dict[str, Any]:
-        path = os.path.join(self.directory, f"{_PREFIX}{step:010d}", "meta.json")
+        path = os.path.join(self.directory, f"{_PREFIX}{step:010d}", _META)
         with open(path) as f:
             return json.load(f)
 
@@ -244,9 +674,15 @@ class CheckpointManager:
             shutil.rmtree(os.path.join(
                 self.directory, f"{_PREFIX}{old:010d}"), ignore_errors=True)
             log.debug("pruned checkpoint step=%d", old)
-        # Abandoned tmp dirs from crashed writers are garbage-collected too.
+        # Abandoned tmp dirs whose pid-suffix could not be parsed (or whose
+        # pid was recycled) are garbage-collected by age as a fallback to
+        # the liveness sweep at construction.
         for name in os.listdir(self.directory):
             if name.startswith("tmp-"):
                 full = os.path.join(self.directory, name)
-                if time.time() - os.path.getmtime(full) > 3600:
+                try:
+                    stale = time.time() - os.path.getmtime(full) > 3600
+                except OSError:
+                    continue
+                if stale:
                     shutil.rmtree(full, ignore_errors=True)

@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import signal
 import sys
 import time
 
@@ -24,6 +26,13 @@ from sharetrade_tpu.data.service import PriceDataService
 from sharetrade_tpu.utils.logging import configure, get_logger
 
 log = get_logger("cli")
+
+#: Exit code of a run that was preempted (SIGTERM/SIGINT) and wrote its
+#: ``tag_preempt`` emergency checkpoint path — EX_TEMPFAIL from sysexits.h:
+#: "temporary failure; the user is invited to retry", which is exactly what
+#: a fleet scheduler should do (relaunch with ``--resume``). Distinct from
+#: 0 (completed) and 1 (failed) so supervisors can tell the three apart.
+EXIT_PREEMPTED = 75
 
 
 def _load_config(args) -> FrameworkConfig:
@@ -41,6 +50,39 @@ def cmd_train(args) -> int:
     cfg = _load_config(args)
     service = PriceDataService(config=cfg.data)
     orch = None
+
+    # Preemption handling: a TERM (fleet/TPU-pod preemption notice) or INT
+    # asks the orchestrator to drain at its next megachunk boundary and
+    # write the tag_preempt emergency checkpoint; the poll loop below
+    # enforces runtime.preempt_grace_s and exits EXIT_PREEMPTED. Installed
+    # BEFORE the (slow) data/orchestrator/compile bring-up so a preemption
+    # notice during startup is never lost to the default signal disposition
+    # — it is replayed onto the orchestrator the moment one exists.
+    # Installed here (not in the Orchestrator) because signal handlers
+    # belong to the process entry point — library users wire
+    # orch.request_preempt() to whatever notification their fleet uses.
+    preempt_at: list[float] = []
+
+    def _on_signal(signum, frame):
+        if not preempt_at:
+            log.warning("received %s; requesting preemption drain",
+                        signal.Signals(signum).name)
+            preempt_at.append(time.monotonic())
+        else:
+            # Second signal escalates: an interactive Ctrl-C on a wedged
+            # drain must not have to wait out the grace+5s hard-exit
+            # timer. Whatever the drain already made durable is the
+            # resume point.
+            log.warning("received %s during the drain; hard exit",
+                        signal.Signals(signum).name)
+            os._exit(EXIT_PREEMPTED)
+        if orch is not None:
+            orch.request_preempt()
+
+    prev_handlers = {
+        s: signal.signal(s, _on_signal)
+        for s in (signal.SIGTERM, signal.SIGINT)}
+
     try:
         symbols = [s.strip() for s in args.symbol.split(",") if s.strip()]
         if len(symbols) > 1:
@@ -67,6 +109,16 @@ def cmd_train(args) -> int:
                             cfg.parallel.num_workers, dp, adjusted)
                 cfg.parallel.num_workers = adjusted
         orch = Orchestrator(cfg, mesh=mesh)
+        if preempt_at:
+            # A notice arrived during bring-up: replay it — the run will
+            # drain at its first boundary and exit EXIT_PREEMPTED. The
+            # grace clock re-anchors HERE so the hard-exit timer below and
+            # the orchestrator's drain deadline (anchored inside
+            # request_preempt) agree — otherwise a long bring-up would let
+            # the hard exit kill the emergency save inside its own budget.
+            preempt_at[0] = time.monotonic()
+            orch.request_preempt()
+
         t0 = time.perf_counter()
         try:
             orch.send_training_data(prices, resume=args.resume)
@@ -77,14 +129,42 @@ def cmd_train(args) -> int:
 
         # Driver poll loop (ShareTradeHelper.scala:32-48), with a sane cadence.
         poll_s = cfg.runtime.poll_interval_s
+        grace = cfg.runtime.preempt_grace_s
         while not orch.wait(timeout=poll_s):
+            if preempt_at:
+                if time.monotonic() - preempt_at[0] > grace + 5.0:
+                    # The drain overran its budget (a wedged device call, a
+                    # hung disk): hard-exit with the preemption code — the
+                    # fleet's KILL follows the TERM regardless, and whatever
+                    # the drain already made durable is what --resume gets.
+                    # os._exit on purpose: a graceful stop() here would
+                    # block on the very threads that overran the budget.
+                    log.error("preemption grace (%.1fs) expired before the "
+                              "drain finished; hard exit", grace)
+                    os._exit(EXIT_PREEMPTED)
+                continue    # draining: don't stack snapshot barriers on it
             snap = orch.snapshot()
             if snap and args.verbose:
                 log.info("progress: env_steps=%s portfolio_mean=%.2f",
                          snap.get("env_steps"), snap.get("portfolio_mean", 0.0))
+        for s, h in prev_handlers.items():
+            signal.signal(s, h)
         elapsed = time.perf_counter() - t0
 
         done = orch.is_everything_done()
+        if orch.preempted or (preempt_at
+                              and done.state is not ReplyState.COMPLETED):
+            # A signal that lands in the same poll window as normal
+            # completion does NOT preempt-label a finished run: completed
+            # results are served below (the fleet must not --resume a run
+            # that already delivered its answer).
+            log.warning("run preempted; resume with --resume "
+                        "(emergency checkpoint: %s)",
+                        "written" if orch.preempt_saved
+                        else "not confirmed — latest cadence checkpoint "
+                             "is the resume point")
+            return EXIT_PREEMPTED
+
         avg, std = orch.get_avg(), orch.get_std()
         if done.state is not ReplyState.COMPLETED or not avg.ok:
             log.error("training did not complete: %s (last error: %r)",

@@ -212,6 +212,22 @@ class ParallelConfig:
 
 
 @dataclass
+class CheckpointConfig:
+    """Durability contract of the checkpoint store (checkpoint/manager.py)."""
+
+    # fsync payload files and their directories around the atomic rename, so
+    # a checkpoint that LOOKS complete after a power loss IS complete (the
+    # same durability contract the framed journal honors). ``os.replace``
+    # alone only orders the rename against other renames — without the
+    # fsyncs, a crash can surface a fully-named checkpoint directory whose
+    # data blocks never reached the disk. Default on; the cost is measured
+    # by ``bench.py bench_ckpt_fsync`` (BASELINE.md "Checkpoint fsync") and
+    # is paid on the async writer thread, not the training loop. Off exists
+    # for that benchmark and throwaway runs on ephemeral storage.
+    fsync: bool = True
+
+
+@dataclass
 class RuntimeConfig:
     """Orchestration / fault tolerance (reference: TrainerRouterActor.scala:46-58)."""
 
@@ -326,6 +342,17 @@ class RuntimeConfig:
     # curve and the best-eval retention below without the caller having to
     # evaluate manually. 0 (default) = only explicit evaluate() calls.
     eval_every_updates: int = 0
+    # Preemption grace budget (seconds): when the CLI's SIGTERM/SIGINT
+    # handler requests preemption, the orchestrator drains the async
+    # pipeline at the next megachunk boundary, writes the ``tag_preempt``
+    # emergency checkpoint with full resume metadata, flushes the journal
+    # batch and dumps the flight recorder — all inside this budget; the CLI
+    # hard-exits with the preemption code once it expires (a fleet
+    # scheduler's kill follows the TERM after its own grace, so an
+    # over-budget drain must not block the inevitable). A later ``--resume``
+    # prefers ``tag_preempt`` when it is newer than the latest step
+    # checkpoint.
+    preempt_grace_s: float = 30.0
     # Retain the best-greedy-eval policy as a tagged checkpoint
     # (<checkpoint_dir>/tag_best) every time evaluate() improves on the
     # best seen: on-policy training can discover a strategy and then
@@ -381,6 +408,7 @@ class FrameworkConfig:
     learner: LearnerConfig = field(default_factory=LearnerConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     seed: int = 0
 
@@ -459,5 +487,6 @@ _NESTED = {
     "learner": LearnerConfig,
     "parallel": ParallelConfig,
     "runtime": RuntimeConfig,
+    "checkpoint": CheckpointConfig,
     "obs": ObsConfig,
 }

@@ -159,7 +159,13 @@ class Orchestrator:
         # megachunk readback already materialized (no new device syncs).
         self.obs = build_obs(cfg, self.metrics, mesh=mesh)
         self.checkpoints = checkpoints or CheckpointManager(
-            cfg.runtime.checkpoint_dir, keep=cfg.runtime.keep_checkpoints)
+            cfg.runtime.checkpoint_dir, keep=cfg.runtime.keep_checkpoints,
+            fsync=cfg.checkpoint.fsync)
+        if getattr(self.checkpoints, "metrics", None) is None:
+            # Restore walk-back counters (ckpt_restore_fallbacks_total,
+            # ckpt_quarantined_total) land in the run's registry and flow
+            # out through the obs MetricsExporter like every other counter.
+            self.checkpoints.metrics = self.metrics
         self.events = event_log or EventLog(None)
         if self.obs.enabled:
             # Structured run events double into the flight ring (the tap),
@@ -189,6 +195,19 @@ class Orchestrator:
         self._step_lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
+        # Preemption (SIGTERM/SIGINT via cli train, or any caller's
+        # request_preempt): the dispatcher honors it at the next megachunk
+        # boundary — drain, emergency tag_preempt checkpoint, journal flush,
+        # flight dump — inside runtime.preempt_grace_s. ``preempted`` is the
+        # caller-visible outcome flag (the CLI maps it to a distinct exit
+        # code).
+        self._preempt = threading.Event()
+        self._preempt_deadline: float | None = None
+        self.preempted = False
+        #: Whether the preemption drain actually published tag_preempt —
+        #: the CLI's "emergency checkpoint: written" claim keys off this,
+        #: not off preemption having been attempted.
+        self.preempt_saved = False
         self.restarts = 0
         self.agent_heals = 0   # per-agent row respawns (partial_recovery)
         self._best_eval: float | None = None  # lazily seeded from tag_best
@@ -271,7 +290,7 @@ class Orchestrator:
         self._eval_fn = None   # env/model changed: retrace on next evaluate
         template = self.agent.init(jax.random.PRNGKey(self.cfg.seed))
         if resume:
-            state, step = self.checkpoints.restore(template)
+            state, step, saved_meta = self._restore_for_resume(template)
             horizon = self.env.num_steps
             max_cursor = int(np.max(np.asarray(state.env_state.t)))
             if max_cursor > horizon:
@@ -291,7 +310,7 @@ class Orchestrator:
             # episode, and resuming it unclamped would set a completion
             # threshold ((episode+1) x horizon) that frozen agents can never
             # reach — an infinite chunk spin.
-            saved_episode = self.checkpoints.metadata(step).get("episode")
+            saved_episode = saved_meta.get("episode")
             raw = (int(saved_episode) if saved_episode is not None
                    else int(state.env_steps) // horizon)
             self.episode = max(0, min(raw, self.cfg.runtime.episodes - 1))
@@ -541,10 +560,17 @@ class Orchestrator:
         # reinitialize, discarding warm-start/resume state. This makes the
         # "lose at most checkpoint_every_updates updates" bound true from
         # chunk 0.
-        if (rt.checkpoint_every_updates > 0
-                and self.checkpoints.latest_step() is None):
+        # "Exists" is not enough — steps() lists damaged dirs so the
+        # walk-back can quarantine them; the baseline must be saved unless
+        # an INTACT checkpoint could actually serve a restore (one hash of
+        # the newest checkpoint, once per run start).
+        has_intact = getattr(self.checkpoints, "any_intact",
+                             lambda: self.checkpoints.latest_step()
+                             is not None)
+        if rt.checkpoint_every_updates > 0 and not has_intact():
             self.checkpoints.save_async(
-                updates0, self._ts, metadata={"episode": self.episode})
+                updates0, self._ts,
+                metadata={"episode": self.episode, "env_steps": env_steps0})
         timer.tick()
         last_env_steps: int | None = env_steps0
         chunks_since = 0   # chunks since the last materialization decision
@@ -581,6 +607,12 @@ class Orchestrator:
           while not self._stop.is_set():
             try:
                 acting_chunk = None
+                if self._preempt.is_set():
+                    # Megachunk-boundary preemption point: every committed
+                    # state lands here between dispatches, so the emergency
+                    # checkpoint below captures a coherent boundary state.
+                    self._preempt_shutdown(pl)
+                    return
                 if pl is not None and (pl.error is not None
                                        or pl.attention.is_set()):
                     # A consumer fault, or a boundary row that needs a
@@ -846,7 +878,7 @@ class Orchestrator:
                 with obs.span("supervision_recovery",
                               restart=self.restarts) \
                         if obs.enabled else _NULL_CTX:
-                    if self._stop.wait(delay):
+                    if self._wait_backoff(delay):
                         return
                     self._restore_or_reinit()
                 # Exclude the failed chunk + backoff + restore from the
@@ -866,6 +898,102 @@ class Orchestrator:
             "boundaries": (self.pipeline_stats.get("boundaries", 0)
                            + pl.processed),
         }
+
+    # ------------------------------------------------------------------
+    # preemption (SIGTERM/SIGINT): drain, emergency checkpoint, exit
+    # ------------------------------------------------------------------
+
+    def request_preempt(self) -> None:
+        """Ask the run to preempt: the training thread drains and writes the
+        ``tag_preempt`` emergency checkpoint at its next megachunk boundary
+        (:meth:`_preempt_shutdown`), then returns. Installed as the
+        SIGTERM/SIGINT action by ``cli train``; safe to call from
+        signal-handler context (it only sets an Event). The grace deadline
+        anchors HERE — at notice time, not at the boundary the dispatcher
+        eventually reaches — so a long in-flight megachunk eats into the
+        budget instead of extending it past the fleet's follow-up KILL."""
+        if not self._preempt.is_set():
+            self._preempt_deadline = (time.monotonic()
+                                      + self.cfg.runtime.preempt_grace_s)
+        self._preempt.set()
+
+    def _wait_backoff(self, delay: float) -> bool:
+        """Backoff sleep that wakes EARLY on preemption — the restart
+        backoff must not eat the ``runtime.preempt_grace_s`` budget (the
+        loop top then runs the preemption drain against the restored
+        state). Returns True when stop was requested."""
+        deadline = time.monotonic() + delay
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or self._preempt.is_set():
+                return False
+            if self._stop.wait(min(remaining, 0.1)):
+                return True
+
+    def _preempt_shutdown(self, pl: AsyncPipeline | None) -> None:
+        """The preemption drain, run on the training thread at a megachunk
+        boundary, inside ``runtime.preempt_grace_s``: queued readbacks drain
+        in order (their metric rows and journal appends commit), in-flight
+        async checkpoint writes land, an emergency ``tag_preempt``
+        checkpoint with full resume metadata (updates / env_steps / episode)
+        is written, the journal group-commit batch hits the disk, and the
+        flight recorder dumps with reason ``"preemption"``. Never raises — a
+        failure here degrades durability but must not convert a preemption
+        into a supervision restart that burns the remaining grace."""
+        obs = self.obs
+        grace = self.cfg.runtime.preempt_grace_s
+        # Anchored at request_preempt time: boundary latency (a long
+        # in-flight megachunk) already consumed part of the budget.
+        deadline = self._preempt_deadline or (time.monotonic() + grace)
+        log.warning("preemption requested; draining for an emergency "
+                    "checkpoint (%.1fs of the %.1fs grace left)",
+                    max(0.0, deadline - time.monotonic()), grace)
+        saved = False
+        with (obs.span("preemption_drain", grace_s=grace)
+              if obs.enabled else _NULL_CTX):
+            try:
+                if pl is not None:
+                    pl.drain(timeout_s=max(0.5,
+                                           deadline - time.monotonic()))
+                self._ensure_live_state()
+                updates, env_steps = (int(v) for v in jax.device_get(
+                    (self._ts.updates, self._ts.env_steps)))
+                self.checkpoints.wait_pending(
+                    timeout=max(0.5, deadline - time.monotonic()))
+                self.checkpoints.save_tagged(
+                    "preempt", self._ts,
+                    metadata={"updates": updates, "env_steps": env_steps,
+                              "episode": self.episode, "preempted": True})
+                saved = True
+                # Durability-critical work strictly BEFORE any telemetry
+                # write: a failing obs volume must not skip the journal
+                # batch flush or the event-log record.
+                flush = getattr(self._transitions_journal, "flush", None)
+                if flush is not None:
+                    flush()
+                self.events.emit("preempted", updates=updates,
+                                 env_steps=env_steps, episode=self.episode)
+                log.warning("emergency checkpoint tag_preempt written "
+                            "(updates=%d, env_steps=%d, episode=%d)",
+                            updates, env_steps, self.episode)
+            except Exception:
+                log.exception("preemption drain failed; exiting with "
+                              "whatever was already durable")
+        try:
+            # Telemetry is inside its own no-raise envelope too: an IO
+            # error here must not convert the preemption into a
+            # supervision restart that burns the remaining grace.
+            if saved:
+                obs.tracer.instant("emergency_checkpoint",
+                                   updates=updates, env_steps=env_steps)
+            obs.dump_flight(reason="preemption", episode=self.episode,
+                            restarts=self.restarts)
+            self.tracer.stop()
+            obs.flush()
+        except Exception:
+            log.exception("preemption telemetry flush failed")
+        self.preempt_saved = saved
+        self.preempted = True
 
     def _host_process(self, b: Boundary) -> dict[str, float]:
         """The consumer half: ONE batched readback for the whole megachunk
@@ -1006,7 +1134,12 @@ class Orchestrator:
             # can't recover it once per-agent heals inflate the step
             # count past horizon-per-episode.
             self.checkpoints.save_async(
-                updates, self._ts, metadata={"episode": self.episode})
+                updates, self._ts,
+                # env_steps rides along for the crash-soak/journal
+                # consistency checks and the resume-source comparison
+                # (tag_preempt vs latest step checkpoint).
+                metadata={"episode": self.episode,
+                          "env_steps": int(metrics.get("env_steps", 0))})
             self.metrics.inc("checkpoints_total")
             self.events.emit("checkpoint", updates=updates)
         self._last_ckpt_updates = updates
@@ -1042,8 +1175,10 @@ class Orchestrator:
                 self._reset_episode()
                 return "rearmed"
             self.checkpoints.wait_pending(timeout=60)
-            self.checkpoints.save(updates, self._ts,
-                                  metadata={"episode": self.episode})
+            self.checkpoints.save(
+                updates, self._ts,
+                metadata={"episode": self.episode,
+                          "env_steps": int(metrics.get("env_steps", 0))})
             # Completion is a durability point: group-commit batches (and
             # the C++ async writer's queue) drain to disk before the run
             # reports COMPLETED, so a reader of the journal file sees every
@@ -1183,18 +1318,109 @@ class Orchestrator:
         return True
 
     def _restore_or_reinit(self) -> None:
-        """Restore the latest checkpoint, else restart the episode from
-        scratch — respawn-and-retrain (TrainerRouterActor.scala:116-120,
-        141-146)."""
+        """Restore the latest INTACT checkpoint — the manager verifies each
+        candidate (checksums, deserializability, finite shared leaves),
+        quarantines damaged ones and walks back — else restart the episode
+        from scratch: respawn-and-retrain (TrainerRouterActor.scala:116-120,
+        141-146). "All corrupt" raises CheckpointCorruptError, a
+        FileNotFoundError subclass, so it lands on the same reinit arm as
+        "none saved yet" — a run never strands on damaged newest bytes."""
         template = self.agent.init(jax.random.PRNGKey(self.cfg.seed))
         self.checkpoints.wait_pending(timeout=60)  # pick up in-flight saves
         try:
             state, step = self.checkpoints.restore(template)
+            self._surface_restore_fallback()
             self._ts = self._place(self._warm_start_replay(state))
             self.events.emit("restored", step=step)
         except FileNotFoundError:
             self._ts = self._place(self._warm_start_replay(template))
             self.events.emit("reinitialized")
+
+    def _surface_restore_fallback(self) -> None:
+        """A restore that had to walk back past quarantined checkpoints is
+        a supervision-visible fact, not just a manager log line: the event
+        log records which steps were skipped and why (the counters —
+        ckpt_restore_fallbacks_total / ckpt_quarantined_total — already
+        flowed through the manager's metrics hook)."""
+        report = getattr(self.checkpoints, "last_restore_report", None) or {}
+        skipped = report.get("skipped")
+        if skipped:
+            self.events.emit(
+                "restore_fallback", step=report.get("step"),
+                skipped=[[int(s), reason] for s, reason in skipped])
+
+    def _restore_for_resume(self, template: TrainState
+                            ) -> tuple[TrainState, int, dict]:
+        """``--resume`` source selection: prefer the ``tag_preempt``
+        emergency checkpoint when it is at least as new (by update count)
+        as the newest VERIFIED step checkpoint — it was written AFTER the
+        last cadence save, at the exact megachunk boundary the preempted
+        run stopped on. Falls back to the verified step-checkpoint
+        walk-back when the tag is absent, older, or quarantined; and
+        symmetrically, when the step walk-back lands BELOW the tag's
+        update count (the unverified ``latest_step`` number was inflated
+        by a checkpoint verification rejected), the intact emergency
+        checkpoint is re-preferred. Returns ``(state, step_label,
+        metadata)``."""
+        pmeta = self.checkpoints.tagged_metadata("preempt")
+        tag_hint = int(pmeta.get("updates", -1)) if pmeta else -1
+        latest = self.checkpoints.latest_step()
+
+        def tag_candidate() -> tuple[TrainState, int, dict] | None:
+            """Verified tag_preempt restore; None when absent or every
+            copy (primary + .old) was quarantined by verification."""
+            try:
+                state, meta = self.checkpoints.restore_tagged(
+                    template, "preempt")
+            except FileNotFoundError:
+                return None
+            return state, int(meta.get("updates", 0)), meta
+
+        def accept(t: tuple[TrainState, int, dict]
+                   ) -> tuple[TrainState, int, dict]:
+            log.info("resuming from preemption checkpoint (updates=%d)",
+                     t[1])
+            self.events.emit("resumed_from_preempt", updates=t[1])
+            return t
+
+        tag = None
+        if pmeta is not None and (latest is None or tag_hint >= latest):
+            tag = tag_candidate()
+            # Compare the ACTUALLY-restored metadata, not the hint: a
+            # corrupt primary makes restore_tagged serve the .old crash-
+            # window copy, which can be older than a step checkpoint.
+            if tag is not None and (latest is None or tag[1] >= latest):
+                return accept(tag)
+        try:
+            state, step = self.checkpoints.restore(template)
+        except FileNotFoundError:
+            # Steps gone or ALL corrupt: an intact emergency checkpoint —
+            # even one OLDER than the (now-quarantined) step numbers that
+            # suppressed the preference above — beats stranding the run.
+            if tag is None and pmeta is not None:
+                tag = tag_candidate()
+            if tag is not None:
+                return accept(tag)
+            raise
+        self._surface_restore_fallback()
+        # The VERIFIED metadata rides the restore report — re-reading
+        # meta.json here would be redundant IO plus a window for an
+        # unverified copy to diverge from what restore just checksummed.
+        report = getattr(self.checkpoints, "last_restore_report", None) or {}
+        meta = report.get("meta") or self.checkpoints.metadata(step)
+        if pmeta is not None and tag is None and tag_hint > step:
+            # The step side's number was inflated by a checkpoint that the
+            # walk-back quarantined; the emergency checkpoint may now be
+            # the freshest intact state after all.
+            tag = tag_candidate()
+        if tag is not None and tag[1] > step:
+            return accept(tag)
+        if tag is not None:
+            log.warning(
+                "preemption checkpoint restored at updates=%d is older "
+                "than step checkpoint %d; using the step checkpoint",
+                tag[1], step)
+        return state, step, meta
 
     # ------------------------------------------------------------------
     # journal-backed replay (learner.journal_replay; SURVEY.md §7.4)
@@ -1487,6 +1713,11 @@ class Orchestrator:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
+        # Queued save_async writes must land before teardown: a stop right
+        # after a cadence save would otherwise silently drop it (the writer
+        # is a daemon thread — process exit kills it mid-write, and the
+        # atomic protocol would roll that checkpoint back to nothing).
+        self.checkpoints.wait_pending(timeout=60)
         if self._transitions_journal is not None:
             self._transitions_journal.close()
             self._transitions_journal = None

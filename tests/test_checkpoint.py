@@ -1,15 +1,24 @@
-"""Checkpoint manager: atomicity, retention, bit-exact resume."""
+"""Checkpoint manager: atomicity, integrity, quarantine walk-back, retention,
+bit-exact resume."""
 
+import json
 import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from sharetrade_tpu.agents import build_agent
-from sharetrade_tpu.checkpoint import CheckpointManager
+from sharetrade_tpu.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointIntegrityError,
+    CheckpointManager,
+    verify_checkpoint_files,
+)
 from sharetrade_tpu.config import FrameworkConfig
 from sharetrade_tpu.env import trading
+from sharetrade_tpu.utils.metrics import MetricsRegistry
 
 WINDOW = 8
 
@@ -115,3 +124,260 @@ class TestSaveRestore:
         mgr.save(7, ts, metadata={"note": "mid-episode"})
         meta = mgr.metadata(7)
         assert meta["step"] == 7 and meta["note"] == "mid-episode"
+
+    def test_fsync_off_still_round_trips(self, tmp_path):
+        agent = make_agent()
+        ts = agent.init(jax.random.PRNGKey(0))
+        mgr = CheckpointManager(str(tmp_path), fsync=False)
+        mgr.save(3, ts)
+        restored, step = mgr.restore(agent.init(jax.random.PRNGKey(5)))
+        assert step == 3
+        for a, b in zip(jax.tree.leaves(jax.device_get(ts)),
+                        jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# integrity: checksums, verify(), quarantine + walk-back
+# ---------------------------------------------------------------------------
+
+def _truncate(path, size):
+    with open(path, "r+b") as f:
+        f.truncate(size)
+
+
+def _bitflip(path, frac=0.5):
+    size = os.path.getsize(path)
+    off = max(0, min(size - 1, int(size * frac)))
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+#: The corruption matrix: (name, mutator(ckpt_dir), expected quarantine
+#: reason). Every case must be DETECTED, the checkpoint quarantined (renamed
+#: corrupt_*, never deleted), and restore must fall back to the next-oldest
+#: intact step.
+CORRUPTIONS = [
+    ("state_truncated_empty",
+     lambda d: _truncate(os.path.join(d, "state.msgpack"), 0),
+     "state_checksum"),
+    ("state_truncated_1byte",
+     lambda d: _truncate(os.path.join(d, "state.msgpack"), 1),
+     "state_checksum"),
+    ("state_truncated_half",
+     lambda d: _truncate(
+         os.path.join(d, "state.msgpack"),
+         os.path.getsize(os.path.join(d, "state.msgpack")) // 2),
+     "state_checksum"),
+    ("state_truncated_last_byte",
+     lambda d: _truncate(
+         os.path.join(d, "state.msgpack"),
+         os.path.getsize(os.path.join(d, "state.msgpack")) - 1),
+     "state_checksum"),
+    ("state_bitflipped",
+     lambda d: _bitflip(os.path.join(d, "state.msgpack")),
+     "state_checksum"),
+    ("state_missing",
+     lambda d: os.remove(os.path.join(d, "state.msgpack")),
+     "state_missing"),
+    ("meta_missing",
+     lambda d: os.remove(os.path.join(d, "meta.json")),
+     "meta_missing"),
+    ("meta_garbled",
+     lambda d: open(os.path.join(d, "meta.json"), "w").write("{nope"),
+     "meta_garbled"),
+    ("meta_bitflipped",
+     lambda d: _bitflip(os.path.join(d, "meta.json"), 0.9),
+     None),      # garbled JSON or checksum mismatch, depending on the byte
+    ("empty_dir",
+     lambda d: [os.remove(os.path.join(d, n)) for n in os.listdir(d)],
+     None),      # meta and state both gone
+]
+
+
+class TestIntegrity:
+    def _three_checkpoints(self, tmp_path, **kwargs):
+        """Steps 10 < 20 < 30, each from a distinct train state."""
+        agent = make_agent()
+        step_fn = jax.jit(agent.step)
+        mgr = CheckpointManager(str(tmp_path), keep=5, **kwargs)
+        ts = agent.init(jax.random.PRNGKey(0))
+        for step in (10, 20, 30):
+            ts, _ = step_fn(ts)
+            mgr.save(step, ts)
+        return agent, mgr
+
+    def test_meta_records_checksums(self, tmp_path):
+        _, mgr = self._three_checkpoints(tmp_path)
+        meta = mgr.metadata(30)
+        integ = meta["integrity"]
+        assert integ["algo"] == "sha256"
+        assert len(integ["state.msgpack"]) == 64
+        assert len(integ["meta_sha256"]) == 64
+
+    def test_verify_accepts_intact(self, tmp_path):
+        _, mgr = self._three_checkpoints(tmp_path)
+        assert mgr.verify()["step"] == 30
+        assert mgr.verify(10)["step"] == 10
+        verify_checkpoint_files(os.path.join(str(tmp_path),
+                                             "ckpt_0000000020"))
+
+    @pytest.mark.parametrize("name,mutate,reason",
+                             CORRUPTIONS, ids=[c[0] for c in CORRUPTIONS])
+    def test_corrupt_newest_quarantined_and_walked_back(
+            self, tmp_path, name, mutate, reason):
+        metrics = MetricsRegistry()
+        agent, mgr = self._three_checkpoints(tmp_path, metrics=metrics)
+        mutate(os.path.join(str(tmp_path), "ckpt_0000000030"))
+        with pytest.raises(CheckpointIntegrityError):
+            mgr.verify(30)
+        restored, step = mgr.restore(agent.init(jax.random.PRNGKey(9)))
+        assert step == 20, "walk-back must serve the next-oldest intact step"
+        # Quarantined — renamed aside with the reason, never deleted.
+        corrupt = [n for n in os.listdir(tmp_path)
+                   if n.startswith("corrupt_0000000030")]
+        assert len(corrupt) == 1
+        if reason is not None:
+            assert reason in corrupt[0]
+        assert not os.path.isdir(tmp_path / "ckpt_0000000030")
+        assert mgr.steps() == [10, 20]
+        assert metrics.counters()["ckpt_quarantined_total"] == 1
+        assert metrics.counters()["ckpt_restore_fallbacks_total"] == 1
+        # The fallback is reported for the orchestrator's event surface.
+        assert mgr.last_restore_report["step"] == 20
+        assert mgr.last_restore_report["skipped"][0][0] == 30
+
+    def test_nonfinite_params_rejected(self, tmp_path):
+        agent, mgr = self._three_checkpoints(tmp_path)
+        ts = agent.init(jax.random.PRNGKey(0))
+        poisoned = ts.replace(
+            params=jax.tree.map(lambda a: jnp.full_like(a, jnp.nan),
+                                ts.params))
+        mgr.save(40, poisoned)
+        restored, step = mgr.restore(agent.init(jax.random.PRNGKey(9)))
+        assert step == 30
+        assert any(n.startswith("corrupt_0000000040_nonfinite")
+                   for n in os.listdir(tmp_path))
+
+    def test_all_corrupt_raises_corrupt_error(self, tmp_path):
+        agent, mgr = self._three_checkpoints(tmp_path)
+        for step in (10, 20, 30):
+            _bitflip(str(tmp_path / f"ckpt_{step:010d}" / "state.msgpack"))
+        with pytest.raises(CheckpointCorruptError):
+            mgr.restore(agent.init(jax.random.PRNGKey(9)))
+        # FileNotFoundError-compatible: restore-or-reinit arms catch it.
+        assert issubclass(CheckpointCorruptError, FileNotFoundError)
+        # All three quarantined, none deleted: the bytes are evidence.
+        assert len([n for n in os.listdir(tmp_path)
+                    if n.startswith("corrupt_")]) == 3
+
+    def test_explicit_corrupt_step_raises_not_substitutes(self, tmp_path):
+        agent, mgr = self._three_checkpoints(tmp_path)
+        _bitflip(str(tmp_path / "ckpt_0000000030" / "state.msgpack"))
+        with pytest.raises(CheckpointCorruptError):
+            mgr.restore(agent.init(jax.random.PRNGKey(9)), step=30)
+
+    def test_corrupt_tagged_quarantined(self, tmp_path):
+        agent, mgr = self._three_checkpoints(tmp_path)
+        ts = agent.init(jax.random.PRNGKey(0))
+        mgr.save_tagged("best", ts, metadata={"eval_portfolio": 1.0})
+        _bitflip(str(tmp_path / "tag_best" / "state.msgpack"))
+        with pytest.raises(CheckpointCorruptError):
+            mgr.restore_tagged(agent.init(jax.random.PRNGKey(9)), "best")
+        assert any(n.startswith("corrupt_tag_best")
+                   for n in os.listdir(tmp_path))
+
+    def test_tagged_overwrite_failure_leaves_live_tag(self, tmp_path,
+                                                      monkeypatch):
+        """The new payload is staged COMPLETELY before the live tag moves:
+        a write failure mid-overwrite (disk full, kill) must leave the
+        previous tag readable, not demote it to .old with no primary."""
+        agent, mgr = self._three_checkpoints(tmp_path)
+        ts = agent.init(jax.random.PRNGKey(0))
+        mgr.save_tagged("best", ts, metadata={"v": 1})
+
+        def boom(*a, **k):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(mgr, "_write_payload_tmp", boom)
+        with pytest.raises(OSError):
+            mgr.save_tagged("best", ts, metadata={"v": 2})
+        _, meta = mgr.restore_tagged(agent.init(jax.random.PRNGKey(9)),
+                                     "best")
+        assert meta["v"] == 1
+
+    def test_template_mismatch_raises_without_quarantine(self, tmp_path):
+        """A checksum-INTACT checkpoint that fails to deserialize is a
+        caller/config mismatch, not corruption: restore must raise loudly
+        and leave the store untouched — quarantining would rename the
+        whole store aside on a model-shape change + --resume."""
+        _, mgr = self._three_checkpoints(tmp_path)
+        # Structurally different template (DQN carries replay extras the
+        # qlearn checkpoints lack) — the config-changed --resume scenario.
+        other = make_agent("dqn")
+        with pytest.raises(ValueError, match="checksum-intact"):
+            mgr.restore(other.init(jax.random.PRNGKey(0)))
+        assert mgr.steps() == [10, 20, 30]
+        assert not any(n.startswith("corrupt_")
+                       for n in os.listdir(tmp_path))
+
+    def test_pre_integrity_checkpoint_still_restores(self, tmp_path):
+        """Checkpoints written before checksums existed (no integrity block)
+        must restore on structural checks alone — an upgrade must not
+        quarantine a healthy old fleet."""
+        agent, mgr = self._three_checkpoints(tmp_path)
+        meta_path = tmp_path / "ckpt_0000000030" / "meta.json"
+        meta = json.loads(meta_path.read_text())
+        del meta["integrity"]
+        meta_path.write_text(json.dumps(meta))
+        _, step = mgr.restore(agent.init(jax.random.PRNGKey(9)))
+        assert step == 30
+
+
+class TestTmpSweep:
+    def test_complete_tmp_recovered_not_swept(self, tmp_path):
+        """The same-step re-save crash window (_publish removes the old dir
+        before the rename): a kill there leaves only the fully-staged tmp.
+        The next manager must RECOVER it — it is a durable, checksummed
+        checkpoint that merely missed its rename — not sweep it."""
+        import shutil
+        agent = make_agent()
+        ts = agent.init(jax.random.PRNGKey(0))
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(5, ts)
+        # Simulate the window: staged tmp of a dead pid, published dir gone.
+        shutil.copytree(tmp_path / "ckpt_0000000005",
+                        tmp_path / "tmp-5-999999999")
+        shutil.rmtree(tmp_path / "ckpt_0000000005")
+        mgr2 = CheckpointManager(str(tmp_path))
+        assert mgr2.steps() == [5]
+        assert not (tmp_path / "tmp-5-999999999").exists()
+        restored, step = mgr2.restore(agent.init(jax.random.PRNGKey(9)))
+        assert step == 5
+        for a, b in zip(jax.tree.leaves(jax.device_get(ts)),
+                        jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_dead_pid_tmp_swept_at_init(self, tmp_path):
+        agent = make_agent()
+        ts = agent.init(jax.random.PRNGKey(0))
+        CheckpointManager(str(tmp_path)).save(5, ts)
+        # Debris from a crashed writer: a pid that cannot be alive.
+        dead = tmp_path / "tmp-7-999999999"
+        dead.mkdir()
+        (dead / "state.msgpack").write_bytes(b"partial")
+        mgr = CheckpointManager(str(tmp_path))
+        assert not dead.exists(), "dead-pid tmp debris must be swept"
+        assert mgr.steps() == [5]
+
+    def test_live_pid_tmp_untouched(self, tmp_path):
+        """A tmp dir of a LIVE pid belongs to a concurrent saver mid-write;
+        sweeping it would tear that save."""
+        live = tmp_path / f"tmp-9-{os.getpid()}"
+        live.mkdir()
+        (live / "state.msgpack").write_bytes(b"mid-write")
+        CheckpointManager(str(tmp_path))
+        assert live.exists()

@@ -397,3 +397,54 @@ class TestObsFacade:
         assert len(root.handlers) == len(before) + 1
         obs.close()
         assert root.handlers == before
+
+
+class TestPreemptionObs:
+    def test_preemption_artifacts(self, tmp_path):
+        """Satellite contract of the durability PR: a preempted obs-enabled
+        run leaves a `preemption_drain` span, an `emergency_checkpoint`
+        instant, and a flight bundle with reason "preemption"."""
+        cfg = obs_cfg(tmp_path)
+        cfg.runtime.episodes = 200          # long run: cannot complete
+        orch = Orchestrator(cfg)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=True)
+        deadline = time.monotonic() + 30
+        while not orch.snapshot() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        orch.request_preempt()
+        assert orch.wait(timeout=30)
+        assert orch.preempted
+        orch.stop()
+
+        events = read_trace(os.path.join(cfg.obs.dir, "trace.jsonl"))
+        names = {e["name"] for e in events}
+        assert "preemption_drain" in names
+        assert "emergency_checkpoint" in names
+        bundle = json.load(open(os.path.join(cfg.obs.dir,
+                                             "flight_recorder.json")))
+        assert bundle["reason"] == "preemption"
+
+    def test_restore_fallback_counters_exported(self, tmp_path):
+        """The walk-back counters flow through the existing exporter into
+        the Prometheus textfile."""
+        cfg = obs_cfg(tmp_path)
+        from sharetrade_tpu.runtime import run_end_to_end
+        orch = run_end_to_end(cfg, PRICES)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        orch.stop()
+        ckpt_dir = cfg.runtime.checkpoint_dir
+        newest = sorted(n for n in os.listdir(ckpt_dir)
+                        if n.startswith("ckpt_"))[-1]
+        from test_checkpoint import _bitflip   # the one corruption helper
+        _bitflip(os.path.join(ckpt_dir, newest, "state.msgpack"))
+
+        cfg2 = obs_cfg(tmp_path)
+        cfg2.obs.dir = str(tmp_path / "obs2")
+        orch2 = Orchestrator(cfg2)
+        orch2.send_training_data(PRICES, resume=True)
+        orch2.obs.flush()
+        prom = open(os.path.join(cfg2.obs.dir, "metrics.prom")).read()
+        assert "sharetrade_ckpt_restore_fallbacks_total 1" in prom
+        assert "sharetrade_ckpt_quarantined_total 1" in prom
+        orch2.stop()

@@ -6,6 +6,7 @@ override), lifecycle queries are asserted in every phase, and failures are
 injected mid-run to assert self-healing.
 """
 
+import os
 import threading
 import time
 
@@ -788,3 +789,157 @@ class TestInitialise:
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         orch.start_training(background=False)
         assert orch.is_everything_done().state is ReplyState.COMPLETED
+
+
+class TestCrashSafety:
+    """The durability tentpole at the orchestrator level: a corrupt newest
+    checkpoint never strands --resume, and SIGTERM-style preemption writes a
+    resumable emergency checkpoint within the grace budget."""
+
+    def _bitflip(self, path):
+        from test_checkpoint import _bitflip   # the one corruption helper
+        _bitflip(path)
+
+    def test_resume_walks_back_past_corrupt_newest(self, tmp_path):
+        cfg = fast_cfg(tmp_path)
+        orch = run_end_to_end(cfg, PRICES)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        orch.stop()
+        ckpt_dir = cfg.runtime.checkpoint_dir
+        names = sorted(n for n in os.listdir(ckpt_dir)
+                       if n.startswith("ckpt_"))
+        assert len(names) >= 2, "need an older step to walk back to"
+        self._bitflip(os.path.join(ckpt_dir, names[-1], "state.msgpack"))
+
+        orch2 = Orchestrator(cfg)
+        orch2.send_training_data(PRICES, resume=True)   # must not raise
+        # The damaged newest was quarantined (not deleted) and the restore
+        # fell back — surfaced through the counters the obs exporter ships.
+        assert any(n.startswith("corrupt_")
+                   for n in os.listdir(ckpt_dir))
+        counters = orch2.metrics.counters()
+        assert counters["ckpt_restore_fallbacks_total"] == 1
+        assert counters["ckpt_quarantined_total"] == 1
+        # ... and training still completes from the walk-back point.
+        orch2.start_training(background=False)
+        assert orch2.is_everything_done().state is ReplyState.COMPLETED
+        orch2.stop()
+
+    def test_preempt_writes_emergency_checkpoint_and_resume_prefers_it(
+            self, tmp_path):
+        cfg = fast_cfg(tmp_path)
+        cfg.runtime.episodes = 200          # long run: cannot complete
+        cfg.runtime.preempt_grace_s = 20.0
+        orch = Orchestrator(cfg)
+        orch.send_training_data(PRICES)
+        orch.start_training(background=True)
+        deadline = time.monotonic() + 30
+        while not orch.snapshot() and time.monotonic() < deadline:
+            time.sleep(0.02)                # let some chunks commit
+        orch.request_preempt()
+        assert orch.wait(timeout=30), "preemption drain did not finish"
+        assert orch.preempted
+        meta = orch.checkpoints.tagged_metadata("preempt")
+        assert meta is not None
+        assert {"updates", "env_steps", "episode"} <= set(meta)
+        orch.stop()
+
+        # --resume prefers the emergency checkpoint: the restored state's
+        # counters equal the preempt metadata, not an older cadence save.
+        orch2 = Orchestrator(cfg)
+        orch2.send_training_data(PRICES, resume=True)
+        assert int(jax.device_get(orch2.train_state.env_steps)) \
+            == int(meta["env_steps"])
+        assert int(jax.device_get(orch2.train_state.updates)) \
+            == int(meta["updates"])
+        orch2.stop()
+
+    def test_preempt_before_start_drains_immediately(self, tmp_path):
+        cfg = fast_cfg(tmp_path)
+        cfg.runtime.episodes = 200
+        orch = Orchestrator(cfg)
+        orch.send_training_data(PRICES)
+        orch.request_preempt()              # notice during bring-up
+        orch.start_training(background=False)
+        assert orch.preempted
+        assert orch.checkpoints.tagged_metadata("preempt") is not None
+        orch.stop()
+
+    def test_stop_waits_for_pending_async_saves(self, tmp_path):
+        """A stop right after a cadence save must not drop the queued
+        save_async write (the writer is a daemon thread)."""
+        cfg = fast_cfg(tmp_path)
+        orch = Orchestrator(cfg)
+        orch.send_training_data(PRICES)
+        ts = orch.train_state
+        orch.checkpoints.save_async(777, ts, metadata={"episode": 0})
+        orch.stop()                         # must drain, not drop
+        assert 777 in orch.checkpoints.steps()
+        assert orch.checkpoints.verify(777)["step"] == 777
+
+    def test_resume_reprefers_preempt_when_newest_step_corrupt(
+            self, tmp_path):
+        """A corrupt newest STEP checkpoint numbered above the emergency
+        checkpoint must not suppress the tag_preempt preference: after the
+        walk-back quarantines it, the intact emergency checkpoint is the
+        freshest state and wins."""
+        cfg = fast_cfg(tmp_path)
+        orch = Orchestrator(cfg)
+        orch.send_training_data(PRICES)
+        ts = orch.train_state
+        mgr = orch.checkpoints
+        mgr.save(32, ts, metadata={"episode": 0, "env_steps": 32})
+        mgr.save_tagged("preempt", ts, metadata={
+            "updates": 47, "env_steps": 47, "episode": 0,
+            "preempted": True})
+        mgr.save(55, ts, metadata={"episode": 0, "env_steps": 55})
+        self._bitflip(str(tmp_path / "ckpts" / "ckpt_0000000055"
+                          / "state.msgpack"))
+        template = orch.agent.init(jax.random.PRNGKey(cfg.seed))
+        _, step, meta = orch._restore_for_resume(template)
+        assert step == 47 and meta["preempted"] is True
+        assert any(n.startswith("corrupt_0000000055")
+                   for n in os.listdir(tmp_path / "ckpts"))
+        orch.stop()
+
+    def test_resume_serves_older_preempt_when_all_steps_corrupt(
+            self, tmp_path):
+        """Every step checkpoint corrupt but an intact OLDER tag_preempt
+        exists: resume must serve the emergency checkpoint instead of
+        stranding — 'resume always succeeds from some intact checkpoint'."""
+        cfg = fast_cfg(tmp_path)
+        orch = Orchestrator(cfg)
+        orch.send_training_data(PRICES)
+        ts = orch.train_state
+        mgr = orch.checkpoints
+        mgr.save_tagged("preempt", ts, metadata={
+            "updates": 10, "env_steps": 10, "episode": 0,
+            "preempted": True})
+        for step in (20, 30):
+            mgr.save(step, ts, metadata={"episode": 0, "env_steps": step})
+            self._bitflip(str(tmp_path / "ckpts" / f"ckpt_{step:010d}"
+                              / "state.msgpack"))
+        template = orch.agent.init(jax.random.PRNGKey(cfg.seed))
+        _, step, meta = orch._restore_for_resume(template)
+        assert step == 10 and meta["preempted"] is True
+        # Both damaged steps were quarantined along the way.
+        corrupt = [n for n in os.listdir(tmp_path / "ckpts")
+                   if n.startswith("corrupt_")]
+        assert len(corrupt) == 2
+        orch.stop()
+
+    def test_baseline_checkpoint_written_despite_torn_store(self, tmp_path):
+        """steps() lists damaged dirs (so walk-back can quarantine them),
+        but the baseline-save guard must key on INTACTNESS: a store holding
+        only a torn ckpt_ dir still gets its chunk-0 baseline, keeping the
+        'lose at most checkpoint_every_updates' bound true."""
+        cfg = fast_cfg(tmp_path)
+        junk = tmp_path / "ckpts" / "ckpt_0000000099"
+        junk.mkdir(parents=True)
+        (junk / "state.msgpack").write_bytes(b"torn")
+        orch = run_end_to_end(cfg, PRICES)
+        assert orch.is_everything_done().state is ReplyState.COMPLETED
+        orch.stop()
+        assert 0 in orch.checkpoints.steps(), \
+            "baseline save was skipped because a torn dir looked like a " \
+            "checkpoint"

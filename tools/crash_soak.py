@@ -1,0 +1,469 @@
+#!/usr/bin/env python
+"""Process-kill chaos soak: real training subprocesses, real SIGKILLs.
+
+The in-process chaos seams (``fault_hook``, the supervision tests) prove the
+orchestrator heals faults it can SEE; this tool proves the durability layer
+survives faults it cannot — the process dying mid-save, mid-journal-batch,
+mid-megachunk. It launches genuine ``cli train`` subprocesses against
+synthetic data, kills them at seeded-random points (SIGKILL for the
+no-warning preemption, SIGTERM to exercise the graceful ``tag_preempt``
+drain), relaunches with ``--resume``, and asserts the crash-safety
+invariants end to end:
+
+- **resume always succeeds** from *some* intact checkpoint (the atomic
+  fsynced write protocol means a kill can tear only a ``tmp-*`` dir, never a
+  published ``ckpt_*``; a deliberately bit-flipped checkpoint — injected by
+  the corruption scenario — is quarantined and walked back past, never a
+  stranding);
+- **corrupt checkpoints are quarantined, not deleted** (``corrupt_*`` dirs
+  survive with their bytes);
+- **no tmp debris accumulates** (the pid-liveness sweep at manager init
+  collects crashed writers' ``tmp-*`` dirs);
+- **progress is monotone**: the env-step total restored at each resume never
+  decreases across kills;
+- **journal agreement**: with per-append flushing, the transitions journal's
+  recovered high-water mark is at least every step checkpoint's recorded
+  ``env_steps`` (the journal sees each chunk before the checkpoint cadence
+  acts on it), and torn-tail recovery reads the file cleanly after every
+  kill;
+- **SIGTERM drains**: a TERM'd child exits ``EXIT_PREEMPTED`` (75) with a
+  ``tag_preempt`` emergency checkpoint carrying resume metadata, and the
+  next ``--resume`` prefers it.
+
+Seeded and reproducible: ``--seed`` fixes the kill schedule (signal choice +
+delay); the child configs are deterministic. ``make crash-soak`` runs the
+full randomized soak (>= 20 injections + the corruption scenario);
+tests/test_crash_soak.py drives a short 2-kill profile in tier-1.
+
+Usage:
+    python tools/crash_soak.py                  # full soak (~5-10 min, CPU)
+    python tools/crash_soak.py --kills 2 --algo qlearn   # quick profile
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from sharetrade_tpu.cli import EXIT_PREEMPTED  # noqa: E402
+
+
+class SoakError(AssertionError):
+    """An invariant violation — the soak FAILED."""
+
+
+def build_config(workdir: str, *, algo: str, episodes: int,
+                 preempt_grace_s: float = 20.0) -> dict:
+    """A small-but-real training config: multi-episode so runs last long
+    enough to kill, journaled DQN (when asked) so the journal invariants
+    are exercised, megachunks + async pipeline on so kills land mid-fused-
+    dispatch, tight checkpoint cadence so every kill window contains saves."""
+    return {
+        "seed": 7,
+        "data": {
+            "synthetic_length": 72,            # 64-step episodes (window 8)
+            "journal_dir": os.path.join(workdir, "journal"),
+            # Python journal with flush-per-append: the journal/checkpoint
+            # agreement invariant needs every acked append durable (the
+            # group-commit/native-writer batches trade a bounded tail for
+            # throughput — their own torn-tail contract is pinned by
+            # tests/test_data.py, not re-proven here).
+            "use_native_journal": False,
+            "async_transition_writer": False,
+            "journal_fsync_every_records": 1,
+            "journal_fsync_interval_s": 0.0,
+        },
+        "env": {"window": 8},
+        "model": {"hidden_dim": 8},
+        "learner": {
+            "algo": algo,
+            "journal_replay": algo == "dqn",
+            "replay_capacity": 4096,
+            "replay_batch": 32,
+        },
+        "parallel": {"num_workers": 4},
+        "runtime": {
+            "chunk_steps": 8,
+            "episodes": episodes,
+            "checkpoint_every_updates": 16,
+            "checkpoint_dir": os.path.join(workdir, "ckpts"),
+            "keep_checkpoints": 3,
+            "megachunk_factor": 2,
+            "metrics_every_chunks": 2,
+            "max_restarts": 3,
+            "backoff_initial_s": 0.05,
+            "backoff_max_s": 0.1,
+            "preempt_grace_s": preempt_grace_s,
+            "poll_interval_s": 0.05,
+        },
+        "obs": {"enabled": True, "dir": os.path.join(workdir, "obs")},
+    }
+
+
+def launch(cfg_path: str, log_path: str, *, resume: bool,
+           overrides: list[str] | None = None) -> subprocess.Popen:
+    """Start a child ``cli train``; its merged stdout/stderr goes to
+    ``log_path`` (a FILE, not a pipe — a pipe nobody drains fills at
+    ~64 KB and wedges the child mid-log-write, turning a drain under test
+    into a spurious hang)."""
+    cmd = [sys.executable, "-m", "sharetrade_tpu.cli", "train",
+           "--config", cfg_path, "--symbol", "SOAK"]
+    if resume:
+        cmd.append("--resume")
+    for item in overrides or []:
+        cmd += ["--set", item]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    with open(log_path, "w") as fh:
+        proc = subprocess.Popen(cmd, cwd=REPO, env=env,
+                                stdout=fh, stderr=subprocess.STDOUT)
+    proc.soak_log = log_path
+    return proc
+
+
+def _log_tail(proc: subprocess.Popen, limit: int = 4000) -> str:
+    try:
+        with open(proc.soak_log, errors="replace") as f:
+            return f.read()[-limit:]
+    except OSError:
+        return "<child log unreadable>"
+
+
+def wait_for_progress(ckpt_dir: str, obs_dir: str, t_launch: float,
+                      proc: subprocess.Popen,
+                      timeout_s: float = 180.0) -> None:
+    """Block until THIS child is past bring-up — its obs manifest has been
+    rewritten (orchestrator constructed, signal handlers live) AND at least
+    one ``ckpt_*`` dir exists. A kill before any durable state exists would
+    make resume legitimately impossible and prove nothing; a SIGTERM during
+    interpreter startup would hit the default disposition instead of the
+    graceful drain under test (the CLI installs its handlers before the
+    slow bring-up, but not before Python itself is up)."""
+    manifest = os.path.join(obs_dir, "manifest.json")
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            fresh = os.path.getmtime(manifest) >= t_launch - 1.0
+        except OSError:
+            fresh = False
+        if fresh and any(n.startswith("ckpt_") for n in _ls(ckpt_dir)):
+            return
+        if proc.poll() is not None:
+            raise SoakError(
+                f"child exited rc={proc.returncode} before its first "
+                f"checkpoint:\n{_log_tail(proc)}")
+        time.sleep(0.1)
+    proc.kill()
+    raise SoakError("child showed no training progress within "
+                    f"{timeout_s:.0f}s:\n{_log_tail(proc)}")
+
+
+def _ls(path: str) -> list[str]:
+    try:
+        return sorted(os.listdir(path))
+    except FileNotFoundError:
+        return []
+
+
+def newest_intact_meta(ckpt_dir: str) -> dict | None:
+    """Metadata of the newest checkpoint that passes verification, walking
+    back over damaged ones WITHOUT quarantining (read-only observer — the
+    resumed child owns the quarantine action)."""
+    from sharetrade_tpu.checkpoint.manager import (
+        _PREFIX, CheckpointIntegrityError, verify_checkpoint_files)
+
+    steps = []
+    for name in _ls(ckpt_dir):
+        if name.startswith(_PREFIX):
+            try:
+                steps.append(int(name[len(_PREFIX):]))
+            except ValueError:
+                pass
+    for s in sorted(steps, reverse=True):
+        try:
+            return verify_checkpoint_files(
+                os.path.join(ckpt_dir, f"{_PREFIX}{s:010d}"))
+        except CheckpointIntegrityError:
+            continue
+    return None
+
+
+def journal_high_water(journal_dir: str) -> int | None:
+    """Recovered env-step high-water of the transitions journal (torn-tail
+    recovery included); None when nothing was journaled yet. Raises through
+    any reader exception — an unreadable journal is an invariant failure."""
+    from sharetrade_tpu.data.transitions import read_tail_transitions
+    path = os.path.join(journal_dir, "transitions.journal")
+    if not os.path.exists(path):
+        return None
+    tail = read_tail_transitions(path, 1)
+    return None if tail is None else int(tail[4])
+
+
+def assert_no_stale_tmp(ckpt_dir: str) -> None:
+    """After a child ran (its manager init swept), no dead-pid tmp debris
+    may remain. Live-pid dirs would belong to a running child — the soak
+    only calls this between children, so ANY tmp dir is debris."""
+    debris = [n for n in _ls(ckpt_dir) if n.startswith("tmp-")]
+    if debris:
+        raise SoakError(f"stale checkpoint tmp debris accumulated: {debris}")
+
+
+def flip_byte(path: str, offset_frac: float = 0.5) -> None:
+    size = os.path.getsize(path)
+    off = max(0, min(size - 1, int(size * offset_frac)))
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def run_soak(*, kills: int, seed: int, algo: str, workdir: str | None,
+             sigterm_every: int = 3, corruption: bool = True,
+             verbose: bool = True) -> dict:
+    """The soak driver; returns a summary dict, raises SoakError on any
+    invariant violation."""
+    rng = random.Random(seed)
+    own_dir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="crash_soak_")
+    os.makedirs(workdir, exist_ok=True)
+    # Episodes high enough that the kill phase never completes a run; the
+    # final run overrides episodes down so completion is reachable.
+    cfg = build_config(workdir, algo=algo, episodes=1000)
+    cfg_path = os.path.join(workdir, "config.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f, indent=2)
+    ckpt_dir = cfg["runtime"]["checkpoint_dir"]
+    journal_dir = cfg["data"]["journal_dir"]
+
+    def say(msg: str) -> None:
+        if verbose:
+            print(f"[crash-soak] {msg}", flush=True)
+
+    summary = {"kills": [], "resumes": 0, "quarantined": 0,
+               "sigterm_preempts": 0, "seed": seed, "algo": algo,
+               "workdir": workdir}
+    last_restored_env_steps = -1
+    try:
+        for i in range(kills):
+            resume = i > 0
+            t_launch = time.time()
+            proc = launch(cfg_path,
+                          os.path.join(workdir, f"child_{i:02d}.log"),
+                          resume=resume)
+            try:
+                wait_for_progress(ckpt_dir, cfg["obs"]["dir"], t_launch,
+                                  proc)
+                if resume:
+                    summary["resumes"] += 1
+                # Seeded kill point: a uniform delay past first-checkpoint
+                # lands kills across the whole phase space — mid-save,
+                # mid-journal-append, mid-megachunk-dispatch (the child
+                # checkpoints every ~16 updates and journals every chunk,
+                # so every window contains all three).
+                delay = rng.uniform(0.2, 3.0)
+                use_term = sigterm_every > 0 and (i % sigterm_every
+                                                  == sigterm_every - 1)
+                time.sleep(delay)
+                if proc.poll() is not None:
+                    raise SoakError(
+                        f"kill {i}: child exited early rc={proc.returncode}"
+                        f":\n{_log_tail(proc)}")
+                sig = signal.SIGTERM if use_term else signal.SIGKILL
+                proc.send_signal(sig)
+                rc = proc.wait(timeout=cfg["runtime"]["preempt_grace_s"]
+                               + 30)
+                say(f"kill {i + 1}/{kills}: {sig.name} after {delay:.2f}s "
+                    f"-> rc={rc}")
+                summary["kills"].append(
+                    {"i": i, "signal": sig.name, "delay_s": round(delay, 3),
+                     "rc": rc})
+                if use_term:
+                    # Graceful preemption contract: distinct exit code and
+                    # an emergency checkpoint with resume metadata.
+                    if rc != EXIT_PREEMPTED:
+                        raise SoakError(
+                            f"SIGTERM child exited rc={rc}, expected "
+                            f"{EXIT_PREEMPTED}:\n{_log_tail(proc)}")
+                    pmeta_path = os.path.join(ckpt_dir, "tag_preempt",
+                                              "meta.json")
+                    if not os.path.isfile(pmeta_path):
+                        raise SoakError("SIGTERM child left no tag_preempt "
+                                        "emergency checkpoint")
+                    with open(pmeta_path) as f:
+                        pmeta = json.load(f)
+                    for key in ("updates", "env_steps", "episode"):
+                        if key not in pmeta:
+                            raise SoakError(
+                                f"tag_preempt metadata missing {key!r}: "
+                                f"{pmeta}")
+                    summary["sigterm_preempts"] += 1
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=30)
+
+            # ---- post-kill invariants, before the next resume ----
+            meta = newest_intact_meta(ckpt_dir)
+            if meta is None:
+                raise SoakError(
+                    f"kill {i}: no intact checkpoint survived "
+                    f"({_ls(ckpt_dir)})")
+            restored = int(meta.get("env_steps", 0))
+            if restored < last_restored_env_steps:
+                raise SoakError(
+                    f"kill {i}: restore point went BACKWARD "
+                    f"({last_restored_env_steps} -> {restored})")
+            last_restored_env_steps = restored
+            hw = journal_high_water(journal_dir)  # raises if unreadable
+            if algo == "dqn" and hw is not None and hw < restored:
+                raise SoakError(
+                    f"kill {i}: journal high-water {hw} behind newest "
+                    f"checkpoint env_steps {restored} despite per-append "
+                    "flushing")
+
+        # ---- corruption scenario: bit-flip every preferred resume source
+        # (tag_preempt AND the newest step checkpoint), so the final resume
+        # must quarantine both and WALK BACK to an older intact step ----
+        if corruption:
+            # Walk-back needs something to walk back TO: let one more child
+            # run gracefully until at least two step checkpoints exist.
+            if len([n for n in _ls(ckpt_dir)
+                    if n.startswith("ckpt_")]) < 2:
+                t_launch = time.time()
+                proc = launch(cfg_path,
+                              os.path.join(workdir, "child_accum.log"),
+                              resume=True)
+                try:
+                    wait_for_progress(ckpt_dir, cfg["obs"]["dir"],
+                                      t_launch, proc)
+                    deadline = time.monotonic() + 120
+                    while (len([n for n in _ls(ckpt_dir)
+                                if n.startswith("ckpt_")]) < 2
+                           and time.monotonic() < deadline):
+                        if proc.poll() is not None:
+                            raise SoakError(
+                                "accumulator child exited early "
+                                f"rc={proc.returncode}:\n{_log_tail(proc)}")
+                        time.sleep(0.2)
+                    proc.send_signal(signal.SIGTERM)
+                    rc = proc.wait(
+                        timeout=cfg["runtime"]["preempt_grace_s"] + 30)
+                    if rc != EXIT_PREEMPTED:
+                        raise SoakError(
+                            f"accumulator child exited rc={rc}, expected "
+                            f"{EXIT_PREEMPTED}")
+                finally:
+                    if proc.poll() is None:
+                        proc.kill()
+                        proc.wait(timeout=30)
+            names = [n for n in _ls(ckpt_dir) if n.startswith("ckpt_")]
+            if len(names) < 2:
+                raise SoakError("could not accumulate two step checkpoints "
+                                "for the corruption scenario")
+            victims = [os.path.join(ckpt_dir, names[-1], "state.msgpack")]
+            preempt_state = os.path.join(ckpt_dir, "tag_preempt",
+                                         "state.msgpack")
+            if os.path.isfile(preempt_state):
+                victims.append(preempt_state)
+            for victim in victims:
+                flip_byte(victim)
+            say("corruption scenario: bit-flipped "
+                + ", ".join(os.path.relpath(v, ckpt_dir) for v in victims))
+
+        # ---- final run: resume and COMPLETE ----
+        meta = newest_intact_meta(ckpt_dir)
+        episode = int((meta or {}).get("episode", 0))
+        proc = launch(cfg_path, os.path.join(workdir, "child_final.log"),
+                      resume=True,
+                      overrides=[f"runtime.episodes={episode + 2}"])
+        try:
+            proc.wait(timeout=900)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        out = _log_tail(proc, limit=20000)
+        if proc.returncode != 0:
+            raise SoakError(
+                f"final resume run failed rc={proc.returncode}:\n"
+                f"{out[-6000:]}")
+        summary["resumes"] += 1
+        summary["final_result"] = json.loads(out.strip().splitlines()[-1])
+
+        corrupt_dirs = [n for n in _ls(ckpt_dir)
+                        if n.startswith("corrupt_")]
+        summary["quarantined"] = len(corrupt_dirs)
+        if corruption:
+            if not corrupt_dirs:
+                raise SoakError("bit-flipped checkpoint was not quarantined")
+            for name in corrupt_dirs:
+                if not os.path.isfile(os.path.join(ckpt_dir, name,
+                                                   "state.msgpack")):
+                    raise SoakError(
+                        f"quarantined checkpoint {name} lost its payload "
+                        "(must be renamed aside, never deleted)")
+            # The resumed child fell back past the corrupt newest: its
+            # metrics export must carry the fallback counter.
+            prom = os.path.join(cfg["obs"]["dir"], "metrics.prom")
+            if os.path.isfile(prom):
+                with open(prom) as f:
+                    prom_text = f.read()
+                if "ckpt_restore_fallbacks_total" not in prom_text:
+                    raise SoakError(
+                        "ckpt_restore_fallbacks_total missing from the "
+                        "metrics export after a walk-back restore")
+        assert_no_stale_tmp(ckpt_dir)
+        say(f"soak PASSED: {kills} kills "
+            f"({summary['sigterm_preempts']} graceful), "
+            f"{summary['resumes']} resumes, "
+            f"{summary['quarantined']} quarantined")
+        return summary
+    finally:
+        if own_dir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--kills", type=int, default=20,
+                        help="SIGKILL/SIGTERM injections before the final "
+                             "completion run")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--algo", default="dqn", choices=["dqn", "qlearn"],
+                        help="dqn journals transitions (the full soak); "
+                             "qlearn skips the journal for a faster profile")
+    parser.add_argument("--sigterm-every", type=int, default=3,
+                        help="every Nth kill is a graceful SIGTERM "
+                             "(0 = SIGKILL only)")
+    parser.add_argument("--no-corruption", action="store_true",
+                        help="skip the bit-flip walk-back scenario")
+    parser.add_argument("--workdir", default=None,
+                        help="keep artifacts here instead of a temp dir")
+    args = parser.parse_args()
+    try:
+        summary = run_soak(kills=args.kills, seed=args.seed, algo=args.algo,
+                           workdir=args.workdir,
+                           sigterm_every=args.sigterm_every,
+                           corruption=not args.no_corruption)
+    except SoakError as exc:
+        print(f"[crash-soak] FAILED: {exc}", file=sys.stderr)
+        return 1
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,20 @@ in tests/test_megachunk.py:
    in a dispatcher-section function, and when the consumer-side functions
    this split relies on disappear (a rename must update this lint, not
    silently un-guard the seam).
+
+5. **fsync before publishing a durable rename** (the crash-safety PR's
+   guard) — in the checkpoint/journal write paths
+   (``checkpoint/manager.py``, ``data/journal.py``) an ``os.replace``
+   publishes a payload atomically, but WITHOUT a preceding fsync the
+   published name can outlive its bytes across a power loss (the rename
+   is ordered in the directory, the data blocks are not). FAILS on any
+   function in those files that calls ``os.replace`` without fsync
+   evidence in the same function — an actual CALL, matched in the AST, to
+   ``os.fsync``/``_fsync_dir`` or to one of the fsynced write helpers
+   (``write_framed*`` / ``_write_payload_tmp`` / ``_publish`` /
+   ``_write_checkpoint_dir``) — unless the replace line carries
+   ``replace-fsync-ok`` naming why durability is not needed there (e.g.
+   quarantining bytes that are already known-corrupt).
 """
 
 from __future__ import annotations
@@ -97,6 +111,22 @@ CONSUMER_FUNCS = ("_host_process", "_journal_transitions")
 #: dispatcher-section code (consumer-side occurrences carry MARKER).
 DISPATCH_BLOCK_PATTERN = re.compile(
     r"device_get\(|np\.asarray\(|os\.fsync\(|block_until_ready\(")
+
+#: Files whose os.replace calls publish DURABLE payloads (checkpoints,
+#: journal compactions) and therefore need fsync evidence in-function.
+DURABLE_WRITE_FILES = ("checkpoint/manager.py", "data/journal.py")
+#: Evidence that a function fsyncs what its os.replace publishes: an ACTUAL
+#: CALL (matched in the AST, not a substring — a comment or an `if
+#: self.fsync:` gate with the real os.fsync deleted must not satisfy the
+#: check) to fsync itself or to one of the fsynced write helpers.
+FSYNC_EVIDENCE_CALLS = {
+    "fsync", "_fsync_dir",
+    "write_framed", "write_framed_bytes",
+    "_write_checkpoint_dir", "_write_payload_tmp", "_publish",
+}
+#: Escape hatch for a durable-path os.replace that intentionally skips
+#: fsync (must name why — e.g. the payload is already known-corrupt).
+REPLACE_MARKER = "replace-fsync-ok"
 
 
 def lint_parallel_device_put() -> list[tuple[str, int, str]]:
@@ -167,6 +197,46 @@ def lint_dispatcher_blocking() -> tuple[list[tuple[str, int, str]], set[str]]:
             if DISPATCH_BLOCK_PATTERN.search(text) and MARKER not in text:
                 bad.append((node.name, ln, text.strip()))
     return bad, found
+
+
+def lint_durable_replace() -> list[tuple[str, int, str, str]]:
+    """Check 5: every function in the durable write paths that calls
+    ``os.replace`` must carry fsync evidence (or a justifying marker on the
+    replace line); returns (relpath, line, function, text) hits."""
+    root = TARGET.parent.parent     # sharetrade_tpu/
+    bad: list[tuple[str, int, str, str]] = []
+    for rel in DURABLE_WRITE_FILES:
+        path = root / rel
+        src = path.read_text()
+        lines = src.splitlines()
+        tree = ast.parse(src)
+        # Innermost enclosing function per os.replace call site.
+        funcs = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "replace"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "os"):
+                continue
+            if REPLACE_MARKER in lines[node.lineno - 1]:
+                continue
+            enclosing = [f for f in funcs
+                         if f.lineno <= node.lineno <= f.end_lineno]
+            if not enclosing:
+                continue    # module-level replace: out of scope
+            fn = min(enclosing, key=lambda f: f.end_lineno - f.lineno)
+            called = set()
+            for child in ast.walk(fn):
+                if isinstance(child, ast.Call):
+                    f = child.func
+                    called.add(f.attr if isinstance(f, ast.Attribute)
+                               else getattr(f, "id", None))
+            if not (called & FSYNC_EVIDENCE_CALLS):
+                bad.append((rel, node.lineno, fn.name,
+                            lines[node.lineno - 1].strip()))
+    return bad
 
 
 def lint_device_host_calls() -> list[tuple[str, int, str, str]]:
@@ -256,11 +326,23 @@ def main() -> int:
               "readback consumer (_host_process), or tag the line "
               f"'# {MARKER}: <why this blocks the dispatcher on purpose>'")
         return 1
+    dur_bad = lint_durable_replace()
+    if dur_bad:
+        print("durable-rename fsync lint FAILED:")
+        for rel, ln, fn, text in dur_bad:
+            print(f"  {rel}:{ln} (in {fn}): {text}")
+        print("an os.replace in a checkpoint/journal write path publishes a "
+              "name whose bytes are not yet durable; fsync the payload (and "
+              "directory) first — see _write_checkpoint_dir / "
+              "write_framed_bytes — or tag the line "
+              f"'# {REPLACE_MARKER}: <why durability is not needed here>'")
+        return 1
     print(f"hot-loop sync lint OK ({', '.join(sorted(found))}); "
           f"parallel device_put lint OK; "
           f"device-code host-call lint OK ({', '.join(DEVICE_PACKAGES)}); "
           f"dispatcher blocking-call lint OK "
-          f"({', '.join(DISPATCHER_FUNCS)})")
+          f"({', '.join(DISPATCHER_FUNCS)}); "
+          f"durable-rename fsync lint OK ({', '.join(DURABLE_WRITE_FILES)})")
     return 0
 
 
