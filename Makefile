@@ -8,13 +8,14 @@
 
 PYTHON ?= python
 
-.PHONY: check test slow native bench bench-async bench-ckpt bench-dispatch bench-obs bench-reshard crash-soak obs-demo lint shard-audit clean
+.PHONY: check test slow native bench bench-async bench-ckpt bench-dispatch bench-obs bench-reshard bench-roofline crash-soak obs-demo lint perf-gate shard-audit clean
 
 check: native lint
 	$(PYTHON) -m pytest tests/ -q -m "not slow" -x
 	$(PYTHON) tools/smoke_compile.py
 	$(PYTHON) tools/obs_demo.py
 	$(PYTHON) tools/shard_audit.py
+	$(PYTHON) tools/perf_gate.py
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
@@ -77,6 +78,20 @@ bench-reshard:
 bench-ckpt:
 	$(PYTHON) -c "import json, bench; \
 	print(json.dumps(bench.bench_ckpt_fsync(), indent=2))"
+
+# Roofline telemetry alone (obs.roofline off vs on, with an A/A control):
+# the <2% capture+gauge budget plus the captured per-program FLOPs /
+# arithmetic intensity / classification, recorded in BASELINE.md
+# "Roofline". Runnable on CPU in ~a minute.
+bench-roofline:
+	$(PYTHON) -c "import json, bench; \
+	print(json.dumps(bench.bench_roofline(), indent=2))"
+
+# Perf-regression gate (also part of check): the newest BENCH_*.json row
+# per (metric, backend) series must sit within the tolerance band of the
+# prior best — steps/s and MFU both gate (tools/perf_gate.py).
+perf-gate:
+	$(PYTHON) tools/perf_gate.py
 
 # Process-kill chaos soak: >= 20 seeded SIGKILL/SIGTERM injections into real
 # training subprocesses (journaled DQN config), each followed by --resume,

@@ -392,6 +392,17 @@ class ObsConfig:
     # trips, the NaN-loss guard fires, or the run escalates.
     flight_recorder: bool = True
     flight_capacity: int = 256
+    # Roofline telemetry (obs/roofline.py): capture XLA cost_analysis /
+    # memory_analysis for every compiled (mega)chunk program at COMPILE
+    # time (one extra AOT lowering per program, never a per-step cost),
+    # cross-check the XLA FLOP count against the analytic utils/flops.py
+    # model (>25% discrepancy warns through the flight recorder), and
+    # publish live mfu / achieved_tflops / hbm_gbps /
+    # arithmetic_intensity gauges from the pipeline consumer thread —
+    # plus a schema-versioned roofline.json artifact in the run dir
+    # (summarized by ``cli obs``). Off by default like the rest of obs/:
+    # disabled means no artifact, no gauges, no capture compile.
+    roofline: bool = False
     # Soak-run growth caps (active regardless of ``enabled`` — they bound
     # the IN-MEMORY primitives, not the exported files). Short runs never
     # reach them, so default behavior is unchanged; 0 = unbounded (the

@@ -17,7 +17,10 @@ hot-loop cost when disabled):
 - :mod:`flight` — bounded ring of recent chunk metrics / lifecycle /
   log events → ``flight_recorder.json`` forensic bundle on failure;
 - :mod:`manifest` — run identity (``manifest.json``: config hash, mesh,
-  backend, git rev) written at construction.
+  backend, git rev) written at construction;
+- :mod:`roofline` — compiled-cost capture (XLA cost/memory analysis per
+  (mega)chunk program) → live ``mfu``/``achieved_tflops``/``hbm_gbps``
+  gauges + schema-versioned ``roofline.json`` (``obs.roofline`` knob).
 
 The :class:`Obs` facade is what the orchestrator holds; a disabled instance
 is inert (``span()`` hands back a shared null context, ``record()`` returns
@@ -37,6 +40,11 @@ from sharetrade_tpu.obs.flight import (  # noqa: F401
     RingLogHandler,
 )
 from sharetrade_tpu.obs.manifest import build_manifest, write_manifest  # noqa: F401
+from sharetrade_tpu.obs.roofline import (  # noqa: F401
+    RooflineCapture,
+    read_roofline,
+    summarize_roofline,
+)
 from sharetrade_tpu.obs.trace import SpanTracer, read_trace  # noqa: F401
 
 FLIGHT_BUNDLE = "flight_recorder.json"
@@ -49,7 +57,8 @@ class Obs:
                  tracer: SpanTracer | None = None,
                  exporter: MetricsExporter | None = None,
                  flight: FlightRecorder | None = None,
-                 log_handler: RingLogHandler | None = None):
+                 log_handler: RingLogHandler | None = None,
+                 roofline: RooflineCapture | None = None):
         self.run_dir = run_dir
         self.enabled = run_dir is not None
         self.tracer = tracer if tracer is not None else SpanTracer(None)
@@ -59,6 +68,9 @@ class Obs:
         # is uniform, but record()/dump_flight() gate on _flight_on.
         self._flight_on = self.enabled and flight is not None
         self.flight = flight if flight is not None else FlightRecorder(1)
+        #: Roofline capture (obs.roofline) — None when disabled, so callers
+        #: gate on ONE attribute read and a disabled run pays nothing.
+        self.roofline = roofline
         self._log_handler = log_handler
         self._closed = False
 
@@ -130,8 +142,15 @@ def build_obs(cfg: Any, registry: Any, *, mesh: Any = None) -> Obs:
         flight = FlightRecorder(oc.flight_capacity)
         log_handler = RingLogHandler(flight)
         logging.getLogger("sharetrade").addHandler(log_handler)
+    roofline = None
+    if oc.roofline:
+        # Discrepancy warnings land in the flight ring (when one exists) so
+        # a later forensic dump names the miscounted program.
+        roofline = RooflineCapture(
+            registry, run_dir,
+            flight_record=flight.record if flight is not None else None)
     return Obs(run_dir=run_dir, tracer=tracer, exporter=exporter,
-               flight=flight, log_handler=log_handler)
+               flight=flight, log_handler=log_handler, roofline=roofline)
 
 
 def summarize_run_dir(run_dir: str) -> dict:
@@ -170,12 +189,24 @@ def summarize_run_dir(run_dir: str) -> dict:
                 if line.strip():
                     drains += 1
                     last = line
+        last_rec = json.loads(last) if last else None
+        counters = (last_rec or {}).get("counters") or {}
         out["metrics"] = {
             "drains": drains,
-            "last": json.loads(last) if last else None,
+            "last": last_rec,
+            # Counter TOTALS surfaced at the top level of the summary (the
+            # exporter's last drain is cumulative — counters are monotone),
+            # with the pipeline-health number called out explicitly so an
+            # operator doesn't have to know the registry key.
+            "counters": counters,
+            "pipeline_stalls_total": counters.get(
+                "pipeline_stalls_total", 0.0),
             "prom_file": os.path.isfile(
                 os.path.join(run_dir, "metrics.prom")),
         }
+    roofline = read_roofline(run_dir)
+    if roofline is not None:
+        out["roofline"] = summarize_roofline(roofline)
     flight_path = os.path.join(run_dir, FLIGHT_BUNDLE)
     if os.path.isfile(flight_path):
         with open(flight_path, encoding="utf-8") as f:

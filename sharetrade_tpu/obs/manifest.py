@@ -32,9 +32,17 @@ def _git_rev() -> str | None:
         return None
 
 
+def config_hash(cfg: Any) -> str:
+    """THE stable 16-char config identity — manifest.json's
+    ``config_hash`` and the bench envelope's (``bench._result_envelope``)
+    are the same recipe by construction, so run dirs and BENCH rows join
+    on it."""
+    blob = json.dumps(cfg.to_dict(), sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
 def build_manifest(cfg: Any, *, mesh: Any = None) -> dict:
     cfg_dict = cfg.to_dict()
-    blob = json.dumps(cfg_dict, sort_keys=True).encode()
     try:
         import jax
         backend = jax.default_backend()
@@ -44,7 +52,7 @@ def build_manifest(cfg: Any, *, mesh: Any = None) -> dict:
         backend, device_count, jax_version = None, None, None
     return {
         "created_at": time.time(),
-        "config_hash": hashlib.sha256(blob).hexdigest()[:16],
+        "config_hash": config_hash(cfg),
         "config": cfg_dict,
         "backend": backend,
         "device_count": device_count,

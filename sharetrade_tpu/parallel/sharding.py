@@ -215,7 +215,8 @@ def jit_parallel_step(agent, mesh: Mesh, ts: TrainState, *,
                       param_rules: dict[str, P] | None = None,
                       megachunk_factor: int = 1,
                       constrain: bool = True,
-                      donate: bool = True):
+                      donate: bool = True,
+                      cost_hook=None):
     """Build the jitted (uncalled) partitioned chunk program and its
     sharding tree: ``(shardings, jitted_fn)``.
 
@@ -239,6 +240,15 @@ def jit_parallel_step(agent, mesh: Mesh, ts: TrainState, *,
     - ``constrain`` (``parallel.shard_constraints``): re-pin the output
       state inside the program (see :func:`_constrained`); off only for
       the bench's with/without comparison.
+
+    ``cost_hook`` (the ``obs.roofline`` seam): called once, after the jit
+    wrapper is built, as ``cost_hook(fn, (ts,),
+    megachunk_factor=megachunk_factor, devices=<mesh size>)`` —
+    obs/roofline.py AOT-lowers the
+    program there and records its XLA cost/memory analysis, so the costs
+    the roofline gauges report belong to byte-for-byte the program the
+    orchestrator dispatches (the same identity guarantee the shard audit
+    relies on). Compile-time only: the hook must never ride a dispatch.
     """
     sh = train_state_shardings(ts, mesh, data_axis=data_axis,
                                param_rules=param_rules)
@@ -258,6 +268,12 @@ def jit_parallel_step(agent, mesh: Mesh, ts: TrainState, *,
                else ())
     fn = jax.jit(step_fn, in_shardings=(sh,), out_shardings=(sh, None),
                  donate_argnums=argnums)
+    if cost_hook is not None:
+        # devices: cost_analysis() describes the PER-DEVICE partition of
+        # the SPMD program; the hook needs the mesh size to relate it to
+        # the analytic (global-work) model.
+        cost_hook(fn, (ts,), megachunk_factor=megachunk_factor,
+                  devices=mesh.devices.size)
     return sh, fn
 
 
@@ -265,7 +281,8 @@ def make_parallel_step(agent, mesh: Mesh, *, data_axis: str = "dp",
                        param_rules: dict[str, P] | None = None,
                        megachunk_factor: int = 1,
                        constrain: bool = True,
-                       donate: bool = True):
+                       donate: bool = True,
+                       cost_hook=None):
     """jit the agent's chunk step with mesh shardings.
 
     Returns ``(place, step)``: ``place(ts)`` device_puts a freshly-initialized
@@ -289,7 +306,7 @@ def make_parallel_step(agent, mesh: Mesh, *, data_axis: str = "dp",
             cache["sh"], cache["fn"] = jit_parallel_step(
                 agent, mesh, ts, data_axis=data_axis,
                 param_rules=param_rules, megachunk_factor=megachunk_factor,
-                constrain=constrain, donate=donate)
+                constrain=constrain, donate=donate, cost_hook=cost_hook)
         return cache
 
     def place(ts: TrainState) -> TrainState:

@@ -289,6 +289,7 @@ class Orchestrator:
         self._build_step()
         self._eval_fn = None   # env/model changed: retrace on next evaluate
         template = self.agent.init(jax.random.PRNGKey(self.cfg.seed))
+        self._capture_roofline_fallback(template)
         if resume:
             state, step, saved_meta = self._restore_for_resume(template)
             horizon = self.env.num_steps
@@ -379,6 +380,26 @@ class Orchestrator:
     def _build_step(self) -> None:
         factor = self.cfg.runtime.megachunk_factor
         self._mega_fn = None
+        # Roofline capture (obs.roofline): seed the analytic FLOP model for
+        # the cross-check, and hand the compile-time capture hook to the
+        # program constructors. All of this runs at BUILD time — the
+        # capture itself is one extra AOT lowering per program, and the
+        # run-time gauge math rides the pipeline consumer (_host_process).
+        roofline = (self.obs.roofline if self._step_override is None
+                    else None)
+        if roofline is not None:
+            roofline.steps_per_chunk = self.cfg.runtime.chunk_steps
+            try:
+                from sharetrade_tpu.utils.flops import (
+                    train_flops_per_agent_step)
+                roofline.analytic_flops_per_chunk = (
+                    train_flops_per_agent_step(self.cfg, self.env.obs_dim)
+                    * self.cfg.parallel.num_workers
+                    * self.cfg.runtime.chunk_steps)
+            except Exception:   # no analytic model: capture still runs
+                log.exception("analytic FLOP model unavailable; roofline "
+                              "cross-check disabled")
+        cost_hook = roofline.capture if roofline is not None else None
         # Async-pipeline donation carve-out, CPU runtime only: the pipeline
         # consumer's device_get runs CONCURRENTLY with the dispatcher's
         # donating dispatch, and on the CPU runtime that combination
@@ -417,7 +438,8 @@ class Orchestrator:
             donate = not (async_on and is_cpu_mesh(self.mesh))
             self._place, self._step_fn = make_parallel_step(
                 self.agent, self.mesh, data_axis=self.cfg.parallel.data_axis,
-                param_rules=rules, constrain=constrain, donate=donate)
+                param_rules=rules, constrain=constrain, donate=donate,
+                cost_hook=cost_hook)
             if factor > 1:
                 # The K-chunk scan composes INSIDE the pjit boundary (one
                 # partitioned program), so ICI collectives stay fused across
@@ -427,7 +449,8 @@ class Orchestrator:
                     self.agent, self.mesh,
                     data_axis=self.cfg.parallel.data_axis,
                     param_rules=rules, megachunk_factor=factor,
-                    constrain=constrain, donate=donate)
+                    constrain=constrain, donate=donate,
+                    cost_hook=cost_hook)
         else:
             self._place = lambda ts: ts
             # Donated input, matching the mesh path: the previous chunk's
@@ -461,6 +484,24 @@ class Orchestrator:
                 # donation, where HBM double-buffering actually matters.
                 self._mega_fn = jax.jit(
                     megachunk_step(self.agent.step, factor))
+
+    def _capture_roofline_fallback(self, template: TrainState) -> None:
+        """Compile-time roofline capture for the MESHLESS build paths —
+        the mesh path captures through ``jit_parallel_step``'s
+        ``cost_hook`` (parallel/sharding.py), but the CPU-fallback
+        programs are plain ``jax.jit`` wrappers built in
+        :meth:`_build_step`, so their costs are recorded here, against
+        the same template the first dispatch will see. Build-time only;
+        a capture failure is swallowed inside RooflineCapture."""
+        roofline = self.obs.roofline
+        if (roofline is None or self.mesh is not None
+                or self._step_override is not None):
+            return
+        roofline.capture(self._step_fn, (template,), megachunk_factor=1)
+        if self._mega_fn is not None:
+            roofline.capture(
+                self._mega_fn, (template,),
+                megachunk_factor=self.cfg.runtime.megachunk_factor)
 
     # ------------------------------------------------------------------
     # protocol: StartTraining (TrainerRouterActor.scala:86-88)
@@ -1041,6 +1082,13 @@ class Orchestrator:
                     self.metrics.record_many(row)
             metrics = rows[-1]
             metrics.update(self._timer.tick(b.chunks_covered))
+            if obs.roofline is not None:
+                # Live roofline gauges (mfu / achieved_tflops / hbm_gbps):
+                # static compiled costs divided by the sampled per-chunk
+                # wall time — consumer-thread math on already-host values,
+                # never a device sync, never the dispatcher.
+                obs.roofline.on_boundary(
+                    k=b.k, chunk_seconds=metrics.get("chunk_seconds"))
             with self._snapshot_lock:
                 self._snapshot = metrics
             self.metrics.record_many(metrics)

@@ -36,6 +36,17 @@ _PEAK_BY_KIND = (
 )
 _DEFAULT_PEAK = 197e12
 
+# device_kind substrings -> HBM bandwidth bytes/s per chip — the other
+# roofline axis (obs/roofline.py): achieved HBM GB/s and the ridge point
+# peak_flops / peak_bw that splits compute-bound from memory-bound.
+_HBM_BW_BY_KIND = (
+    ("v6 lite", 1640e9),   # Trillium
+    ("v5p", 2765e9),
+    ("v5 lite", 819e9),    # v5e
+    ("v4", 1228e9),
+)
+_DEFAULT_HBM_BW = 819e9
+
 
 def chip_peak_flops(device=None) -> float:
     """Dense bf16 peak for the attached chip (fallback: v5e)."""
@@ -46,6 +57,20 @@ def chip_peak_flops(device=None) -> float:
         if sub in kind:
             return peak
     return _DEFAULT_PEAK
+
+
+def chip_peak_hbm_bw(device=None) -> float:
+    """Peak HBM bytes/s for the attached chip (fallback: v5e). On the CPU
+    backend this — like :func:`chip_peak_flops` — reports the v5e default,
+    so CPU-measured MFU/roofline rows are comparable placeholders for the
+    TPU numbers that slot in later (the BASELINE.md convention)."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, bw in _HBM_BW_BY_KIND:
+        if sub in kind:
+            return bw
+    return _DEFAULT_HBM_BW
 
 
 def forward_flops_per_obs(model: ModelConfig, obs_dim: int,

@@ -66,6 +66,18 @@ in tests/test_megachunk.py:
    ``_write_checkpoint_dir``) — unless the replace line carries
    ``replace-fsync-ok`` naming why durability is not needed there (e.g.
    quarantining bytes that are already known-corrupt).
+
+6. **Roofline capture stays at compile time** (the roofline PR's guard) —
+   ``cost_analysis()`` / ``memory_analysis()`` / ``RooflineCapture
+   .capture()`` AOT-lower and compile a program, seconds of work that
+   must happen ONCE at build time (the ``cost_hook`` seam in
+   ``parallel/sharding.py``, the orchestrator's fallback capture), never
+   per chunk. FAILS when such a call site appears in the dispatcher
+   section (``_run_supervised``/``_boundary_actions``) or inside a
+   nested (traced) function of the device packages — the run-time half
+   of the roofline (gauge math on already-captured static costs) rides
+   the pipeline consumer and never needs these calls. Escape hatch:
+   ``roofline-capture-ok`` naming why a capture is intentionally there.
 """
 
 from __future__ import annotations
@@ -128,6 +140,14 @@ FSYNC_EVIDENCE_CALLS = {
 #: fsync (must name why — e.g. the payload is already known-corrupt).
 REPLACE_MARKER = "replace-fsync-ok"
 
+#: Compile-time-only roofline capture calls (check 6): each one lowers and
+#: compiles a whole program — never a per-chunk cost, never traced-code
+#: behavior. ``.capture(`` is matched as the RooflineCapture entry point.
+ROOFLINE_PATTERN = re.compile(
+    r"cost_analysis\(|memory_analysis\(|compiled_costs\(|\.capture\(")
+#: Escape hatch for an intentional capture site in guarded code.
+ROOFLINE_MARKER = "roofline-capture-ok"
+
 
 def lint_parallel_device_put() -> list[tuple[str, int, str]]:
     """Flag ``device_put`` calls without an explicit sharding inside
@@ -154,49 +174,79 @@ def lint_parallel_device_put() -> list[tuple[str, int, str]]:
     return bad
 
 
-def lint_hot_loop_syncs() -> tuple[list[tuple[str, int, str]], set[str]]:
+def _scan_named_funcs(names, pattern, marker, *, also_find=()
+                      ) -> tuple[list[tuple[str, int, str]], set[str]]:
+    """Shared traversal for the orchestrator-section checks: pattern hits
+    on non-comment lines inside the named functions of TARGET (comment-
+    only lines can't dispatch anything, so prose ABOUT device_get never
+    trips a check). Returns (hits, found-function-names over ``names`` +
+    ``also_find`` — existence checks ride the same walk)."""
     src = TARGET.read_text()
     lines = src.splitlines()
     bad: list[tuple[str, int, str]] = []
     found: set[str] = set()
+    watch = set(names) | set(also_find)
     for node in ast.walk(ast.parse(src)):
-        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and node.name in HOT_FUNCS):
-            found.add(node.name)
-            for ln in range(node.lineno, node.end_lineno + 1):
-                text = lines[ln - 1]
-                # Comment-only lines can't dispatch a sync; skip them so
-                # prose ABOUT device_get doesn't trip the lint.
-                if text.lstrip().startswith("#"):
-                    continue
-                if PATTERN.search(text) and MARKER not in text:
-                    bad.append((node.name, ln, text.strip()))
+        if (not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                or node.name not in watch):
+            continue
+        found.add(node.name)
+        if node.name not in names:
+            continue
+        for ln in range(node.lineno, node.end_lineno + 1):
+            text = lines[ln - 1]
+            if text.lstrip().startswith("#"):
+                continue
+            if pattern.search(text) and marker not in text:
+                bad.append((node.name, ln, text.strip()))
     return bad, found
+
+
+def _scan_nested_funcs(pattern, marker) -> list[tuple[str, int, str, str]]:
+    """Shared traversal for the traced-closure checks: pattern hits on
+    non-comment lines inside NESTED functions of the device packages (the
+    closures handed to jit/scan); returns (relpath, line, function, text)
+    hits."""
+    root = TARGET.parent.parent     # sharetrade_tpu/
+    bad: list[tuple[str, int, str, str]] = []
+    for pkg in DEVICE_PACKAGES:
+        for path in sorted((root / pkg).glob("*.py")):
+            src = path.read_text()
+            lines = src.splitlines()
+            seen: set[tuple[int, int]] = set()
+            for node in ast.walk(ast.parse(src)):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for child in ast.walk(node):
+                    if (child is node
+                            or not isinstance(child, (ast.FunctionDef,
+                                                      ast.AsyncFunctionDef))):
+                        continue
+                    span = (child.lineno, child.end_lineno)
+                    if span in seen:
+                        continue
+                    seen.add(span)
+                    for ln in range(child.lineno, child.end_lineno + 1):
+                        text = lines[ln - 1]
+                        if text.lstrip().startswith("#"):
+                            continue
+                        if pattern.search(text) and marker not in text:
+                            bad.append((f"{pkg}/{path.name}", ln,
+                                        child.name, text.strip()))
+    return bad
+
+
+def lint_hot_loop_syncs() -> tuple[list[tuple[str, int, str]], set[str]]:
+    return _scan_named_funcs(HOT_FUNCS, PATTERN, MARKER)
 
 
 def lint_dispatcher_blocking() -> tuple[list[tuple[str, int, str]], set[str]]:
     """Check 4: no unmarked blocking host calls in the dispatcher section;
     the consumer-side functions must still exist. Returns (hits, found
     function names over DISPATCHER_FUNCS + CONSUMER_FUNCS)."""
-    src = TARGET.read_text()
-    lines = src.splitlines()
-    bad: list[tuple[str, int, str]] = []
-    found: set[str] = set()
-    for node in ast.walk(ast.parse(src)):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if node.name in CONSUMER_FUNCS:
-            found.add(node.name)
-        if node.name not in DISPATCHER_FUNCS:
-            continue
-        found.add(node.name)
-        for ln in range(node.lineno, node.end_lineno + 1):
-            text = lines[ln - 1]
-            if text.lstrip().startswith("#"):
-                continue
-            if DISPATCH_BLOCK_PATTERN.search(text) and MARKER not in text:
-                bad.append((node.name, ln, text.strip()))
-    return bad, found
+    return _scan_named_funcs(DISPATCHER_FUNCS, DISPATCH_BLOCK_PATTERN,
+                             MARKER, also_find=CONSUMER_FUNCS)
 
 
 def lint_durable_replace() -> list[tuple[str, int, str, str]]:
@@ -239,37 +289,21 @@ def lint_durable_replace() -> list[tuple[str, int, str, str]]:
     return bad
 
 
+def lint_roofline_capture() -> list[tuple[str, int, str, str]]:
+    """Check 6: no compiled-cost capture (cost_analysis / memory_analysis /
+    RooflineCapture.capture) in the dispatcher section or inside nested
+    (traced) device-package functions; returns (where, line, function,
+    text) hits."""
+    disp, _ = _scan_named_funcs(DISPATCHER_FUNCS, ROOFLINE_PATTERN,
+                                ROOFLINE_MARKER)
+    return ([(TARGET.name, ln, fn, text) for fn, ln, text in disp]
+            + _scan_nested_funcs(ROOFLINE_PATTERN, ROOFLINE_MARKER))
+
+
 def lint_device_host_calls() -> list[tuple[str, int, str, str]]:
     """Flag time/log/print host calls inside nested (= traced) functions of
     the device packages; returns (relpath, line, function, text) hits."""
-    root = TARGET.parent.parent     # sharetrade_tpu/
-    bad: list[tuple[str, int, str, str]] = []
-    for pkg in DEVICE_PACKAGES:
-        for path in sorted((root / pkg).glob("*.py")):
-            src = path.read_text()
-            lines = src.splitlines()
-            seen: set[tuple[int, int]] = set()
-            for node in ast.walk(ast.parse(src)):
-                if not isinstance(node, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef)):
-                    continue
-                for child in ast.walk(node):
-                    if (child is node
-                            or not isinstance(child, (ast.FunctionDef,
-                                                      ast.AsyncFunctionDef))):
-                        continue
-                    span = (child.lineno, child.end_lineno)
-                    if span in seen:
-                        continue
-                    seen.add(span)
-                    for ln in range(child.lineno, child.end_lineno + 1):
-                        text = lines[ln - 1]
-                        if text.lstrip().startswith("#"):
-                            continue
-                        if JIT_PATTERN.search(text) and JIT_MARKER not in text:
-                            bad.append((f"{pkg}/{path.name}", ln,
-                                        child.name, text.strip()))
-    return bad
+    return _scan_nested_funcs(JIT_PATTERN, JIT_MARKER)
 
 
 def main() -> int:
@@ -326,6 +360,17 @@ def main() -> int:
               "readback consumer (_host_process), or tag the line "
               f"'# {MARKER}: <why this blocks the dispatcher on purpose>'")
         return 1
+    roof_bad = lint_roofline_capture()
+    if roof_bad:
+        print("roofline compile-time capture lint FAILED:")
+        for rel, ln, fn, text in roof_bad:
+            print(f"  {rel}:{ln} (in {fn}): {text}")
+        print("cost_analysis/memory_analysis/RooflineCapture.capture lower "
+              "and compile a whole program — compile-time-only work that "
+              "must never ride the dispatcher or a traced step body; move "
+              "it to the build path (jit_parallel_step cost_hook), or tag "
+              f"the line '# {ROOFLINE_MARKER}: <why capture here>'")
+        return 1
     dur_bad = lint_durable_replace()
     if dur_bad:
         print("durable-rename fsync lint FAILED:")
@@ -342,6 +387,7 @@ def main() -> int:
           f"device-code host-call lint OK ({', '.join(DEVICE_PACKAGES)}); "
           f"dispatcher blocking-call lint OK "
           f"({', '.join(DISPATCHER_FUNCS)}); "
+          f"roofline capture lint OK; "
           f"durable-rename fsync lint OK ({', '.join(DURABLE_WRITE_FILES)})")
     return 0
 

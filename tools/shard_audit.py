@@ -24,6 +24,17 @@ What the gate certifies (the anti-resharding tentpole, round 8):
 3. **Memory report.** ``compiled.memory_analysis()`` (arguments / temps /
    output bytes) per config, recorded in the report for BASELINE.md's
    "Multichip resharding" table.
+4. **Roofline rows (the obs/roofline PR).** Per-config FLOPs and HBM
+   bytes of the compiled megachunk program — ``cost_analysis()`` FLOPs /
+   bytes-accessed (raw HLO counts: loop bodies counted once, so the
+   numbers are compile-deterministic identities, not per-dispatch work —
+   obs/roofline.py owns the trip-count-corrected runtime view) plus the
+   ``memory_analysis()`` peak footprint — gated against manifest ceilings
+   exactly like the collective counts: an unexplained FLOP or HBM growth
+   fails the audit under the manifest's jax version, warns under any
+   other, and ``--update`` re-measures. This is the ROADMAP item-4 gate:
+   MFU regressions caused by program-cost changes trip here at compile
+   time, before a single benchmark runs.
 
 The compiled program is built by ``parallel.sharding.jit_parallel_step`` —
 the SAME constructor the orchestrator dispatches through — so the audit
@@ -215,6 +226,23 @@ def run_child(spec: dict) -> None:
             }
         except Exception:            # backend without the analysis: report-only
             result["memory"] = None
+        # Roofline row: HLO cost analysis (FLOPs / bytes accessed, loop
+        # bodies counted once — a deterministic program identity under a
+        # fixed jax version) plus the memory footprint as the HBM-bytes-
+        # per-megachunk number. Quirk handling (list-vs-dict returns,
+        # -1 = unavailable) lives in ONE place: obs/roofline.py
+        # compiled_costs, the same reader the live telemetry uses. None
+        # where a backend lacks the counter; the parent's ceiling gate
+        # skips None on either side.
+        from sharetrade_tpu.obs.roofline import compiled_costs
+        costs = compiled_costs(compiled)
+        cost: dict | None = {
+            "flops": costs["flops"],
+            "bytes_accessed": costs["bytes_accessed"],
+        }
+        if result["memory"] is not None:
+            cost["hbm_peak_bytes"] = sum(result["memory"].values())
+        result["cost"] = cost
     except AttributeError as exc:
         # Missing jax API on an old toolchain (the parallel layer targets
         # current jax; compat.py covers shard_map, anything else lands
@@ -321,19 +349,52 @@ def run_audit(update: bool = False, as_json: bool = False) -> int:
                                f" (measured under jax "
                                f"{manifest.get('jax_version')}, running "
                                f"{child_jax}: count gate downgraded)"))
+        # Roofline ceilings (FLOPs / HLO bytes accessed / HBM footprint):
+        # the same contract as the collective counts — exceeding the
+        # manifest under its own jax version fails, under a different
+        # version warns, and --update re-measures. A key missing on
+        # either side (older manifest, backend without the counter)
+        # gates nothing.
+        want_cost = want.get("cost") or {}
+        got_cost = res.get("cost") or {}
+        for key, unit in (("flops", "FLOPs"),
+                          ("bytes_accessed", "HLO bytes accessed"),
+                          ("hbm_peak_bytes", "HBM footprint bytes")):
+            ceiling = want_cost.get(key)
+            got = got_cost.get(key)
+            if ceiling is None or got is None:
+                continue
+            if got > ceiling * (1 + 1e-9):
+                msg = (f"{name}: {unit} {got:.6g} exceeds manifest "
+                       f"ceiling {ceiling:.6g}")
+                if same_jax and not update:
+                    failures.append(msg)
+                else:
+                    warnings.append(
+                        msg + ("" if same_jax else
+                               f" (measured under jax "
+                               f"{manifest.get('jax_version')}, running "
+                               f"{child_jax}: roofline gate downgraded)"))
 
     if update:
         manifest = {
             "jax_version": child_jax,
-            "note": ("Collective-count ceilings per audit config, measured "
-                     "on the forced-8-device host platform. Regenerate with "
+            "note": ("Collective-count and roofline (FLOPs / HLO bytes "
+                     "accessed / HBM footprint) ceilings per audit config, "
+                     "measured on the forced-8-device host platform. "
+                     "Roofline numbers are raw HLO cost_analysis counts "
+                     "(loop bodies counted once) — compile-deterministic "
+                     "identities of the program, gated as ceilings; "
+                     "obs/roofline.py owns the trip-count-corrected "
+                     "per-dispatch view. Regenerate with "
                      "`python tools/shard_audit.py --update` after an "
-                     "intentional collective-count change or a jax upgrade."),
+                     "intentional program-cost change or a jax upgrade."),
             "configs": {
                 res["name"]: {
                     "collectives": res["collectives"],
                     "collective_bytes": res["collective_bytes"],
                     "memory": res.get("memory"),
+                    "cost": res.get("cost"),
                 }
                 for res in results if res.get("ok")
             },
@@ -354,10 +415,13 @@ def run_audit(update: bool = False, as_json: bool = False) -> int:
         for res in results:
             if res.get("ok"):
                 mem = res.get("memory") or {}
+                cost = res.get("cost") or {}
                 print(f"  {res['name']}: remat={res['involuntary_remat']} "
                       f"collectives={res['collectives']} "
                       f"bytes={res['collective_bytes']} "
-                      f"temps={mem.get('temps')}")
+                      f"temps={mem.get('temps')} "
+                      f"flops={cost.get('flops')} "
+                      f"hbm={cost.get('hbm_peak_bytes')}")
             else:
                 print(f"  {res['name']}: "
                       + ("SKIPPED" if res.get("skipped") else "FAILED")
