@@ -8,7 +8,7 @@
 
 PYTHON ?= python
 
-.PHONY: check test slow native bench bench-async bench-ckpt bench-dispatch bench-obs bench-reshard bench-roofline crash-soak obs-demo lint perf-gate shard-audit clean
+.PHONY: check test slow native bench bench-async bench-ckpt bench-dispatch bench-obs bench-precision bench-reshard bench-roofline crash-soak obs-demo lint perf-gate shard-audit clean
 
 check: native lint
 	$(PYTHON) -m pytest tests/ -q -m "not slow" -x
@@ -87,9 +87,18 @@ bench-roofline:
 	$(PYTHON) -c "import json, bench; \
 	print(json.dumps(bench.bench_roofline(), indent=2))"
 
+# Precision-policy A/B (precision.mode fp32 vs bf16_mixed): reference-MLP
+# steps/s + static costs, flagship episode-PPO compile-only static bytes —
+# the measured state-bytes reduction behind bf16_mixed, recorded in
+# BASELINE.md "Precision". Runnable on CPU in ~a minute (CPU-framed: bf16
+# compute is f32-emulated there; see the bench row's note).
+bench-precision:
+	$(PYTHON) -c "import json, bench; \
+	print(json.dumps(bench.bench_precision(), indent=2))"
+
 # Perf-regression gate (also part of check): the newest BENCH_*.json row
-# per (metric, backend) series must sit within the tolerance band of the
-# prior best — steps/s and MFU both gate (tools/perf_gate.py).
+# per (metric, backend, precision) series must sit within the tolerance
+# band of the prior best — steps/s and MFU both gate (tools/perf_gate.py).
 perf-gate:
 	$(PYTHON) tools/perf_gate.py
 

@@ -102,6 +102,10 @@ def _result_envelope(cfg: FrameworkConfig | None = None) -> dict:
     }
     if cfg is not None:
         env["config_hash"] = _config_hash(cfg)
+        # Precision joins the perf-gate series key (metric, backend,
+        # precision): a bf16_mixed row must never gate against fp32
+        # history — different compute tier, different roofline.
+        env["precision"] = cfg.precision.mode
     return env
 
 
@@ -157,6 +161,7 @@ def bench_episode_config(config_name: str, metric: str, *,
         "vs_baseline": round(rate / REFERENCE_CEILING_STEPS_PER_S, 2),
         "mfu": round(mfu(rate, cfg, env_params.window + 2), 6),
         "config_hash": _config_hash(cfg),
+        "precision": cfg.precision.mode,
     }
 
 
@@ -234,6 +239,7 @@ def bench_reference_shape() -> dict:
         # be launch-bound; benchmarks/run_all.py carries saturating configs.
         "mfu": round(mfu(rate, cfg, env_params.window + 2), 6),
         "config_hash": _config_hash(cfg),
+        "precision": cfg.precision.mode,
     }
 
 
@@ -661,6 +667,141 @@ def bench_roofline(k: int = 8, *, chunks: int = 48, trials: int = 2) -> dict:
     return out
 
 
+def bench_precision(*, timed_chunks: int = 4, trials: int = 2,
+                    flagship_series: int = 2048) -> dict:
+    """Precision-policy A/B (``precision.mode`` fp32 vs bf16_mixed): the
+    ROADMAP item-4 bytes lever, measured.
+
+    Two workloads, mirroring the policy's target regimes:
+
+    - **reference MLP** (the qlearn reference shape): timed steps/s + MFU
+      per mode, plus the compiled chunk program's static costs.
+    - **flagship episode-PPO** (``ppo_tr_episode_b512_u1024_bf16``, the
+      BASELINE.md headline config, on a shortened series so the compile
+      fits a bench run): COMPILE-ONLY static costs per mode — the
+      flagship chunk is minutes of CPU wall time, and the bytes claim is
+      a compile-time identity, not a timing.
+
+    Static costs come from the same reader as the roofline telemetry
+    (obs/roofline.py ``compiled_costs``): HLO FLOPs / bytes-accessed plus
+    the ``memory_analysis`` argument/temp/output split. Headline:
+    ``state_bytes`` (arguments + outputs — the TrainState/carry/rollout
+    buffers every megachunk streams between HBM and the program) and its
+    reduction under bf16_mixed.
+
+    CPU-framing caveat (recorded with the numbers, BASELINE.md
+    "Precision"): the CPU backend EMULATES most bf16 arithmetic by
+    upcasting to f32, so CPU-lowered ``temp_bytes``/``bytes_accessed``
+    (and steps/s) do not show the compute-side savings a TPU compile
+    gets — state_bytes is lowering-invariant (program I/O), which is why
+    it carries the CPU-framed claim; the TPU MFU run is the recorded
+    follow-up (ROADMAP infra note: tunnel down since BENCH_r04)."""
+    from benchmarks.run_all import make_configs
+    from sharetrade_tpu.obs.roofline import compiled_costs
+
+    def static_costs(compiled) -> dict:
+        costs = compiled_costs(compiled)
+        args = costs["argument_bytes"]
+        out = {
+            "flops_hlo": costs["flops"],
+            "bytes_accessed_hlo": costs["bytes_accessed"],
+            "argument_bytes": args,
+            "temp_bytes": costs["temp_bytes"],
+            "output_bytes": costs["output_bytes"],
+        }
+        if args is not None:
+            out["state_bytes"] = args + (costs["output_bytes"] or 0)
+            out["hbm_peak_bytes"] = (args + (costs["temp_bytes"] or 0)
+                                     + (costs["output_bytes"] or 0))
+        return out
+
+    def reduction(rows: dict, key: str) -> float | None:
+        a = (rows.get("fp32") or {}).get(key)
+        b = (rows.get("bf16_mixed") or {}).get(key)
+        if not a or b is None:
+            return None
+        return round(100.0 * (1.0 - b / a), 2)
+
+    out: dict = {"metric": "precision_ab", "modes": ["fp32", "bf16_mixed"]}
+
+    # ---- reference MLP: timed + static -------------------------------
+    ref_rows: dict = {}
+    built = {}
+    for mode in ("fp32", "bf16_mixed"):
+        cfg = FrameworkConfig()
+        cfg.learner.algo = "qlearn"
+        cfg.parallel.num_workers = 10      # reference noOfChildren
+        cfg.runtime.chunk_steps = 50
+        cfg.precision.mode = mode
+        length = (cfg.env.window
+                  + (1 + timed_chunks) * cfg.runtime.chunk_steps + 8)
+        series = synthetic_price_series(length=length)
+        env_params = trading.env_from_prices(
+            series.prices, window=cfg.env.window,
+            initial_budget=cfg.env.initial_budget)
+        agent = build_agent(cfg, env_params)
+        step = jax.jit(agent.step)
+        ts = agent.init(jax.random.PRNGKey(0))
+        compiled = step.lower(ts).compile()
+        ts, _ = step(ts)                   # warm chunk
+        jax.block_until_ready(ts.params)
+        built[mode] = (cfg, env_params, agent, step)
+        ref_rows[mode] = static_costs(compiled)
+    # Interleaved best-of-N timing (the bench_dispatch_floor lesson).
+    best: dict[str, float] = {}
+    for _ in range(max(1, trials)):
+        for mode, (cfg, env_params, agent, step) in built.items():
+            ts = agent.init(jax.random.PRNGKey(1))
+            t0 = time.perf_counter()
+            for _ in range(timed_chunks):
+                ts, _ = step(ts)
+            jax.block_until_ready(ts.params)
+            best[mode] = min(best.get(mode, float("inf")),
+                             time.perf_counter() - t0)
+    for mode, (cfg, env_params, agent, step) in built.items():
+        rate = (timed_chunks * cfg.runtime.chunk_steps
+                * cfg.parallel.num_workers) / best[mode]
+        ref_rows[mode]["agent_steps_per_sec"] = round(rate, 2)
+        ref_rows[mode]["mfu"] = round(
+            mfu(rate, cfg, env_params.window + 2), 6)
+    ref_rows["state_bytes_reduction_pct"] = reduction(
+        ref_rows, "state_bytes")
+    ref_rows["steps_ratio_bf16_vs_fp32"] = round(
+        ref_rows["bf16_mixed"]["agent_steps_per_sec"]
+        / ref_rows["fp32"]["agent_steps_per_sec"], 3)
+    out["reference_mlp"] = ref_rows
+
+    # ---- flagship episode-PPO: compile-only static -------------------
+    flag_rows: dict = {}
+    flagship = make_configs()["ppo_tr_episode_b512_u1024_bf16"]
+    for mode in ("fp32", "bf16_mixed"):
+        cfg = FrameworkConfig.from_dict(flagship.to_dict())
+        cfg.precision.mode = mode
+        series = synthetic_price_series(length=flagship_series)
+        env_params = trading.env_from_prices(
+            series.prices, window=cfg.env.window,
+            initial_budget=cfg.env.initial_budget)
+        agent = build_agent(cfg, env_params)
+        ts = agent.init(jax.random.PRNGKey(0))
+        t0 = time.perf_counter()
+        compiled = jax.jit(agent.step).lower(ts).compile()
+        row = static_costs(compiled)
+        row["compile_s"] = round(time.perf_counter() - t0, 2)
+        flag_rows[mode] = row
+    flag_rows["config"] = "b512_u1024 episode-PPO (shortened series)"
+    flag_rows["state_bytes_reduction_pct"] = reduction(
+        flag_rows, "state_bytes")
+    flag_rows["hbm_peak_reduction_pct"] = reduction(
+        flag_rows, "hbm_peak_bytes")
+    out["flagship_episode_ppo"] = flag_rows
+    out["note"] = ("CPU backend emulates bf16 compute in f32: temp/"
+                   "bytes_accessed/steps columns understate (or invert) "
+                   "the TPU savings; state_bytes is the lowering-"
+                   "invariant program-I/O claim. TPU rows are the "
+                   "recorded follow-up (tunnel down).")
+    return out
+
+
 def bench_ckpt_fsync(saves: int = 20) -> dict:
     """Durability cost of ``checkpoint.fsync`` (default on): wall time of
     ``CheckpointManager.save`` with the fsync barrier on vs off, at two
@@ -931,13 +1072,15 @@ def _await_devices(attempts: int = 3, timeout_s: float = 180.0,
                  "r.update(bench._result_envelope()); "
                  "r['dispatch_floor'] = bench.bench_dispatch_floor(); "
                  "r['roofline'] = bench.bench_roofline(); "
+                 "r['precision'] = bench.bench_precision(); "
                  "print(json.dumps(r))"],
                 env=scrub, cwd=repo,
-                # Sized for BOTH fallback workloads (reference_shape plus the
-                # dispatch_floor ladder, ~25 s each here) with ~3x headroom
-                # for a slower host — a timeout loses the round's only bench
-                # evidence during a TPU outage.
-                timeout=600, capture_output=True, check=True)
+                # Sized for the fallback workloads (reference_shape, the
+                # dispatch_floor ladder, roofline, and the precision A/B's
+                # two flagship compiles) with ~3x headroom for a slower
+                # host — a timeout loses the round's only bench evidence
+                # during a TPU outage.
+                timeout=900, capture_output=True, check=True)
             fallback = json.loads(out.stdout.decode().strip().splitlines()[-1])
             fallback["backend"] = "cpu"
             fallback["note"] = ("TPU unreachable; CPU-backend fallback of "
@@ -987,6 +1130,7 @@ def main() -> None:
     result["async_pipeline"] = bench_async_pipeline()
     result["ckpt_fsync"] = bench_ckpt_fsync()
     result["roofline"] = bench_roofline()
+    result["precision"] = bench_precision()
     print(json.dumps(result), flush=True)
 
 

@@ -41,6 +41,7 @@ def bench_config(name: str, cfg: FrameworkConfig, *, chunks: int) -> dict:
         needed = int(_np.prod(list(cfg.parallel.mesh_shape.values())))
         if needed > jax.device_count():
             return {"metric": f"{name}_agent_steps_per_sec_per_chip",
+                    "precision": cfg.precision.mode,
                     "skipped": f"needs {needed} devices, have "
                                f"{jax.device_count()}"}
         mesh = build_mesh(cfg.parallel)
@@ -90,6 +91,10 @@ def bench_config(name: str, cfg: FrameworkConfig, *, chunks: int) -> dict:
         "mfu": round(mfu(rate, cfg, obs_dim), 6),
         "model_gflops_per_agent_step": round(
             train_flops_per_agent_step(cfg, obs_dim) / 1e9, 6),
+        # Joins the perf-gate's (metric, backend, precision) series key:
+        # the *_bf16 configs' bf16_mixed rows must fork from their
+        # whole-model-cast history, not gate against it.
+        "precision": cfg.precision.mode,
     }
 
 
@@ -129,14 +134,14 @@ def make_configs() -> dict[str, FrameworkConfig]:
             learner__algo="ppo", model__kind="transformer",
             learner__unroll_len=32, runtime__chunk_steps=32,
             model__num_layers=2, model__num_heads=2, model__head_dim=128,
-            model__dtype="bfloat16"),
+            precision__mode="bf16_mixed"),
         "ppo_transformer_b1024_bf16": base(
             learner__algo="ppo", model__kind="transformer",
             parallel__num_workers=1024,
             learner__unroll_len=32, runtime__chunk_steps=32,
             learner__remat=True,
             model__num_layers=2, model__num_heads=2, model__head_dim=128,
-            model__dtype="bfloat16"),
+            precision__mode="bf16_mixed"),
         # Episode-mode transformer (model.seq_mode="episode"): ticks embed
         # once, banded flash attention over the episode's tick stream, one
         # O(T+L*window) replay pass per chunk instead of T window forwards.
@@ -150,7 +155,7 @@ def make_configs() -> dict[str, FrameworkConfig]:
             model__seq_mode="episode", parallel__num_workers=256,
             learner__unroll_len=128, runtime__chunk_steps=128,
             model__num_layers=2, model__num_heads=2, model__head_dim=128,
-            model__dtype="bfloat16"),
+            precision__mode="bf16_mixed"),
         # Longer unrolls amortize the sequential rollout against the one
         # banded replay pass — the episode-mode throughput sweet spot.
         "ppo_tr_episode_b128_u1024_bf16": base(
@@ -158,7 +163,7 @@ def make_configs() -> dict[str, FrameworkConfig]:
             model__seq_mode="episode", parallel__num_workers=128,
             learner__unroll_len=1024, runtime__chunk_steps=1024,
             model__num_layers=2, model__num_heads=2, model__head_dim=128,
-            model__dtype="bfloat16"),
+            precision__mode="bf16_mixed"),
         # Wider agent batch on the precomputed-trunk rollout: the trunk is
         # shared across agents and the sequential loop is elementwise in B,
         # so batch width costs only the replay/update passes.
@@ -167,7 +172,7 @@ def make_configs() -> dict[str, FrameworkConfig]:
             model__seq_mode="episode", parallel__num_workers=512,
             learner__unroll_len=1024, runtime__chunk_steps=1024,
             model__num_layers=2, model__num_heads=2, model__head_dim=128,
-            model__dtype="bfloat16"),
+            precision__mode="bf16_mixed"),
         # Large-model tier: d_model=1024 x 4 layers (~50M params). The MXU
         # leaves the small-matmul regime (this chip sustains ~8-15 TF/s at
         # d=256 vs ~60% of peak at d>=2048), so MFU — not steps/s — is the
@@ -177,7 +182,7 @@ def make_configs() -> dict[str, FrameworkConfig]:
             model__seq_mode="episode", parallel__num_workers=64,
             learner__unroll_len=512, runtime__chunk_steps=512,
             model__num_layers=4, model__num_heads=8, model__head_dim=128,
-            model__dtype="bfloat16"),
+            precision__mode="bf16_mixed"),
         # d1024 with block-granular remat (model.remat_blocks): the MFU
         # experiment row — recomputing block internals in the backward
         # frees residual HBM for wider unrolls/batches; measure against
@@ -187,7 +192,7 @@ def make_configs() -> dict[str, FrameworkConfig]:
             model__seq_mode="episode", parallel__num_workers=64,
             learner__unroll_len=512, runtime__chunk_steps=512,
             model__num_layers=4, model__num_heads=8, model__head_dim=128,
-            model__dtype="bfloat16", model__remat_blocks=True),
+            precision__mode="bf16_mixed", model__remat_blocks=True),
         # The reference's ENTIRE workload as one compiled chunk: 10 workers x
         # the full 5,845-step episode (6,046 prices - 201 window,
         # env/trading.py num_steps), rollout + GAE + clipped updates, with
@@ -198,7 +203,7 @@ def make_configs() -> dict[str, FrameworkConfig]:
             model__seq_mode="episode",
             learner__unroll_len=5845, runtime__chunk_steps=5845,
             model__num_layers=2, model__num_heads=2, model__head_dim=128,
-            model__dtype="bfloat16"),
+            precision__mode="bf16_mixed"),
         # Long-context ceiling: a 32,768-step synthetic episode trained as
         # ONE chunk — the replay is a ~33k-token banded pass through the
         # STREAMING kernels (K/V one block per grid step; VMEM-unbounded).
@@ -208,7 +213,7 @@ def make_configs() -> dict[str, FrameworkConfig]:
             data__synthetic_length=32768 + 201,
             learner__unroll_len=32768, runtime__chunk_steps=32768,
             model__num_layers=2, model__num_heads=2, model__head_dim=128,
-            model__dtype="bfloat16"),
+            precision__mode="bf16_mixed"),
         # Mesh-sharded row (ParallelConfig.mesh_shape): dp-sharded agents,
         # Megatron column/row tp split of the MLP. Skips unless the host
         # exposes 8 devices (v5e-8); capability is CPU-mesh-tested either way.

@@ -23,6 +23,7 @@ from sharetrade_tpu.env import trading
 from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models import build_model
 from sharetrade_tpu.models.core import Model
+from sharetrade_tpu.precision import policy_from_config
 
 _FACTORIES = {
     "qlearn": make_qlearn_agent,
@@ -70,6 +71,12 @@ def build_agent(cfg: FrameworkConfig, env: TradingEnv | trading.EnvParams,
                             num_actions=env.num_actions, mesh=mesh,
                             num_assets=env.num_assets)
     kwargs = {}
+    # Precision policy (precision.py): fp32 = structural identity with the
+    # pre-policy code; bf16_mixed = fp32 masters + bf16 compute copies at
+    # each update boundary + fused f32 updates. Validated here (ConfigError
+    # on unknown modes — construction-time STOP, like every impossible
+    # composition).
+    kwargs["precision"] = policy_from_config(cfg.precision)
     if algo == "dqn" and cfg.learner.journal_replay:
         kwargs["collect_transitions"] = True
     if algo == "ppo":

@@ -10,11 +10,10 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import optax
 
 from sharetrade_tpu.agents.base import (
     Agent, TrainState, batched_carry, batched_reset, build_optimizer,
-    portfolio_metrics,
+    make_update_fn, portfolio_metrics,
 )
 from sharetrade_tpu.agents.rollout import (
     collect_rollout, discounted_returns, normalize_advantages_masked,
@@ -23,12 +22,16 @@ from sharetrade_tpu.agents.rollout import (
 from sharetrade_tpu.config import LearnerConfig
 from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models.core import Model
+from sharetrade_tpu.precision import FP32
 
 
 def make_a2c_agent(model: Model, env: TradingEnv,
                    cfg: LearnerConfig, *, num_agents: int = 10,
-                   steps_per_chunk: int | None = None) -> Agent:
+                   steps_per_chunk: int | None = None,
+                   precision=None) -> Agent:
     optimizer = build_optimizer(cfg)
+    precision = precision or FP32
+    apply_update = make_update_fn(optimizer, cfg, precision)
     unroll = steps_per_chunk or cfg.unroll_len
 
     def init(key: jax.Array) -> TrainState:
@@ -36,14 +39,18 @@ def make_a2c_agent(model: Model, env: TradingEnv,
         params = model.init(k_params)
         return TrainState(
             params=params, opt_state=optimizer.init(params),
-            carry=batched_carry(model, num_agents),
+            carry=precision.cast_carry(
+                batched_carry(model, num_agents), model),
             env_state=batched_reset(env, num_agents),
             rng=k_rng, env_steps=jnp.int32(0), updates=jnp.int32(0),
         )
 
     def step(ts: TrainState):
+        # ONE compute-dtype weight copy per chunk update (precision.py);
+        # the update applies to the fp32 masters. Identity in fp32 mode.
+        params_c = precision.cast_compute(ts.params)
         ts, traj, bootstrap, init_carry = collect_rollout(
-            model, env, ts, unroll, num_agents)
+            model, env, ts, unroll, num_agents, params=params_c)
         returns = discounted_returns(traj.reward, traj.active,
                                      bootstrap, cfg.gamma)
         weight = traj.active
@@ -68,9 +75,8 @@ def make_a2c_agent(model: Model, env: TradingEnv,
             return total, (policy_loss, value_loss, entropy)
 
         (loss, (policy_loss, value_loss, entropy)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(ts.params)
-        updates, opt_state = optimizer.update(grads, ts.opt_state, ts.params)
-        params = optax.apply_updates(ts.params, updates)
+            loss_fn, has_aux=True)(params_c)
+        params, opt_state = apply_update(grads, ts.opt_state, ts.params)
         ts = ts.replace(params=params, opt_state=opt_state,
                         updates=ts.updates + 1)
         metrics = {

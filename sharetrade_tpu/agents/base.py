@@ -116,6 +116,54 @@ def build_optimizer(cfg: LearnerConfig) -> optax.GradientTransformation:
     raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
 
 
+def make_update_fn(optimizer: optax.GradientTransformation,
+                   cfg: LearnerConfig, precision,
+                   *, use_pallas: bool | None = None):
+    """THE optimizer-update seam every learner applies its gradients
+    through: ``update(grads, opt_state, params) -> (params, opt_state)``.
+
+    ``grads`` arrive in whatever dtype the loss backward produced (bf16
+    under the mixed policy — differentiation runs against the compute
+    copy); the seam owns the master-space upcast, so learners never touch
+    a dtype. Two implementations, selected by the precision policy
+    (``precision.use_fused_update``):
+
+    - **optax pair** (fp32 default): literally ``optimizer.update`` +
+      ``optax.apply_updates`` — the pre-policy code path, bit-identical,
+      with the grads routed through ``precision.grads_to_master`` (an
+      object identity in fp32 mode).
+    - **fused** (bf16_mixed default, or ``precision.fused_update='on'``):
+      ``ops/fused_update.fused_apply`` — grad-upcast + moment update +
+      param update in one pass per leaf (Pallas on TPU, one fused XLA
+      elementwise chain elsewhere), optax-exact in fp32 and sharing the
+      optax state structure either way.
+
+    Unsupported optimizers under 'on'/'auto' fall back to the optax pair
+    (fused_supported) rather than failing — the policy is a performance
+    lever, not a capability gate."""
+    from sharetrade_tpu.ops.fused_update import fused_apply, fused_supported
+
+    if precision is not None and precision.use_fused_update \
+            and fused_supported(cfg):
+        name, lr = cfg.optimizer, cfg.learning_rate
+        compute_dtype = precision.compute_dtype
+
+        def update(grads, opt_state, params):
+            return fused_apply(name, lr, grads, opt_state, params,
+                               compute_dtype=compute_dtype,
+                               use_pallas=use_pallas)
+
+        return update
+
+    def update(grads, opt_state, params):
+        if precision is not None:
+            grads = precision.grads_to_master(grads)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt_state
+
+    return update
+
+
 def exploit_probability(step: jax.Array, cfg: LearnerConfig) -> jax.Array:
     """P(exploit) = min(epsilon, step / ramp): fully random at step 0 ramping
     to epsilon-greedy (QDecisionPolicyActor.scala:58: ``Seq(epsilon,

@@ -19,16 +19,17 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import optax
 from flax import struct
 
 from sharetrade_tpu.agents.base import (
     Agent, TrainState, batched_carry, batched_reset, build_optimizer,
-    epsilon_greedy, exploit_probability, portfolio_metrics, quarantine_mask,
+    epsilon_greedy, exploit_probability, make_update_fn, portfolio_metrics,
+    quarantine_mask,
 )
 from sharetrade_tpu.config import LearnerConfig
 from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models.core import Model, apply_batched
+from sharetrade_tpu.precision import FP32
 
 
 @struct.dataclass
@@ -95,11 +96,14 @@ class DQNExtras:
 def make_dqn_agent(model: Model, env: TradingEnv,
                    cfg: LearnerConfig, *, num_agents: int = 10,
                    steps_per_chunk: int = 200,
-                   collect_transitions: bool = False) -> Agent:
+                   collect_transitions: bool = False,
+                   precision=None) -> Agent:
     """``collect_transitions`` makes each chunk additionally return its raw
     transition batch under ``metrics["transitions"]`` so the host can journal
     them (the runtime's ``learner.journal_replay`` switch)."""
     optimizer = build_optimizer(cfg)
+    precision = precision or FP32
+    apply_update = make_update_fn(optimizer, cfg, precision)
     horizon = env.num_steps
     obs_dim = model.obs_dim
 
@@ -108,7 +112,8 @@ def make_dqn_agent(model: Model, env: TradingEnv,
         params = model.init(k_params)
         return TrainState(
             params=params, opt_state=optimizer.init(params),
-            carry=batched_carry(model, num_agents),
+            carry=precision.cast_carry(
+                batched_carry(model, num_agents), model),
             env_state=batched_reset(env, num_agents),
             rng=k_rng, env_steps=jnp.int32(0), updates=jnp.int32(0),
             extras=DQNExtras(
@@ -129,6 +134,11 @@ def make_dqn_agent(model: Model, env: TradingEnv,
     def one_step(ts: TrainState, _):
         rng, k_act, k_sample = jax.random.split(ts.rng, 3)
         act_keys = jax.random.split(k_act, num_agents)
+        # ONE compute-dtype copy per update boundary (precision.py): the
+        # online net AND the target net forwards read compute copies; the
+        # update applies to the fp32 masters. Identity in fp32 mode.
+        params_c = precision.cast_compute(ts.params)
+        target_c = precision.cast_compute(ts.extras.target_params)
 
         # Horizon freeze + poisoned-row quarantine (base.quarantine_mask):
         # a non-finite agent contributes no transitions to the replay buffer
@@ -138,7 +148,7 @@ def make_dqn_agent(model: Model, env: TradingEnv,
         active = (ts.env_state.t < horizon) & healthy
         obs = jnp.where(healthy[:, None], obs_raw, 0.0)
 
-        q_sel = q_batch(ts.params, obs)
+        q_sel = q_batch(params_c, obs)
         actions = jax.vmap(lambda k, q: epsilon_greedy(k, q, ts.env_steps, cfg))(
             act_keys, q_sel)
         stepped, rewards = jax.vmap(env.step)(ts.env_state, actions)
@@ -155,8 +165,7 @@ def make_dqn_agent(model: Model, env: TradingEnv,
         def td_loss(params):
             b_obs, b_act, b_rew, b_next = replay.sample(k_sample, cfg.replay_batch)
             q_s, aux = q_batch_with_aux(params, b_obs)
-            q_next = jax.lax.stop_gradient(
-                q_batch(ts.extras.target_params, b_next))
+            q_next = jax.lax.stop_gradient(q_batch(target_c, b_next))
             target = b_rew + cfg.gamma * jnp.max(q_next, axis=-1)
             predicted = jnp.take_along_axis(q_s, b_act[:, None], axis=-1)[:, 0]
             return (jnp.mean(jnp.square(predicted - target))
@@ -164,9 +173,8 @@ def make_dqn_agent(model: Model, env: TradingEnv,
 
         # Learn only once the buffer can fill a batch.
         ready = replay.size >= cfg.replay_batch
-        loss, grads = jax.value_and_grad(td_loss)(ts.params)
-        updates, opt_state = optimizer.update(grads, ts.opt_state, ts.params)
-        new_params = optax.apply_updates(ts.params, updates)
+        loss, grads = jax.value_and_grad(td_loss)(params_c)
+        new_params, opt_state = apply_update(grads, ts.opt_state, ts.params)
         params = jax.tree.map(lambda new, old: jnp.where(ready, new, old),
                               new_params, ts.params)
         opt_state = jax.tree.map(lambda new, old: jnp.where(ready, new, old),

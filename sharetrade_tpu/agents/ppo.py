@@ -14,11 +14,10 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import optax
 
 from sharetrade_tpu.agents.base import (
     Agent, TrainState, batched_carry, batched_reset, build_optimizer,
-    portfolio_metrics,
+    make_update_fn, portfolio_metrics,
 )
 from sharetrade_tpu.agents.rollout import (
     collect_rollout, gae_advantages, normalize_advantages_masked,
@@ -28,6 +27,7 @@ from sharetrade_tpu.config import LearnerConfig
 from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models.core import Model
 from sharetrade_tpu.parallel.mesh import has_shard_map_axis
+from sharetrade_tpu.precision import FP32
 from sharetrade_tpu.utils.logging import get_logger
 
 
@@ -41,8 +41,11 @@ def _replicated(seam_mesh):
 
 def make_ppo_agent(model: Model, env: TradingEnv,
                    cfg: LearnerConfig, *, num_agents: int = 10,
-                   steps_per_chunk: int | None = None, mesh=None) -> Agent:
+                   steps_per_chunk: int | None = None, mesh=None,
+                   precision=None) -> Agent:
     optimizer = build_optimizer(cfg)
+    precision = precision or FP32
+    apply_update = make_update_fn(optimizer, cfg, precision)
     # The rollout→update replicate seam applies ONLY on meshes with a
     # shard_map-partitioned axis (mesh.has_shard_map_axis): there, the
     # epoch scans' permuted minibatch gathers over dp-sharded rollout
@@ -71,7 +74,8 @@ def make_ppo_agent(model: Model, env: TradingEnv,
         params = model.init(k_params)
         return TrainState(
             params=params, opt_state=optimizer.init(params),
-            carry=batched_carry(model, num_agents),
+            carry=precision.cast_carry(
+                batched_carry(model, num_agents), model),
             env_state=batched_reset(env, num_agents),
             rng=k_rng, env_steps=jnp.int32(0), updates=jnp.int32(0),
         )
@@ -102,8 +106,13 @@ def make_ppo_agent(model: Model, env: TradingEnv,
         return total, (policy_loss, value_loss, entropy)
 
     def step(ts: TrainState):
+        # Rollout forwards read ONE compute-dtype weight copy
+        # (precision.py cast_compute — identity in fp32 mode); each
+        # minibatch update below casts its own fresh copy of the
+        # just-updated masters.
         ts, traj, bootstrap, init_carry = collect_rollout(
-            model, env, ts, unroll, num_agents)
+            model, env, ts, unroll, num_agents,
+            params=precision.cast_compute(ts.params))
         advantages = gae_advantages(traj.reward, traj.value, traj.active,
                                     bootstrap, cfg.gamma, cfg.gae_lambda)
         returns = advantages + traj.value
@@ -146,11 +155,14 @@ def make_ppo_agent(model: Model, env: TradingEnv,
                         lambda x: jax.lax.with_sharding_constraint(
                             x, replicated),
                         (traj_mb, carry_mb, adv_mb, ret_mb))
+                # Differentiate against the compute copy of the CURRENT
+                # masters (re-cast per minibatch — the masters just moved);
+                # the update itself applies in f32 to the masters.
                 (loss, aux), grads = jax.value_and_grad(
                     minibatch_loss, has_aux=True)(
-                    params, traj_mb, carry_mb, adv_mb, ret_mb)
-                updates, opt_state = optimizer.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
+                    precision.cast_compute(params), traj_mb, carry_mb,
+                    adv_mb, ret_mb)
+                params, opt_state = apply_update(grads, opt_state, params)
                 return (params, opt_state), (loss, *aux)
 
             (params, opt_state), losses = jax.lax.scan(

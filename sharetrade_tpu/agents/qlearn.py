@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import optax
 
 from sharetrade_tpu.agents.base import (
     Agent,
@@ -30,27 +29,32 @@ from sharetrade_tpu.agents.base import (
     build_optimizer,
     epsilon_greedy,
     exploit_probability,
+    make_update_fn,
     portfolio_metrics,
     quarantine_mask,
 )
 from sharetrade_tpu.config import LearnerConfig
 from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models.core import Model, apply_batched
+from sharetrade_tpu.precision import FP32
 
 
 def make_qlearn_agent(model: Model, env: TradingEnv,
                       cfg: LearnerConfig, *, num_agents: int = 10,
-                      steps_per_chunk: int = 200) -> Agent:
+                      steps_per_chunk: int = 200, precision=None) -> Agent:
     optimizer = build_optimizer(cfg)
+    precision = precision or FP32
+    apply_update = make_update_fn(optimizer, cfg, precision)
     horizon = env.num_steps
 
     def init(key: jax.Array) -> TrainState:
         k_params, k_rng = jax.random.split(key)
-        params = model.init(k_params)
+        params = model.init(k_params)   # fp32 masters, always
         return TrainState(
             params=params,
             opt_state=optimizer.init(params),
-            carry=batched_carry(model, num_agents),
+            carry=precision.cast_carry(
+                batched_carry(model, num_agents), model),
             env_state=batched_reset(env, num_agents),
             rng=k_rng,
             env_steps=jnp.int32(0),
@@ -67,6 +71,11 @@ def make_qlearn_agent(model: Model, env: TradingEnv,
     def one_step(ts: TrainState, _):
         rng, k_act = jax.random.split(ts.rng)
         act_keys = jax.random.split(k_act, num_agents)
+        # ONE compute-dtype weight copy per update boundary (precision.py):
+        # selection forward, TD replay and backward all read it; the
+        # gradients upcast inside apply_update and the update applies to
+        # the fp32 masters in ts.params. Identity in fp32 mode.
+        params_c = precision.cast_compute(ts.params)
 
         # Freeze agents whose episode is over (chunking may overrun the
         # horizon) AND quarantine poisoned rows (base.quarantine_mask): a
@@ -77,7 +86,7 @@ def make_qlearn_agent(model: Model, env: TradingEnv,
         active = (ts.env_state.t < horizon) & healthy  # (B,) bool
         obs = jnp.where(healthy[:, None], obs_raw, 0.0)
 
-        q_sel, _aux_sel, carry_new = apply_batch(ts.params, obs, ts.carry)
+        q_sel, _aux_sel, carry_new = apply_batch(params_c, obs, ts.carry)
         actions = jax.vmap(lambda k, q: epsilon_greedy(k, q, ts.env_steps, cfg))(
             act_keys, q_sel)
 
@@ -111,10 +120,9 @@ def make_qlearn_agent(model: Model, env: TradingEnv,
             td = jnp.sum(per_agent) / jnp.maximum(jnp.sum(active), 1)
             return td + cfg.aux_loss_coef * aux
 
-        loss, grads = jax.value_and_grad(td_loss)(ts.params)
+        loss, grads = jax.value_and_grad(td_loss)(params_c)
         any_active = jnp.any(active)
-        updates, opt_state = optimizer.update(grads, ts.opt_state, ts.params)
-        new_params = optax.apply_updates(ts.params, updates)
+        new_params, opt_state = apply_update(grads, ts.opt_state, ts.params)
         params = jax.tree.map(
             lambda new, old: jnp.where(any_active, new, old),
             new_params, ts.params)

@@ -45,13 +45,19 @@ def supports_precomputed_trunk(model: Model, env: TradingEnv) -> bool:
 
 
 def collect_rollout(model: Model, env: TradingEnv,
-                    ts: TrainState, unroll_len: int, num_agents: int):
+                    ts: TrainState, unroll_len: int, num_agents: int,
+                    params=None):
     """Roll the policy forward ``unroll_len`` steps.
 
     Returns ``(new_ts, traj, bootstrap_value, init_carry)`` where ``traj``
     stacks :class:`StepData` along a leading time axis, ``bootstrap_value`` is
     V(s_T) for return bootstrapping, and ``init_carry`` is the recurrent state
     the unroll started from (needed to replay the forward pass in losses).
+
+    ``params`` overrides the weights the rollout forwards read — the
+    precision policy's compute copy (precision.py cast_compute); the fp32
+    masters in ``ts.params`` are never mutated here and the returned
+    ``new_ts`` keeps them. None (the fp32 path) reads ``ts.params``.
 
     Models exposing the precomputed-rollout pair (``apply_rollout_trunk`` /
     ``apply_rollout_head``, models/core.py) take the parallel-trunk path:
@@ -62,7 +68,8 @@ def collect_rollout(model: Model, env: TradingEnv,
     # observations by the fast path; they use the generic per-step loop.
     if supports_precomputed_trunk(model, env):
         return _collect_rollout_precomputed(
-            model, env, ts, unroll_len, num_agents)
+            model, env, ts, unroll_len, num_agents, params=params)
+    params = ts.params if params is None else params
     horizon = env.num_steps
     init_carry = ts.carry
 
@@ -79,7 +86,7 @@ def collect_rollout(model: Model, env: TradingEnv,
         healthy = quarantine_mask(obs_raw, env_state)
         active = ((env_state.t < horizon) & healthy).astype(jnp.float32)
         obs = jnp.where(healthy[:, None], obs_raw, 0.0)
-        outs, new_model_carry = apply_batched(model, ts.params, obs, model_carry)
+        outs, new_model_carry = apply_batched(model, params, obs, model_carry)
         actions = jax.vmap(
             lambda k, lg: jax.random.categorical(k, lg))(act_keys, outs.logits)
         actions = actions.astype(jnp.int32)
@@ -106,7 +113,7 @@ def collect_rollout(model: Model, env: TradingEnv,
     final_raw = jax.vmap(env.observe)(env_state)
     final_fine = quarantine_mask(final_raw, env_state)
     final_obs = jnp.where(final_fine[:, None], final_raw, 0.0)
-    final_outs, _ = apply_batched(model, ts.params, final_obs, model_carry)
+    final_outs, _ = apply_batched(model, params, final_obs, model_carry)
     bootstrap = final_outs.value * (
         (env_state.t < horizon) & final_fine).astype(jnp.float32)
 
@@ -152,7 +159,7 @@ def _trunk_precompute(model: Model, env: TradingEnv, params, state1, carry1,
 
 def _collect_rollout_precomputed(model: Model, env: TradingEnv,
                                  ts: TrainState, unroll_len: int,
-                                 num_agents: int):
+                                 num_agents: int, params=None):
     """Rollout with the heavy trunk hoisted OUT of the sequential loop.
 
     The trading env's prices are action-independent (actions move only
@@ -171,6 +178,7 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
     reached; their outputs are masked inactive exactly as the incremental
     path masked its lockstep-advanced carry.
     """
+    params = ts.params if params is None else params
     horizon = env.num_steps
     init_carry = ts.carry
     window = model.obs_dim - 2
@@ -204,7 +212,7 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
     state1 = jax.tree.map(take_rep, ts.env_state)
     carry1 = jax.tree.map(take_rep, ts.carry)
     windows, trade_prices, hn_base, carry1_out = _trunk_precompute(
-        model, env, ts.params, state1, carry1, unroll_len, horizon)
+        model, env, params, state1, carry1, unroll_len, horizon)
     new_model_carry = jax.tree.map(
         lambda x: jnp.broadcast_to(x, (num_agents,) + x.shape[1:]),
         carry1_out)
@@ -224,7 +232,7 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
     # bound once everything else was hoisted (BASELINE.md round 5).
     factored = model.rollout_head_factored
     if factored is not None:
-        base_l, base_v, pf_fn = factored(ts.params, hn_base)
+        base_l, base_v, pf_fn = factored(params, hn_base)
         head_xs = (base_l[:unroll_len], base_v[:unroll_len])
 
         def head_outs(head_i, obs):
@@ -239,7 +247,7 @@ def _collect_rollout_precomputed(model: Model, env: TradingEnv,
         def head_outs(head_i, obs):
             (hn_i,) = head_i
             outs = model.apply_rollout_head(
-                ts.params,
+                params,
                 jnp.broadcast_to(hn_i, (num_agents,) + hn_i.shape), obs)
             return outs.logits, outs.value
 

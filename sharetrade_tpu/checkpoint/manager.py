@@ -177,9 +177,20 @@ def verify_checkpoint_files(path: str, *,
 
 class CheckpointManager:
     def __init__(self, directory: str, *, keep: int = 3, tracer: Any = None,
-                 fsync: bool = True, metrics: Any = None):
+                 fsync: bool = True, metrics: Any = None,
+                 precision_mode: str | None = None):
         self.directory = directory
         self.keep = keep
+        #: Precision contract of the run (config.PrecisionConfig.mode):
+        #: stamped into every save's meta.json, and VALIDATED at restore —
+        #: a mode-mismatched store raises a loud ValueError instead of
+        #: letting flax ``from_bytes`` silently deserialize wrong-dtype
+        #: leaves into the template (it does not raise on array
+        #: shape/dtype mismatches — the PR-5 gotcha). Checkpoints always
+        #: hold fp32 MASTER weights regardless of mode; the mode matters
+        #: because the compute-dtype carry (K/V caches) rides the state.
+        #: None = don't stamp, don't check (library use outside a run).
+        self.precision_mode = precision_mode
         #: Durability gate (``checkpoint.fsync``): fsync payload files, the
         #: tmp dir, and the parent dir around the atomic rename. Default on —
         #: the same contract the framed journal honors. Off exists for the
@@ -357,6 +368,8 @@ class CheckpointManager:
         payload = serialization.to_bytes(host_state)
         meta = {"step": int(step), "saved_at": time.time(),
                 **(metadata or {})}
+        if self.precision_mode is not None:
+            meta.setdefault("precision_mode", self.precision_mode)
 
         tmp = os.path.join(self.directory, f"tmp-{step}-{os.getpid()}")
         final = os.path.join(self.directory, f"{_PREFIX}{step:010d}")
@@ -376,6 +389,8 @@ class CheckpointManager:
         host_state = jax.device_get(train_state)
         payload = serialization.to_bytes(host_state)
         meta = {"tag": tag, "saved_at": time.time(), **(metadata or {})}
+        if self.precision_mode is not None:
+            meta.setdefault("precision_mode", self.precision_mode)
         tmp = os.path.join(self.directory, f"tmp-{tag}-{os.getpid()}")
         final = os.path.join(self.directory, f"tag_{tag}")
         # Stage the NEW payload completely (durable bytes, no name) BEFORE
@@ -511,6 +526,7 @@ class CheckpointManager:
             raise CheckpointIntegrityError(
                 "state_unreadable", f"{type(exc).__name__}: {exc}") from exc
         meta = verify_checkpoint_files(path, state_bytes=payload)
+        self._check_precision(meta, path)
         try:
             state = serialization.from_bytes(jax.device_get(template),
                                              payload)
@@ -538,6 +554,28 @@ class CheckpointManager:
                 raise CheckpointIntegrityError(
                     "nonfinite", "non-finite value in params/opt_state")
         return state, meta
+
+    def _check_precision(self, meta: dict[str, Any], path: str) -> None:
+        """Refuse a precision-mode-mismatched restore LOUDLY. This must be
+        an explicit meta check because flax ``from_bytes`` silently accepts
+        array dtype/shape mismatches (the PR-5 walk-back gotcha): a
+        bf16_mixed carry would deserialize into an fp32 template — or vice
+        versa — and surface later as a baffling retrace/aval error inside
+        the compiled step. Raises ValueError (NOT quarantine: the bytes are
+        intact; the CONFIG changed — same contract as the template-mismatch
+        branch below). Checkpoints written before the precision policy
+        carry no mode and are treated as fp32 — which they are."""
+        if self.precision_mode is None:
+            return
+        saved = meta.get("precision_mode", "fp32")
+        if saved != self.precision_mode:
+            raise ValueError(
+                f"checkpoint at {path} was saved under precision.mode="
+                f"{saved!r} but this run is configured with "
+                f"{self.precision_mode!r}; restore refuses a mode mismatch "
+                "(master weights are always fp32, but the compute-dtype "
+                "carry differs). Set precision.mode accordingly, or start "
+                "fresh without --resume.")
 
     def verify(self, step: int | None = None) -> dict[str, Any]:
         """Validate one step checkpoint's files + checksums WITHOUT

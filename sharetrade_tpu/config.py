@@ -212,6 +212,48 @@ class ParallelConfig:
 
 
 @dataclass
+class PrecisionConfig:
+    """Numeric precision policy (precision.py) — the ROADMAP item-4
+    low-precision lever, done the convergence-safe way.
+
+    ``mode`` selects the compute tier:
+
+    - ``"fp32"`` (default): everything float32 — BIT-IDENTICAL to the
+      pre-policy behavior (the policy helpers are structural identities,
+      pinned by tests/test_precision.py's golden trajectory).
+    - ``"bf16_mixed"``: fp32 MASTER weights live in the TrainState (and in
+      every checkpoint); each update boundary inside the jitted (mega)chunk
+      casts one bf16 compute copy, every model forward/backward runs bf16
+      with f32 matmul accumulation (``preferred_element_type`` — the
+      ops/attention.py convention, now framework-wide), gradients upcast to
+      f32, and the optimizer update applies in f32. Halves the
+      activation/weight HBM traffic of the hot loop (the roofline
+      telemetry's measured memory-bound axis) without the silently-bf16
+      optimizer state the old whole-model ``model.dtype`` cast produced.
+
+    The old ``model.dtype="bfloat16"`` knob is DEPRECATED with a loud
+    migration error (models/__init__.py): it cast params, grads, and
+    optimizer accumulators wholesale — the convergence-hostile
+    configuration this policy exists to replace.
+
+    fp8 forward-compatibility: the compute tier is a single dtype seam
+    (``PrecisionPolicy.compute_dtype``) and every matmul already pins f32
+    accumulation, so an ``"fp8_mixed"`` mode slots in here when a backend
+    supports it — no new mechanism needed."""
+
+    mode: str = "fp32"                 # "fp32" | "bf16_mixed"
+    # Fused optimizer update (ops/fused_update.py): grad-upcast + moment
+    # update + param update in ONE pass per parameter leaf (a Pallas kernel
+    # on TPU, one fused XLA elementwise chain elsewhere) instead of the
+    # O(params) intermediate buffers optax's update/apply_updates pair
+    # materializes. "auto" = on for bf16_mixed, off for fp32 (keeping the
+    # default mode's update path literally the pre-policy optax calls);
+    # "on"/"off" force it. fp32-exact vs optax is pinned by
+    # tests/test_precision.py regardless of mode.
+    fused_update: str = "auto"         # "auto" | "on" | "off"
+
+
+@dataclass
 class CheckpointConfig:
     """Durability contract of the checkpoint store (checkpoint/manager.py)."""
 
@@ -420,6 +462,7 @@ class FrameworkConfig:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    precision: PrecisionConfig = field(default_factory=PrecisionConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     seed: int = 0
 
@@ -499,5 +542,6 @@ _NESTED = {
     "parallel": ParallelConfig,
     "runtime": RuntimeConfig,
     "checkpoint": CheckpointConfig,
+    "precision": PrecisionConfig,
     "obs": ObsConfig,
 }

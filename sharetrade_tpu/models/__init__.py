@@ -16,7 +16,21 @@ from sharetrade_tpu.models.lstm import lstm_policy
 from sharetrade_tpu.models.mlp import ac_mlp, q_mlp
 from sharetrade_tpu.models.transformer import transformer_policy
 
-_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+#: Master-weight dtypes a config may request. ``bfloat16`` is DELIBERATELY
+#: absent: the old whole-model cast put params, gradients AND optimizer
+#: accumulators in bf16 with no warning — the convergence-hostile
+#: configuration the precision policy (precision.py) replaces. The
+#: migration error below names the new knob.
+_DTYPES = {"float32": jnp.float32}
+
+_BF16_MIGRATION = (
+    "model.dtype='bfloat16' has been removed: the whole-model cast "
+    "silently put optimizer state and master weights in bf16 (a "
+    "convergence-hostile configuration). Set precision.mode='bf16_mixed' "
+    "instead — bf16 compute with fp32 master weights, f32 matmul "
+    "accumulation, and f32 optimizer updates (see README 'Precision "
+    "policy'). Model params now always initialize as fp32 masters; the "
+    "precision policy casts the compute copy at each update boundary.")
 
 
 def _validate_moe_dispatch(cfg: ModelConfig, ep_mesh) -> None:
@@ -54,6 +68,12 @@ def build_model(cfg: ModelConfig, obs_dim: int, *, head: str = "ac",
     window transformer's per-asset-block tokenization over the portfolio
     observation layout (episode mode stays single-asset — PARITY.md).
     """
+    if cfg.dtype == "bfloat16":
+        raise ConfigError(_BF16_MIGRATION)
+    if cfg.dtype not in _DTYPES:
+        raise ConfigError(f"unknown model.dtype {cfg.dtype!r}; "
+                          f"choose from {sorted(_DTYPES)} "
+                          "(low precision is precision.mode's job)")
     dtype = _DTYPES[cfg.dtype]
     actions = cfg.num_actions if num_actions is None else num_actions
     if cfg.seq_mode not in ("window", "episode"):

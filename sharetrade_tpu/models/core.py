@@ -109,6 +109,15 @@ class Model:
     # with ModelOut-equivalent totals base + pf (pinned by
     # tests/test_models.py::test_factored_rollout_head_matches_exact).
     rollout_head_factored: Callable | None = None
+    # Optional precision hook: cast_carry(carry, compute_dtype) -> carry,
+    # casting exactly the carry leaves the model's forward produces in the
+    # compute dtype (K/V caches, recurrent cells). The precision policy
+    # (precision.py cast_carry) calls this when the model provides it;
+    # None means "every floating leaf follows the compute dtype". The
+    # episode transformer needs the hook: its ``hist`` carry holds raw
+    # PRICES that its forwards always rebuild in f32 — blanket-casting it
+    # would both lose tick precision and destabilize the scan carry dtype.
+    cast_carry: Callable[[Any, Any], Any] | None = None
 
 
 def apply_batched(model: Model, params: Any, obs_batch: jax.Array,
@@ -122,6 +131,21 @@ def apply_batched(model: Model, params: Any, obs_batch: jax.Array,
 
 
 _EPS = 1e-6
+
+
+def compute_dtype(params: Any):
+    """The dtype a forward pass should COMPUTE in: the floating dtype of
+    the params it was handed. Models derive their activation-cast dtype
+    from this instead of a build-time closure constant, so the SAME model
+    object serves both halves of the precision policy (precision.py): the
+    fp32 masters (eval, fp32 mode) and the bf16 compute copy the policy
+    casts at each update boundary. Trace-time only (dtypes are static
+    under jit). Falls back to f32 for paramless/empty subtrees."""
+    for leaf in jax.tree.leaves(params):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                     jnp.floating):
+            return leaf.dtype
+    return jnp.float32
 
 
 def rows_finite(tree: Any, batch: int) -> jax.Array:

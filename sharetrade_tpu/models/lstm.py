@@ -15,7 +15,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from sharetrade_tpu.models.core import Model, ModelOut, dense, dense_init
+from sharetrade_tpu.models.core import (Model, ModelOut, compute_dtype,
+                                        dense, dense_init)
 
 
 def lstm_policy(obs_dim: int = 203, hidden_dim: int = 200, num_actions: int = 3,
@@ -35,8 +36,12 @@ def lstm_policy(obs_dim: int = 203, hidden_dim: int = 200, num_actions: int = 3,
         return (zeros, zeros)
 
     def apply(params, obs, carry):
+        # Compute in the handed-in params' dtype (masters or the precision
+        # policy's bf16 copy); ``dtype`` above governs only the master init
+        # and the carry seed (the policy casts the carry at construction).
         h_prev, c_prev = carry
-        x = jax.nn.relu(dense(params["input"], obs.astype(dtype)))
+        x = jax.nn.relu(dense(params["input"],
+                              obs.astype(compute_dtype(params))))
         gates = dense(params["gates"], jnp.concatenate([x, h_prev]))
         i, f, g, o = jnp.split(gates, 4)
         c = jax.nn.sigmoid(f + 1.0) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)

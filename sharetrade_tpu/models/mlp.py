@@ -23,7 +23,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from sharetrade_tpu.models.core import Model, ModelOut, dense, dense_init
+from sharetrade_tpu.models.core import (Model, ModelOut, compute_dtype,
+                                        dense, dense_init)
 
 
 def q_mlp(obs_dim: int = 203, hidden_dim: int = 200, num_actions: int = 3,
@@ -45,6 +46,10 @@ def q_mlp(obs_dim: int = 203, hidden_dim: int = 200, num_actions: int = 3,
         return {"layer1": p1, "layer2": p2}
 
     def apply(params, obs, carry):
+        # Compute in the dtype of the params actually handed in (the fp32
+        # masters, or the precision policy's bf16 copy) — the build-time
+        # ``dtype`` governs only the master init above.
+        dtype = compute_dtype(params)
         x = obs.astype(dtype)
         if parity:
             h = jax.nn.relu(
@@ -79,7 +84,7 @@ def ac_mlp(obs_dim: int = 203, hidden_dim: int = 200, num_actions: int = 3,
         }
 
     def apply(params, obs, carry):
-        x = obs.astype(dtype)
+        x = obs.astype(compute_dtype(params))
         h = jax.nn.relu(dense(params["torso1"], x))
         h = jax.nn.relu(dense(params["torso2"], h))
         logits = dense(params["policy"], h).astype(jnp.float32)

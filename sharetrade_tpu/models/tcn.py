@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from sharetrade_tpu.models.core import (
-    Model, ModelOut, dense, dense_init, portfolio_features,
+    Model, ModelOut, compute_dtype, dense, dense_init, portfolio_features,
     tick_window_features)
 
 KERNEL = 3
@@ -51,6 +51,18 @@ def _causal_conv(p, x, dilation: int):
     """(B, W, C_in) -> (B, W, C_out), left-padded so position t sees only
     positions <= t (standard causal dilated conv)."""
     pad = (KERNEL - 1) * dilation
+    if x.dtype == jnp.bfloat16:
+        # No preferred_element_type on the bf16 path: conv's TRANSPOSE rule
+        # (unlike dot_general's) rebuilds a conv between the bf16 primal
+        # and the f32 cotangent of the pre-cast output and rejects the
+        # dtype mix — a trace-time TypeError under value_and_grad. A plain
+        # bf16 conv differentiates cleanly, and the TPU MXU accumulates
+        # bf16 convolutions in f32 internally regardless.
+        out = jax.lax.conv_general_dilated(
+            x, p["w"], window_strides=(1,), padding=[(pad, 0)],
+            rhs_dilation=(dilation,),
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        return out + p["b"]
     out = jax.lax.conv_general_dilated(
         x, p["w"], window_strides=(1,), padding=[(pad, 0)],
         rhs_dilation=(dilation,),
@@ -91,6 +103,9 @@ def tcn_policy(obs_dim: int = 203, num_actions: int = 3, *,
         return params
 
     def apply_batch(params, obs, carry):
+        # Compute dtype follows the handed-in params (masters or the
+        # precision policy's bf16 copy); build-time ``dtype`` = master init.
+        dtype = compute_dtype(params)
         tokens = tick_window_features(obs, window)               # (B, W, 3)
         x = dense(params["embed"], tokens.astype(dtype))         # (B, W, C)
         for i, blk in enumerate(params["blocks"]):

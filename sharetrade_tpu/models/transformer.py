@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from sharetrade_tpu.config import ConfigError
 
 from sharetrade_tpu.models.core import (
-    Model, ModelOut, dense, dense_init, portfolio_features,
+    Model, ModelOut, compute_dtype, dense, dense_init, portfolio_features,
     tick_window_features)
 from sharetrade_tpu.models.ffn import ffn_apply
 from sharetrade_tpu.ops.attention import flash_attention
@@ -143,6 +143,9 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         overflows expert buffers and silently zeroes dropped tokens.
         """
         bsz, t = x.shape[0], x.shape[1]
+        # Compute dtype follows the handed-in block params (masters or the
+        # precision policy's bf16 copy), not the build-time closure.
+        dtype = compute_dtype(blk)
         h = _layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
         qkv = dense(blk["qkv"], h).reshape(bsz, t, 3, num_heads, head_dim)
         # attention expects (batch, heads, seq, head_dim)
@@ -182,7 +185,7 @@ def transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         kernel call per layer with a batch*heads grid — no batch-1 programs
         (the round-1 pathology: per-agent vmapped kernel invocations)."""
         bsz = obs.shape[0]
-        tokens = tokenize(obs).astype(dtype)
+        tokens = tokenize(obs).astype(compute_dtype(params))
         pos = jnp.tile(params["pos"], (num_assets, 1))           # (seq, d)
         x = dense(params["embed"], tokens) + pos                 # (B, seq, d)
         if num_assets > 1:

@@ -54,7 +54,8 @@ import jax.numpy as jnp
 from sharetrade_tpu.config import ConfigError
 
 from sharetrade_tpu.models.core import (
-    Model, ModelOut, dense, dense_init, portfolio_features, rows_finite)
+    Model, ModelOut, compute_dtype, dense, dense_init, portfolio_features,
+    rows_finite)
 from sharetrade_tpu.models.ffn import ffn_apply
 from sharetrade_tpu.models.transformer import _layer_norm
 from sharetrade_tpu.ops.attention import flash_attention
@@ -237,6 +238,9 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         window (always computed; a few window-length rows) and the FFN's
         MoE balance loss."""
         bsz, s_len = x.shape[0], x.shape[1]
+        # Compute dtype follows the handed-in params (fp32 masters or the
+        # precision policy's bf16 copy); the build ``dtype`` = master init.
+        dtype = compute_dtype(blk)
         h = _layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
         qkv = dense(blk["qkv"], h).reshape(
             bsz, s_len, 3, num_heads, head_dim)
@@ -268,6 +272,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         rows, too short to shard).
         """
         bsz, s_len = series.shape
+        dtype = compute_dtype(params)
         x = dense(params["embed"], _tick_features(series).astype(dtype))
         if pp_mesh is not None:   # overrides rejected at build: always local
             x, kv, aux = _forward_blocks_pipelined(
@@ -331,6 +336,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         """Microbatches cut the AGENT batch (independent rows)."""
         from jax.sharding import PartitionSpec as P
         from sharetrade_tpu.parallel.pipeline import pipeline_apply
+        dtype = compute_dtype(params)
         bsz, s_len = x.shape[0], x.shape[1]
         mb_b = bsz // m
         state = jnp.concatenate(
@@ -425,6 +431,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         cache-tail side slices around it (static offset)."""
         from jax.sharding import PartitionSpec as P
         from sharetrade_tpu.parallel.pipeline import pipeline_apply
+        dtype = compute_dtype(params)
         bsz, s_len = x.shape[0], x.shape[1]
         m, chunk_len, pad = plan
         halo = window - 1
@@ -555,6 +562,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         the key axis, so slot order never matters.
         """
         bsz = obs.shape[0]
+        dtype = compute_dtype(params)
         new, prev = obs[:, window - 1], obs[:, window - 2]
         ret = (jnp.log(jnp.maximum(new, _EPS))
                - jnp.log(jnp.maximum(prev, _EPS)))
@@ -671,10 +679,13 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         factored head (f32): shared by rollout_head_factored AND the
         shared replay so their op order — and thus their bf16 rounding —
         can never diverge. Differentiable (the folds stay in the graph)."""
-        wp = params["port"]["w"].astype(jnp.float32)      # (3, d)
-        bp = params["port"]["b"].astype(jnp.float32)      # (d,)
-        wl = params["policy"]["w"].astype(jnp.float32)    # (d, A)
-        wv = params["value"]["w"].astype(jnp.float32)     # (d, 1)
+        # precision-cast-ok (x4): deliberate f32 UPCASTS for the folded
+        # head matrices — the fold must not compound bf16 rounding, and an
+        # upcast of compute-copy leaves never touches the master contract.
+        wp = params["port"]["w"].astype(jnp.float32)      # precision-cast-ok
+        bp = params["port"]["b"].astype(jnp.float32)      # precision-cast-ok
+        wl = params["policy"]["w"].astype(jnp.float32)    # precision-cast-ok
+        wv = params["value"]["w"].astype(jnp.float32)     # precision-cast-ok
         return wp @ wl, bp @ wl, (wp @ wv)[:, 0], (bp @ wv)[0]
 
     def apply_unroll_shared(params, obs, carry):
@@ -793,6 +804,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         """The state-dependent remainder of the forward: inject the
         portfolio features and read the policy/value heads — a few
         (B, d)-sized ops per env step."""
+        dtype = compute_dtype(params)
         hn = hn_row.astype(dtype) + dense(params["port"], _port_feats(
             obs[:, window], obs[:, window + 1],
             obs[:, window - 1]).astype(dtype))
@@ -809,6 +821,7 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
         the d-sized per-iteration GEMMs that bound the d=256 flagship
         scan (BASELINE.md round-5 section). Exact up to float
         reassociation; the combined matrices are folded in f32."""
+        dtype = compute_dtype(params)
         base_logits = dense(params["policy"],
                             hn_base.astype(dtype)).astype(jnp.float32)
         base_values = dense(params["value"],
@@ -830,8 +843,22 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
             "t": jnp.int32(0),
         }
 
+    def cast_carry_fn(carry, to_dtype):
+        """Precision-policy carry cast (models/core.py Model.cast_carry):
+        the K/V cache follows the compute dtype — every forward writes
+        rotated keys/values in that dtype, so a mismatched cache is a
+        dynamic_update_slice/cond aval error, not a slowdown — while
+        ``hist`` stays f32: it holds raw PRICES that prefill/trunk always
+        rebuild from f32 observations (casting it would flip the scan
+        carry dtype mid-episode AND quantize the tick stream)."""
+        out = dict(carry)
+        out["k"] = carry["k"].astype(to_dtype)  # precision-cast-ok: policy hook
+        out["v"] = carry["v"].astype(to_dtype)  # precision-cast-ok: policy hook
+        return out
+
     return Model(init=init, apply=apply, apply_batch=apply_batch,
                  apply_unroll=apply_unroll, init_carry=init_carry,
+                 cast_carry=cast_carry_fn,
                  apply_unroll_shared=apply_unroll_shared,
                  apply_rollout_trunk=apply_rollout_trunk,
                  apply_rollout_head=apply_rollout_head,

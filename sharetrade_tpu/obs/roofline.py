@@ -220,6 +220,11 @@ class RooflineCapture:
         #: inner loop trip count of every captured program; the
         #: orchestrator sets it before the programs build.
         self.steps_per_chunk: int = 1
+        #: Precision mode the captured programs compiled under
+        #: (config.PrecisionConfig.mode) — recorded in the artifact so a
+        #: bytes/AI movement is attributable to the compute tier, and so
+        #: perf tooling never compares rooflines across precisions.
+        self.precision_mode: str | None = None
         self.programs: dict[str, ProgramCost] = {}
         self._by_factor: dict[int, ProgramCost] = {}
         self._flight_record = flight_record
@@ -361,6 +366,7 @@ class RooflineCapture:
         """The artifact/summary object — caller holds ``self._lock``."""
         return {
             "schema_version": SCHEMA_VERSION,
+            "precision_mode": self.precision_mode,
             "peak_flops_per_s": self.peak_flops,
             "peak_hbm_bytes_per_s": self.peak_hbm_bw,
             "ridge_flops_per_byte": self.ridge,

@@ -1,0 +1,142 @@
+"""Precision policy: bf16 compute with fp32 master weights.
+
+The old low-precision story was ``model.dtype="bfloat16"`` — a whole-model
+cast where params, gradients, AND optimizer accumulators silently followed
+the compute dtype (models/__init__.py now rejects it with a migration
+error). This module replaces it with the standard mixed-precision contract
+the TPU RL stacks run (RLAX arxiv 2512.06392, Podracer arxiv 2104.06272):
+
+- **Masters**: ``TrainState.params`` (and optimizer state) stay float32,
+  always. Checkpoints therefore always hold fp32 master weights.
+- **Compute**: at each update boundary inside the jitted (mega)chunk the
+  policy casts ONE bf16 copy (:meth:`PrecisionPolicy.cast_compute`); every
+  forward/backward runs on that copy, with f32 matmul accumulation
+  (``preferred_element_type`` — models/core.py ``dense``,
+  ops/attention.py ``_dot``).
+- **Gradients**: differentiate w.r.t. the bf16 copy, upcast to f32
+  (:meth:`PrecisionPolicy.grads_to_master`), apply the update in f32.
+- **Recurrent carry**: cast once at TrainState construction
+  (:meth:`PrecisionPolicy.cast_carry`) so the scan-carried K/V caches ride
+  bf16 with a stable pytree dtype (a carry whose dtype flips mid-scan is a
+  trace error, not a slowdown).
+
+Everything here is a STRUCTURAL identity in fp32 mode — the helpers return
+their argument object untouched, so the default mode's traced program is
+bit-for-bit the pre-policy program (pinned by tests/test_precision.py's
+golden trajectory). Casts anywhere near params/grads must route through
+these helpers: tools/lint_hot_loop.py check 7 flags bare ``.astype(`` on
+params/grads in the hot paths (``precision-cast-ok`` escape hatch).
+
+fp8 note: the compute tier is this one dtype seam; when a backend supports
+fp8 matmuls, an ``fp8_mixed`` mode is a new ``compute_dtype`` plus a
+scaling strategy — the accumulation seams are already in place.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from sharetrade_tpu.config import ConfigError, PrecisionConfig
+
+MODES = ("fp32", "bf16_mixed")
+
+
+def _cast_float_leaves(tree: Any, dtype) -> Any:
+    """Cast every floating leaf of ``tree`` to ``dtype`` (integer leaves —
+    counters, cursors, replay indices — pass through untouched)."""
+    def leaf(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)  # precision-cast-ok: THE policy cast site
+        return x
+    return jax.tree.map(leaf, tree)
+
+
+@dataclass(frozen=True)
+class PrecisionPolicy:
+    """The resolved precision contract every learner/runtime path consults.
+
+    ``mixed`` is False for fp32 mode, and then every helper is an object
+    identity (returns its argument) — the structural bit-identity guarantee
+    of the default mode."""
+
+    mode: str = "fp32"
+    fused_update: str = "auto"
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ConfigError(
+                f"unknown precision.mode {self.mode!r}; choose from {MODES}")
+        if self.fused_update not in ("auto", "on", "off"):
+            raise ConfigError(
+                f"precision.fused_update must be 'auto', 'on' or 'off', "
+                f"got {self.fused_update!r}")
+
+    @property
+    def mixed(self) -> bool:
+        return self.mode == "bf16_mixed"
+
+    @property
+    def compute_dtype(self):
+        """The dtype model forwards run in (activations + compute copy of
+        the weights); matmul ACCUMULATION stays f32 either way."""
+        return jnp.bfloat16 if self.mixed else jnp.float32
+
+    @property
+    def use_fused_update(self) -> bool:
+        """Resolve the fused-update tri-state: 'auto' engages it exactly
+        when the mode is mixed (fp32 default keeps the literal optax
+        call pair — the bit-identity contract)."""
+        if self.fused_update == "auto":
+            return self.mixed
+        return self.fused_update == "on"
+
+    # ---- the three cast seams ----------------------------------------
+
+    def cast_compute(self, params: Any) -> Any:
+        """fp32 masters -> the compute copy the forwards/backwards see.
+        Called ONCE per update boundary inside the traced step (XLA CSEs
+        any duplicate). Identity in fp32 mode."""
+        if not self.mixed:
+            return params
+        return _cast_float_leaves(params, self.compute_dtype)
+
+    def grads_to_master(self, grads: Any) -> Any:
+        """Gradients of the compute copy -> f32 master-space gradients.
+        Identity in fp32 mode."""
+        if not self.mixed:
+            return grads
+        return _cast_float_leaves(grads, jnp.float32)
+
+    def cast_carry(self, carry: Any, model: Any = None) -> Any:
+        """Model recurrent state (K/V caches, LSTM cells) -> the compute
+        dtype, applied at TrainState CONSTRUCTION (init / heal / episode
+        re-arm) so the scan-carried dtype is stable across chunks.
+        Models that produce a MIXED-dtype carry (the episode transformer's
+        f32 ``hist`` beside its compute-dtype K/V cache) provide
+        ``Model.cast_carry`` and the hook decides per leaf; otherwise
+        every floating leaf follows the compute dtype. Identity in fp32
+        mode."""
+        if not self.mixed:
+            return carry
+        hook = getattr(model, "cast_carry", None)
+        if hook is not None:
+            return hook(carry, self.compute_dtype)
+        return _cast_float_leaves(carry, self.compute_dtype)
+
+
+#: The default policy every path without an explicit config resolves to —
+#: fp32, structurally identical to the pre-policy code.
+FP32 = PrecisionPolicy()
+
+
+def policy_from_config(cfg: PrecisionConfig | None) -> PrecisionPolicy:
+    """Validate + freeze a PrecisionConfig into the policy object (the
+    constructor raises ConfigError on unknown modes — STOP territory, a bad
+    precision config can never heal by restarting)."""
+    if cfg is None:
+        return FP32
+    return PrecisionPolicy(mode=cfg.mode, fused_update=cfg.fused_update)

@@ -150,6 +150,14 @@ class Orchestrator:
                 cfg.runtime.metrics_every_chunks,
                 cfg.runtime.megachunk_factor)
         self.lifecycle = Lifecycle()
+        # Precision policy (precision.py): validated at construction (a bad
+        # mode is STOP territory). The agents own the training-side casts;
+        # the orchestrator applies the same policy to the eval forwards and
+        # stamps the mode into checkpoint metadata (restore refuses a
+        # mode-mismatched store with a loud error instead of letting flax
+        # silently deserialize the wrong dtypes).
+        from sharetrade_tpu.precision import policy_from_config
+        self._precision = policy_from_config(cfg.precision)
         self.metrics = MetricsRegistry(
             max_points=cfg.obs.max_metric_points)
         # Telemetry (obs/): inert facade when cfg.obs.enabled is False —
@@ -160,7 +168,12 @@ class Orchestrator:
         self.obs = build_obs(cfg, self.metrics, mesh=mesh)
         self.checkpoints = checkpoints or CheckpointManager(
             cfg.runtime.checkpoint_dir, keep=cfg.runtime.keep_checkpoints,
-            fsync=cfg.checkpoint.fsync)
+            fsync=cfg.checkpoint.fsync,
+            precision_mode=cfg.precision.mode)
+        if getattr(self.checkpoints, "precision_mode", None) is None:
+            # Injected managers join the run's precision contract the same
+            # way they join its metrics/tracer below.
+            self.checkpoints.precision_mode = cfg.precision.mode
         if getattr(self.checkpoints, "metrics", None) is None:
             # Restore walk-back counters (ckpt_restore_fallbacks_total,
             # ckpt_quarantined_total) land in the run's registry and flow
@@ -389,6 +402,7 @@ class Orchestrator:
                     else None)
         if roofline is not None:
             roofline.steps_per_chunk = self.cfg.runtime.chunk_steps
+            roofline.precision_mode = self.cfg.precision.mode
             try:
                 from sharetrade_tpu.utils.flops import (
                     train_flops_per_agent_step)
@@ -1693,6 +1707,11 @@ class Orchestrator:
     def _evaluate_params(self, params) -> dict[str, float]:
         env = self.env
         horizon = env.num_steps
+        # Evaluate in the precision the policy TRAINS in (the compute copy
+        # of the fp32 masters — identity in fp32 mode): the shipped
+        # numbers should describe the network as it actually runs, and a
+        # master-dtype eval would retrace the cached program besides.
+        params = self._precision.cast_compute(params)
 
         # The jitted eval program is cached on the orchestrator (jit caches
         # by function identity — a fresh lambda per call would retrace the
@@ -1726,6 +1745,8 @@ class Orchestrator:
                 self._eval_fn = jax.jit(
                     lambda p: greedy_rollout_precomputed(model, env, p))
             else:
+                precision = self._precision
+
                 def greedy_scan(p):
                     def body(carry, _):
                         state, model_carry = carry
@@ -1735,8 +1756,13 @@ class Orchestrator:
                         new_state, reward = env.step(state, action)
                         return (new_state, model_carry), reward
 
+                    # The carry seed follows the compute dtype (identity in
+                    # fp32): a recurrent model fed bf16 weights writes a
+                    # bf16 carry, and an f32 seed would flip the scan
+                    # carry's dtype on the first iteration.
+                    carry0 = precision.cast_carry(model.init_carry(), model)
                     (final, _), rewards = jax.lax.scan(
-                        body, (env.reset(), model.init_carry()), None,
+                        body, (env.reset(), carry0), None,
                         length=horizon)
                     return final, rewards
 

@@ -110,11 +110,19 @@ class TestHeads:
         assert all(np.isfinite(norms)) and any(n > 0 for n in norms)
 
     def test_bfloat16_compute(self):
-        cfg = ModelConfig(kind="mlp", hidden_dim=32, dtype="bfloat16")
+        """bf16 compute now arrives via the precision policy's compute
+        copy (precision.py) — model.dtype='bfloat16' is a migration error
+        (it silently put optimizer state in bf16; tests/test_precision.py
+        covers the error path). Forwards compute in the dtype of the
+        params they are handed; heads cast back to f32 for numerics
+        downstream (TD targets etc)."""
+        from sharetrade_tpu.precision import PrecisionPolicy
+        cfg = ModelConfig(kind="mlp", hidden_dim=32)
         model = build_model(cfg, OBS_DIM)
-        params = model.init(jax.random.PRNGKey(0))
+        params = PrecisionPolicy(mode="bf16_mixed").cast_compute(
+            model.init(jax.random.PRNGKey(0)))
+        assert all(l.dtype == jnp.bfloat16 for l in jax.tree.leaves(params))
         out, _ = model.apply(params, _obs(jax.random.PRNGKey(1)), ())
-        # Heads cast back to f32 for numerics downstream (TD targets etc).
         assert out.logits.dtype == jnp.float32
 
 

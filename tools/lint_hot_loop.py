@@ -67,6 +67,21 @@ in tests/test_megachunk.py:
    ``replace-fsync-ok`` naming why durability is not needed there (e.g.
    quarantining bytes that are already known-corrupt).
 
+7. **Params/grads casts go through the precision policy** (the
+   mixed-precision PR's guard) — a bare ``.astype(`` touching params or
+   gradients inside ``_run_supervised`` or a traced step closure
+   sidesteps the precision policy (precision.py): under fp32 it breaks
+   the default mode's bit-identity contract, and under bf16_mixed a
+   stray cast either re-creates the whole-model-cast failure mode
+   (optimizer state silently following the compute dtype) or flips a
+   scan carry's dtype mid-program. Casts on params/grads must route
+   through ``PrecisionPolicy.cast_compute`` / ``grads_to_master`` /
+   ``cast_carry``. FAILS on a line that both mentions params/grads and
+   calls ``.astype(`` in those regions, unless it carries
+   ``precision-cast-ok`` naming why the cast is policy-sanctioned
+   (activation casts — a dot output that merely MENTIONS params on the
+   same line — use the same marker).
+
 6. **Roofline capture stays at compile time** (the roofline PR's guard) —
    ``cost_analysis()`` / ``memory_analysis()`` / ``RooflineCapture
    .capture()`` AOT-lower and compile a program, seconds of work that
@@ -147,6 +162,20 @@ ROOFLINE_PATTERN = re.compile(
     r"cost_analysis\(|memory_analysis\(|compiled_costs\(|\.capture\(")
 #: Escape hatch for an intentional capture site in guarded code.
 ROOFLINE_MARKER = "roofline-capture-ok"
+
+#: Check 7: a ``.astype(`` whose RECEIVER is a params/grads expression
+#: (``ts.params.astype(``, ``grads.astype(``, ``params["w"].astype(`` —
+#: ``\w*params`` catches new_params/target_params too), or a tree.map'd
+#: cast applied to a params/grads tree on the same line. Activation casts
+#: that merely mention params elsewhere on the line (head outputs,
+#: ``dense(params[...], h).astype(f32)``) deliberately do NOT match: they
+#: cast dot outputs, not the weight/grad trees the policy owns.
+PRECISION_PATTERN = re.compile(
+    r"(?:\w*params\b|\bgrads?\b)(?:\.\w+|\[[^]]*\])*\s*\.astype\("
+    r"|(?=.*tree\.map)(?=.*\.astype\()(?=.*(?:\w*params\b|\bgrads?\b))")
+#: Escape hatch: the policy's own cast sites (precision.py helpers, model
+#: cast_carry hooks) and activation casts that merely mention params.
+PRECISION_MARKER = "precision-cast-ok"
 
 
 def lint_parallel_device_put() -> list[tuple[str, int, str]]:
@@ -306,6 +335,16 @@ def lint_device_host_calls() -> list[tuple[str, int, str, str]]:
     return _scan_nested_funcs(JIT_PATTERN, JIT_MARKER)
 
 
+def lint_precision_casts() -> list[tuple[str, int, str, str]]:
+    """Check 7: no bare ``.astype(`` on params/grads in ``_run_supervised``
+    or nested (traced) device-package functions — casts route through the
+    precision policy helpers; returns (where, line, function, text) hits."""
+    disp, _ = _scan_named_funcs(HOT_FUNCS, PRECISION_PATTERN,
+                                PRECISION_MARKER)
+    return ([(TARGET.name, ln, fn, text) for fn, ln, text in disp]
+            + _scan_nested_funcs(PRECISION_PATTERN, PRECISION_MARKER))
+
+
 def main() -> int:
     bad, found = lint_hot_loop_syncs()
     missing = set(HOT_FUNCS) - found
@@ -371,6 +410,18 @@ def main() -> int:
               "it to the build path (jit_parallel_step cost_hook), or tag "
               f"the line '# {ROOFLINE_MARKER}: <why capture here>'")
         return 1
+    prec_bad = lint_precision_casts()
+    if prec_bad:
+        print("precision-policy cast lint FAILED:")
+        for rel, ln, fn, text in prec_bad:
+            print(f"  {rel}:{ln} (in {fn}): {text}")
+        print("a bare .astype( on params/grads in the hot paths bypasses "
+              "the precision policy (fp32 bit-identity, bf16 master-weight "
+              "contract); route it through PrecisionPolicy.cast_compute/"
+              "grads_to_master/cast_carry (precision.py), or tag the line "
+              f"'# {PRECISION_MARKER}: <why this cast is policy-"
+              "sanctioned>'")
+        return 1
     dur_bad = lint_durable_replace()
     if dur_bad:
         print("durable-rename fsync lint FAILED:")
@@ -388,6 +439,7 @@ def main() -> int:
           f"dispatcher blocking-call lint OK "
           f"({', '.join(DISPATCHER_FUNCS)}); "
           f"roofline capture lint OK; "
+          f"precision-cast lint OK; "
           f"durable-rename fsync lint OK ({', '.join(DURABLE_WRITE_FILES)})")
     return 0
 

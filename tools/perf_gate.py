@@ -15,10 +15,13 @@ tool turns the trajectory into a gate (``make perf-gate``, wired into
    raw ``tail`` line when parsing failed — for ``{metric, value, mfu}``
    rows, labeling legacy rows ``tpu`` (the tunnel era) except under a
    ``cpu_fallback`` subtree or an explicit ``backend`` key.
-2. **Group** rows into series per ``(metric, backend)`` — a CPU-fallback
-   round (BENCH_r04/r05's dead tunnel) must never gate against TPU
-   numbers — ordered by the driver's round number ``n`` (file order as
-   the tiebreak).
+2. **Group** rows into series per ``(metric, backend, precision)`` — a
+   CPU-fallback round (BENCH_r04/r05's dead tunnel) must never gate
+   against TPU numbers, and a ``bf16_mixed`` row must never gate against
+   fp32 history (different compute tier, different roofline; the
+   precision PR). Rows carry ``precision`` from the new-schema envelope;
+   legacy rows without one gate as ``fp32`` — which they were. Ordered by
+   the driver's round number ``n`` (file order as the tiebreak).
 3. **Gate** each series' NEWEST value against the best PRIOR value with a
    per-quantity relative tolerance band: ``value`` (steps/s) and ``mfu``
    each default to 25% — wide enough for the measured round-to-round host
@@ -63,10 +66,12 @@ def _legacy_backend(path_keys: tuple[str, ...], row: dict) -> str:
 
 
 def extract_rows(obj, *, default_backend: str | None = None,
+                 default_precision: str | None = None,
                  _path: tuple[str, ...] = ()) -> list[dict]:
     """Recursively pull ``{metric, value[, mfu]}`` rows out of one parsed
     bench result (works on both the new schema-versioned envelope and the
-    legacy nested objects)."""
+    legacy nested objects). ``precision`` rides along when the row or the
+    envelope declares one; absent means fp32 (every pre-policy row)."""
     rows: list[dict] = []
     if not isinstance(obj, dict):
         return rows
@@ -82,6 +87,9 @@ def extract_rows(obj, *, default_backend: str | None = None,
                 "backend": (default_backend
                             or _legacy_backend(_path, obj)),
             }
+            precision = obj.get("precision") or default_precision
+            if precision:
+                row["precision"] = str(precision)
             try:
                 # Tolerant like the value parse above: one malformed
                 # legacy field drops the quantity, never the gate run.
@@ -93,6 +101,7 @@ def extract_rows(obj, *, default_backend: str | None = None,
     for key, child in obj.items():
         if isinstance(child, dict):
             rows.extend(extract_rows(child, default_backend=default_backend,
+                                     default_precision=default_precision,
                                      _path=_path + (key,)))
     return rows
 
@@ -125,10 +134,12 @@ def parse_bench_file(path: str) -> dict | None:
         return None
     # Pure error snapshots (r04) have no top-level rows; extract_rows
     # still walks any cpu_fallback subtree for the rows it carries.
-    default_backend = None
+    default_backend = default_precision = None
     if parsed.get("schema_version"):
         default_backend = parsed.get("backend")
-    rows = extract_rows(parsed, default_backend=default_backend)
+        default_precision = parsed.get("precision")
+    rows = extract_rows(parsed, default_backend=default_backend,
+                        default_precision=default_precision)
     return {"n": n, "path": os.path.basename(path), "rows": rows}
 
 
@@ -146,7 +157,10 @@ def parse_baseline(path: str) -> dict | None:
 
 
 def collect_series(snapshots: list[dict]) -> dict[tuple, list[dict]]:
-    """(metric, backend, quantity) → chronological [{round, value}, ...]."""
+    """(metric, backend, precision, quantity) → chronological
+    [{round, value}, ...]. Rows without a precision label gate as fp32
+    (every pre-policy snapshot ran fp32 — or its whole-model-cast
+    ancestor, whose rows the fp32 series absorbs as history)."""
     series: dict[tuple, list[dict]] = {}
     ordered = sorted(
         (s for s in snapshots if s is not None),
@@ -157,7 +171,8 @@ def collect_series(snapshots: list[dict]) -> dict[tuple, list[dict]]:
             for quantity in ("value", "mfu"):
                 if quantity not in row:
                     continue
-                key = (row["metric"], row["backend"], quantity)
+                key = (row["metric"], row["backend"],
+                       row.get("precision", "fp32"), quantity)
                 series.setdefault(key, []).append(
                     {"round": snap["n"], "path": snap["path"],
                      "value": row[quantity]})
@@ -169,8 +184,9 @@ def gate(series: dict[tuple, list[dict]],
     failures: list[str] = []
     notes: list[str] = []
     checked = 0
-    for (metric, backend, quantity), points in sorted(series.items()):
-        name = f"{metric}[{backend}].{quantity}"
+    for (metric, backend, precision, quantity), points in sorted(
+            series.items()):
+        name = f"{metric}[{backend},{precision}].{quantity}"
         if len(points) < 2:
             notes.append(f"{name}: only {len(points)} point(s); nothing to "
                          "gate yet")

@@ -111,6 +111,21 @@ CONFIGS: list[dict] = [
      "model": {"kind": "transformer", "pipeline_blocks": True,
                "num_layers": 2, "num_heads": 2, "head_dim": 16},
      "window": 14, "unroll": 4, "chunk": 4, "workers": 4, "series": 40},
+    # Per-precision rows (the mixed-precision PR): the SAME programs under
+    # precision.mode=bf16_mixed get their own byte/HBM ceilings — a bf16
+    # program gating against fp32 ceilings would always pass (and the
+    # reverse always fail), hiding regressions in exactly the tier the
+    # policy exists to shrink. The episode row doubles as the remat gate
+    # for the bf16 carry: the K/V cache changes dtype, and the seam pins
+    # must keep the compile involuntary-remat-clean regardless.
+    {"name": "dp8_qlearn_k8_bf16", "mesh": {"dp": 8}, "algo": "qlearn",
+     "mega": 8, "precision": "bf16_mixed"},
+    {"name": "dp4_sp2_ppo_episode_bf16", "mesh": {"dp": 4, "sp": 2},
+     "algo": "ppo", "precision": "bf16_mixed",
+     "model": {"kind": "transformer", "seq_mode": "episode",
+               "attention": "ring", "num_layers": 2, "num_heads": 2,
+               "head_dim": 8},
+     "window": 14, "unroll": 4, "chunk": 4, "workers": 8, "series": 40},
 ]
 
 
@@ -186,6 +201,7 @@ def _child_build(spec: dict):
         cfg.learner.journal_replay = bool(spec.get("journal"))
     for key, val in spec.get("model", {}).items():
         setattr(cfg.model, key, val)
+    cfg.precision.mode = spec.get("precision", "fp32")
     cfg.parallel.mesh_shape = dict(spec["mesh"])
 
     sizes = list(spec["mesh"].values())

@@ -72,6 +72,34 @@ def main() -> int:
     agent = build_agent(cfg, env_params)
     state = agent.init(jax.random.PRNGKey(0))
     smoke("ppo+transformer EPISODE train step", agent.step, state)
+
+    # Fused optimizer update (ops/fused_update.py): the Pallas kernel path
+    # on TPU (tiling legality is exactly what interpret-mode tests cannot
+    # catch), the fused XLA chain elsewhere — adagrad/adam/sgd, bf16 grads
+    # + emit_compute (the full kernel output surface).
+    import optax
+    from sharetrade_tpu.ops.fused_update import fused_apply
+    fu_params = {"w": jax.random.normal(key, (1024, 200)),
+                 "b": jnp.zeros((200,))}
+    fu_grads = jax.tree.map(
+        lambda x: (x * 0.1 + 0.01).astype(jnp.bfloat16), fu_params)
+    for opt_name, opt in (("adagrad", optax.adagrad(0.01)),
+                          ("adam", optax.adam(0.01)),
+                          ("sgd", optax.sgd(0.01))):
+        st = opt.init(fu_params)
+        smoke(f"fused_update {opt_name} (bf16 grads + emit_compute)",
+              lambda g, s, p, _n=opt_name: fused_apply(
+                  _n, 0.01, g, s, p, compute_dtype=jnp.bfloat16,
+                  emit_compute=True),
+              fu_grads, st, fu_params)
+
+    # Full bf16_mixed episode step: the policy's compute casts + fused
+    # update inside the real jitted program.
+    cfg.precision.mode = "bf16_mixed"
+    agent = build_agent(cfg, env_params)
+    state = agent.init(jax.random.PRNGKey(0))
+    smoke("ppo+transformer EPISODE train step [bf16_mixed]",
+          agent.step, state)
     print("compile smoke: ALL OK")
     return 0
 
