@@ -8,7 +8,7 @@
 
 PYTHON ?= python
 
-.PHONY: check test slow native bench bench-async bench-ckpt bench-dispatch bench-obs bench-precision bench-reshard bench-roofline crash-soak obs-demo lint perf-gate shard-audit clean
+.PHONY: check test slow native bench bench-async bench-ckpt bench-dispatch bench-obs bench-precision bench-reshard bench-roofline bench-serve crash-soak obs-demo lint perf-gate serve-soak shard-audit clean
 
 check: native lint
 	$(PYTHON) -m pytest tests/ -q -m "not slow" -x
@@ -96,11 +96,26 @@ bench-precision:
 	$(PYTHON) -c "import json, bench; \
 	print(json.dumps(bench.bench_precision(), indent=2))"
 
+# Serving tier A/B (continuous batching vs the batch=1 closed-loop
+# baseline, rate sweep + saturation + the cache-bound episode row): the
+# numbers behind BASELINE.md "Serving" and the serve_qps / serve_p99_ms
+# perf-gate series. Runnable on CPU in ~a minute; the full soak is
+# `python tools/serve_soak.py` (with --strict for the 3x acceptance).
+bench-serve:
+	$(PYTHON) -c "import json, bench; \
+	print(json.dumps(bench.bench_serve(), indent=2))"
+
 # Perf-regression gate (also part of check): the newest BENCH_*.json row
 # per (metric, backend, precision) series must sit within the tolerance
 # band of the prior best — steps/s and MFU both gate (tools/perf_gate.py).
 perf-gate:
 	$(PYTHON) tools/perf_gate.py
+
+# Serving-tier load soak: thousands of synthetic sessions, open-loop rate
+# sweep, continuous batching vs the batch=1 server head-to-head; --strict
+# enforces the >=3x-QPS-at-equal-or-better-p99 acceptance (ISSUE 8).
+serve-soak:
+	$(PYTHON) tools/serve_soak.py --strict
 
 # Process-kill chaos soak: >= 20 seeded SIGKILL/SIGTERM injections into real
 # training subprocesses (journaled DQN config), each followed by --resume,

@@ -19,6 +19,7 @@ import json
 import os
 import signal
 import sys
+import threading
 import time
 
 from sharetrade_tpu.config import FrameworkConfig
@@ -202,6 +203,151 @@ def cmd_train(args) -> int:
         service.close()
 
 
+def _serve_boot_params(manager, template, tag: str):
+    """Initial serving weights: the tagged best policy when one exists,
+    else the latest step checkpoint, else a fresh init (loud — an
+    untrained policy serves finite garbage, not answers). Returns
+    ``(params, step, boot_meta)``; ``boot_meta`` seeds the swap watcher's
+    already-applied stamp."""
+    try:
+        state, meta = manager.restore_tagged(template, tag)
+        return (state.params,
+                int(meta.get("updates", meta.get("step", 0)) or 0), meta)
+    except FileNotFoundError:
+        pass
+    try:
+        state, step = manager.restore(template)
+        return state.params, int(step), None
+    except FileNotFoundError:
+        log.warning("no checkpoint under %s; serving a fresh-initialized "
+                    "(UNTRAINED) policy", manager.directory)
+        return template.params, 0, None
+
+
+def cmd_serve(args) -> int:
+    """Continuous-batching inference service (serve/engine.py): coalesce
+    per-session queries into padded device batches over the session slot
+    pool, hot-swap weights from the training run's ``tag_best`` checkpoint,
+    and export SLO gauges through obs/. Driven here by the synthetic
+    session replayer (serve/driver.py) — a network front-end would sit on
+    ``ServeEngine.submit`` the same way.
+
+    Preemption-safe from day one: SIGTERM/SIGINT drains in-flight requests,
+    flushes metrics, and exits ``EXIT_PREEMPTED`` (75) — the same contract
+    as ``cli train``."""
+    import jax
+
+    from sharetrade_tpu.agents import build_agent
+    from sharetrade_tpu.checkpoint.manager import CheckpointManager
+    from sharetrade_tpu.env import trading
+    from sharetrade_tpu.obs import build_obs
+    from sharetrade_tpu.precision import policy_from_config
+    from sharetrade_tpu.serve import ServeEngine, WeightSwapWatcher
+    from sharetrade_tpu.serve.driver import (
+        make_sessions,
+        run_closed_loop,
+        run_open_loop,
+    )
+    from sharetrade_tpu.utils.metrics import MetricsRegistry
+
+    cfg = _load_config(args)
+    service = PriceDataService(config=cfg.data)
+    engine = watcher = obs_bundle = None
+    stop_evt = threading.Event()
+    preempt_at: list[float] = []
+
+    def _on_signal(signum, frame):
+        if not preempt_at:
+            log.warning("received %s; draining in-flight requests",
+                        signal.Signals(signum).name)
+            preempt_at.append(time.monotonic())
+            stop_evt.set()
+        else:
+            log.warning("received %s during the drain; hard exit",
+                        signal.Signals(signum).name)
+            os._exit(EXIT_PREEMPTED)
+
+    prev_handlers = {
+        s: signal.signal(s, _on_signal)
+        for s in (signal.SIGTERM, signal.SIGINT)}
+    try:
+        response = service.request(args.symbol.split(",")[0].strip(),
+                                   args.start, args.end)
+        prices = response.series.prices
+        env_params = trading.env_from_prices(
+            prices, window=cfg.env.window,
+            initial_budget=cfg.env.initial_budget,
+            initial_shares=cfg.env.initial_shares)
+        agent = build_agent(cfg, env_params)
+        template = agent.init(jax.random.PRNGKey(cfg.seed))
+        manager = CheckpointManager(
+            cfg.runtime.checkpoint_dir, keep=cfg.runtime.keep_checkpoints,
+            fsync=cfg.checkpoint.fsync, precision_mode=cfg.precision.mode)
+        params, step, boot_meta = _serve_boot_params(
+            manager, template, cfg.serve.swap_tag)
+
+        registry = MetricsRegistry(
+            max_points=cfg.obs.max_metric_points or None)
+        obs_bundle = build_obs(cfg, registry)
+        engine = ServeEngine(agent.model, cfg.serve, params,
+                             params_step=step,
+                             precision=policy_from_config(cfg.precision),
+                             registry=registry, obs=obs_bundle)
+        engine.warmup()
+        if cfg.serve.swap_poll_s > 0:
+            watcher = WeightSwapWatcher(
+                engine, manager, template, tag=cfg.serve.swap_tag,
+                poll_s=cfg.serve.swap_poll_s, seen_meta=boot_meta).start()
+        # Readiness line (machine-readable: the soak/tests wait on it).
+        print(json.dumps({"event": "serving_ready", "params_step": step,
+                          "model": agent.model.name,
+                          "max_batch": cfg.serve.max_batch,
+                          "slots": cfg.serve.slots}), flush=True)
+
+        sessions = make_sessions(prices, cfg.env.window, args.sessions,
+                                 seed=cfg.seed)
+        if args.rate > 0:
+            stats = run_open_loop(engine, sessions, rate_qps=args.rate,
+                                  duration_s=args.duration, stop=stop_evt)
+        else:
+            stats = run_closed_loop(
+                engine, sessions, concurrency=cfg.serve.max_batch,
+                duration_s=args.duration, stop=stop_evt)
+
+        # Drain inside the preemption grace budget, flush telemetry.
+        grace = cfg.runtime.preempt_grace_s
+        drained = engine.drain(timeout_s=grace)
+        obs_bundle.flush()
+        counters = registry.counters()
+        summary = {
+            **stats,
+            "params_step": engine.params_step,
+            "swaps": int(counters.get("serve_swaps_total", 0)),
+            "swap_rejected": int(
+                counters.get("serve_swap_rejected_total", 0)),
+            "evictions": int(counters.get("serve_evictions_total", 0)),
+            "prefills": int(counters.get("serve_prefills_total", 0)),
+            "requests": int(counters.get("serve_requests_total", 0)),
+            "drained": drained,
+        }
+        if preempt_at:
+            summary["preempted"] = True
+            log.warning("serve run preempted; in-flight requests %s",
+                        "drained" if drained else "NOT fully drained")
+        print(json.dumps(summary))
+        return EXIT_PREEMPTED if preempt_at else 0
+    finally:
+        for s, h in prev_handlers.items():
+            signal.signal(s, h)
+        if watcher is not None:
+            watcher.stop()
+        if engine is not None:
+            engine.stop(drain=False)
+        if obs_bundle is not None:
+            obs_bundle.close()
+        service.close()
+
+
 def cmd_obs(args) -> int:
     """Summarize a telemetry run dir (obs.enabled=true output): manifest
     identity, span aggregates from the Chrome trace, metrics tail, and the
@@ -243,7 +389,8 @@ def main(argv=None) -> int:
     parser.add_argument("--verbose", action="store_true")
     sub = parser.add_subparsers(dest="command", required=True)
 
-    for name, fn in [("train", cmd_train), ("query", cmd_query)]:
+    for name, fn in [("train", cmd_train), ("query", cmd_query),
+                     ("serve", cmd_serve)]:
         p = sub.add_parser(name)
         p.add_argument("--config", default=None, help="JSON config file")
         p.add_argument("--set", action="append", default=[],
@@ -263,6 +410,15 @@ def main(argv=None) -> int:
             p.add_argument("--eval-best", action="store_true",
                            help="also evaluate the retained best-eval "
                                 "checkpoint (runtime.keep_best_eval)")
+        if name == "serve":
+            p.add_argument("--duration", type=float, default=10.0,
+                           help="seconds to serve the synthetic load "
+                                "(SIGTERM drains and exits 75 earlier)")
+            p.add_argument("--sessions", type=int, default=512,
+                           help="synthetic user sessions to replay")
+            p.add_argument("--rate", type=float, default=0.0,
+                           help="open-loop offered QPS; 0 = closed loop "
+                                "at serve.max_batch concurrency")
         p.set_defaults(fn=fn)
 
     p = sub.add_parser("obs", help="summarize a telemetry run dir")

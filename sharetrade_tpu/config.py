@@ -405,6 +405,53 @@ class RuntimeConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Continuous-batching inference tier (serve/engine.py) — ROADMAP
+    item 2's low-latency policy-inference service, decoupled from
+    training.
+
+    The engine coalesces per-user ``(window, portfolio)`` queries into
+    padded device batches under a deadline and keeps a fixed-capacity
+    device-resident SESSION SLOT POOL — a ``(slots, ...)`` arena of
+    per-session recurrent carries (the episode transformer's incremental
+    K/V cache repurposed as a per-session serving cache) with LRU
+    admission/eviction and batched re-prefill for cold sessions — so
+    steady-state serving is ONE jitted batched program per tick instead
+    of a dispatch per request (the TF-Agents batched-simulation thesis,
+    arxiv 1709.02878, applied to inference)."""
+
+    # Padded device batch per serving tick: the ONE compiled program's
+    # batch dimension. Larger amortizes dispatch over more requests;
+    # latency under light load is bounded by batch_timeout_ms, not this.
+    max_batch: int = 64
+    # Deadline to coalesce a partial batch (milliseconds): the dispatcher
+    # sends whatever arrived once the FIRST request of a batch has waited
+    # this long (work-conserving — a full batch never waits). 0 = dispatch
+    # immediately with whatever is queued.
+    batch_timeout_ms: float = 2.0
+    # Session slot-pool capacity: how many sessions keep their device-
+    # resident carry (K/V cache) between requests. Must be >= max_batch
+    # (a batch's sessions all need live slots). An evicted session that
+    # returns is COLD: it re-enters through the batched prefill and its
+    # episode restarts from its request's window (README "Serving tier"
+    # slot-pool contract).
+    slots: int = 256
+    # Hot weight swap: poll the training run's tagged checkpoint at this
+    # cadence and swap serving params atomically between batches when it
+    # advances; restores go through the PR-5 verified path (checksums +
+    # finite check + precision-mode check) and a corrupt candidate is
+    # refused without interrupting serving. 0 disables the watcher.
+    swap_poll_s: float = 5.0
+    swap_tag: str = "best"
+    # SLO gauge publication cadence (serve_qps / serve_p50_ms /
+    # serve_p99_ms / serve_batch_occupancy / serve_queue_depth through
+    # MetricsRegistry -> metrics.prom).
+    stats_interval_s: float = 1.0
+    # Per-request latency ring the percentile gauges are computed over.
+    latency_window: int = 8192
+
+
+@dataclass
 class ObsConfig:
     """Telemetry (obs/): span trace, metrics export, crash flight recorder.
 
@@ -463,6 +510,7 @@ class FrameworkConfig:
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     precision: PrecisionConfig = field(default_factory=PrecisionConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     seed: int = 0
 
@@ -543,5 +591,6 @@ _NESTED = {
     "runtime": RuntimeConfig,
     "checkpoint": CheckpointConfig,
     "precision": PrecisionConfig,
+    "serve": ServeConfig,
     "obs": ObsConfig,
 }

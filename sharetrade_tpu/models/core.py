@@ -109,6 +109,26 @@ class Model:
     # with ModelOut-equivalent totals base + pf (pinned by
     # tests/test_models.py::test_factored_rollout_head_matches_exact).
     rollout_head_factored: Callable | None = None
+    # Optional SERVING pair (serve/engine.py — the continuous-batching
+    # inference tier). Models with a prefill/incremental split provide
+    # both; stateless or simple-carry models need neither (the engine
+    # runs ``apply_batched`` over slot-gathered carries, which imposes no
+    # cross-row constraint).
+    #
+    # apply_prefill(params, obs (B, obs_dim)) -> (ModelOut batched,
+    #   carry_batch) — the episode-start forward for a COLD batch (every
+    #   row a fresh session). Rows are independent: unlike
+    #   ``apply_batch``'s t[0] dispatch, no lockstep assumption.
+    # apply_serve_batch(params, obs (B, obs_dim), carry_batch) ->
+    #   (ModelOut batched, carry_batch) — one incremental step for a WARM
+    #   batch whose rows sit at HETEROGENEOUS episode steps (per-row ring
+    #   slots). This is exactly the invariant a serving batch violates in
+    #   ``apply_batch``: training batches step in lockstep, user sessions
+    #   don't.
+    apply_prefill: Callable[[Any, jax.Array],
+                            tuple[ModelOut, Any]] | None = None
+    apply_serve_batch: Callable[[Any, jax.Array, Any],
+                                tuple[ModelOut, Any]] | None = None
     # Optional precision hook: cast_carry(carry, compute_dtype) -> carry,
     # casting exactly the carry leaves the model's forward produces in the
     # compute dtype (K/V caches, recurrent cells). The precision policy

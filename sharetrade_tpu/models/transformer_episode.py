@@ -612,6 +612,73 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
                  "hist": hist, "t": carry["t"] + 1}
         return ModelOut(logits=logits, value=values, aux=aux), carry
 
+    def _incremental_serve(params, obs, carry):
+        """One-token step for a batch at HETEROGENEOUS episode steps — the
+        serving batch (serve/engine.py). Same math as :func:`_incremental`
+        (layer norm → qkv → RoPE at per-row absolute positions → ring
+        write → cache attention → FFN → heads), with the ONE lockstep
+        dependency removed: the ring slot is computed PER ROW
+        (``mod(t_i - 1, window)``) and the cache write is a vmapped
+        ``dynamic_update_slice``, so each session writes its own slot
+        regardless of where its neighbors sit in their episodes. Kept as a
+        separate function rather than generalizing ``_incremental``: the
+        training path's scalar-slot write is part of the pinned fp32
+        golden trajectory (tests/golden/), and a scatter-lowered write
+        there would change the compiled program for zero training
+        benefit. Every row must be WARM (t >= 1) — cold rows belong to
+        the batched prefill."""
+        bsz = obs.shape[0]
+        dtype = compute_dtype(params)
+        new, prev = obs[:, window - 1], obs[:, window - 2]
+        ret = (jnp.log(jnp.maximum(new, _EPS))
+               - jnp.log(jnp.maximum(prev, _EPS)))
+        tok = jnp.stack([ret, jnp.abs(ret), jnp.zeros_like(ret)], axis=-1)
+        x = dense(params["embed"], tok.astype(dtype))[:, None, :]
+        pos = (carry["t"] + window - 1).astype(jnp.int32)[:, None]  # (B, 1)
+        slots = jnp.mod(carry["t"] - 1, window).astype(jnp.int32)   # (B,)
+
+        k_cache, v_cache = carry["k"], carry["v"]     # (B, L, H, W, Dh)
+        aux = jnp.float32(0.0)
+        for li, blk in enumerate(blocks_of(params)):
+            h = _layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
+            qkv = dense(blk["qkv"], h).reshape(bsz, 1, 3, num_heads, head_dim)
+            q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
+            q = _rope(q, pos)
+            k = _rope(k, pos)
+
+            def write_ring(cache, row, slot, _li=li):
+                # cache (L, H, W, Dh) one session; row (H, 1, Dh).
+                zero = jnp.int32(0)
+                return jax.lax.dynamic_update_slice(
+                    cache, row[None], (jnp.int32(_li), zero, slot, zero))
+
+            k_cache = jax.vmap(write_ring)(k_cache, k, slots)
+            v_cache = jax.vmap(write_ring)(v_cache, v, slots)
+            k_all, v_all = k_cache[:, li], v_cache[:, li]
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k_all,
+                           preferred_element_type=jnp.float32) * sm_scale
+            probs = jax.nn.softmax(s, axis=-1).astype(v_all.dtype)
+            attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v_all)
+            attn = attn.transpose(0, 2, 1, 3).reshape(
+                bsz, 1, d_model).astype(dtype)
+            x = x + dense(blk["proj"], attn)
+            h = _layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
+            y, blk_aux = block_ffn(blk, h)
+            x = x + y
+            aux = aux + blk_aux
+        hn = _layer_norm(x[:, 0], params["final_ln"]["scale"],
+                         params["final_ln"]["bias"])
+        hn = hn + dense(params["port"], _port_feats(
+            obs[:, window], obs[:, window + 1], new).astype(dtype))
+        logits = dense(params["policy"], hn).astype(jnp.float32)
+        values = dense(params["value"], hn).astype(jnp.float32)[..., 0]
+        hist = carry["hist"]
+        if hist_len:
+            hist = jnp.concatenate([hist[:, 1:], obs[:, :1]], axis=1)
+        out_carry = {"k": k_cache, "v": v_cache,
+                     "hist": hist, "t": carry["t"] + 1}
+        return ModelOut(logits=logits, value=values, aux=aux), out_carry
+
     def apply_batch(params, obs, carry):
         """Batched rollout step.
 
@@ -859,6 +926,8 @@ def episode_transformer_policy(obs_dim: int = 203, num_actions: int = 3, *,
     return Model(init=init, apply=apply, apply_batch=apply_batch,
                  apply_unroll=apply_unroll, init_carry=init_carry,
                  cast_carry=cast_carry_fn,
+                 apply_prefill=_prefill,
+                 apply_serve_batch=_incremental_serve,
                  apply_unroll_shared=apply_unroll_shared,
                  apply_rollout_trunk=apply_rollout_trunk,
                  apply_rollout_head=apply_rollout_head,

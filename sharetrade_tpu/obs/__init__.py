@@ -204,6 +204,27 @@ def summarize_run_dir(run_dir: str) -> dict:
             "prom_file": os.path.isfile(
                 os.path.join(run_dir, "metrics.prom")),
         }
+        gauges = (last_rec or {}).get("gauges") or {}
+        if any(k.startswith("serve_") for k in list(gauges)
+               + list(counters)):
+            # Serving tier (``cli serve`` run dirs): the SLO surface in
+            # one glanceable block — QPS, latency percentiles, batching
+            # health — without the operator knowing the registry keys.
+            out["serve"] = {
+                "qps": gauges.get("serve_qps"),
+                "p50_ms": gauges.get("serve_p50_ms"),
+                "p99_ms": gauges.get("serve_p99_ms"),
+                "batch_occupancy": gauges.get("serve_batch_occupancy"),
+                "queue_depth": gauges.get("serve_queue_depth"),
+                "requests_total": counters.get("serve_requests_total", 0.0),
+                "batches_total": counters.get("serve_batches_total", 0.0),
+                "prefills_total": counters.get("serve_prefills_total", 0.0),
+                "evictions_total": counters.get(
+                    "serve_evictions_total", 0.0),
+                "swaps_total": counters.get("serve_swaps_total", 0.0),
+                "swaps_rejected_total": counters.get(
+                    "serve_swap_rejected_total", 0.0),
+            }
     roofline = read_roofline(run_dir)
     if roofline is not None:
         out["roofline"] = summarize_roofline(roofline)

@@ -1,0 +1,18 @@
+"""Serving tier: continuous-batching policy inference (ROADMAP item 2).
+
+- :mod:`engine` — :class:`ServeEngine`: deadline-coalesced padded device
+  batches over a device-resident session slot pool (LRU admission /
+  eviction, batched re-prefill), dispatcher/consumer split, SLO gauges.
+- :mod:`swap` — :class:`WeightSwapWatcher`: hot weight swaps from the
+  crash-safe tagged checkpoint through the verified restore path, applied
+  atomically between batches.
+- :mod:`driver` — synthetic portfolio sessions + closed/open-loop load
+  harnesses (``cli serve``, ``tools/serve_soak.py``, ``bench_serve``).
+"""
+
+from sharetrade_tpu.serve.engine import (  # noqa: F401
+    ServeEngine,
+    ServeResult,
+    SlotPool,
+)
+from sharetrade_tpu.serve.swap import WeightSwapWatcher  # noqa: F401

@@ -1,0 +1,325 @@
+"""Synthetic portfolio-session load for the serving tier.
+
+One :class:`SessionSim` is one "user": a cursor into a price series plus a
+host-side portfolio that follows the served actions (the user obeys the
+policy — the same trade rules as ``env/trading.py`` applied on the host).
+Thousands of them replayed against a :class:`~sharetrade_tpu.serve.engine.
+ServeEngine` are the load shape the ISSUE's soak generates, with staggered
+series offsets so sessions are genuinely heterogeneous (different episode
+clocks, different portfolios — exactly what the per-row serve batch
+handles and a lockstep training batch cannot).
+
+Two measurement harnesses, both engine-agnostic (anything with the
+``submit(session_id, obs, callback=) -> handle`` surface):
+
+- :func:`run_closed_loop` — ``concurrency`` sessions each keep exactly one
+  request in flight (submit-on-completion). ``concurrency=1`` against
+  :class:`BatchOneServer` is THE batch=1 closed-loop baseline: one
+  dispatch, one blocking readback per request — the per-request server the
+  continuous-batching engine replaces.
+- :func:`run_open_loop` — arrivals at a fixed offered rate regardless of
+  completions (the "heavy traffic" shape): sessions without an in-flight
+  request are scheduled round-robin; when every session is busy the
+  arrival is counted ``dropped`` (the queue already holds one request per
+  live session — unbounded pile-up would measure the generator, not the
+  server).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from sharetrade_tpu.env.trading import BUY, SELL
+from sharetrade_tpu.serve.engine import latency_percentiles
+from sharetrade_tpu.utils.logging import get_logger
+
+log = get_logger("serve.driver")
+
+
+class SessionSim:
+    """One synthetic user session over a price series."""
+
+    def __init__(self, session_id: Any, prices: np.ndarray, window: int,
+                 start: int, *, budget: float = 2400.0, shares: float = 0.0):
+        self.session_id = session_id
+        self.prices = prices
+        self.window = window
+        self.start = int(start)
+        self.t = 0
+        self.budget = float(budget)
+        self.shares = float(shares)
+        self.generation = 0         # bumps on wrap → fresh session id
+
+    @property
+    def sid(self) -> Any:
+        """The WIRE session id: wraps restart the episode under a new id
+        (user churn — naturally exercises eviction + cold re-admission)."""
+        return (self.session_id if self.generation == 0
+                else f"{self.session_id}#{self.generation}")
+
+    def observation(self) -> np.ndarray:
+        lo = self.start + self.t
+        return np.concatenate(
+            [self.prices[lo:lo + self.window],
+             np.asarray([self.budget, self.shares], np.float32)]
+        ).astype(np.float32)
+
+    def advance(self, action: int) -> None:
+        """Apply the served action with the env's trade rules, move one
+        tick; restart (new generation, fresh portfolio) at series end."""
+        price = float(self.prices[self.start + self.t + self.window])
+        if action == BUY and self.budget >= price:
+            self.budget -= price
+            self.shares += 1.0
+        elif action == SELL and self.shares > 0:
+            self.budget += price
+            self.shares -= 1.0
+        self.t += 1
+        if self.start + self.t + self.window >= len(self.prices):
+            self.t = 0
+            self.budget = 2400.0
+            self.shares = 0.0
+            self.generation += 1
+
+
+def make_sessions(prices: Any, window: int, n: int, *,
+                  seed: int = 0, prefix: str = "s") -> list[SessionSim]:
+    """``n`` sessions with staggered starts across the series. ``prefix``
+    namespaces the session ids — measurement phases that share one engine
+    must not reuse ids, or a "fresh" session would silently hit its
+    predecessor's still-warm slot carry instead of prefilling."""
+    prices = np.asarray(prices, np.float32)
+    horizon = len(prices) - window - 1
+    if horizon < 1:
+        raise ValueError(f"price series too short for window={window}")
+    rng = np.random.default_rng(seed)
+    starts = rng.integers(0, max(horizon - 1, 1), size=n)
+    return [SessionSim(f"{prefix}{i}", prices, window, starts[i])
+            for i in range(n)]
+
+
+class BatchOneServer:
+    """The per-request-dispatch baseline: same submit surface as
+    :class:`ServeEngine`, but every request is one jitted B=1 ``apply``
+    with a blocking readback, carries threaded per session on the host —
+    the closed-loop batch=1 server ``bench_serve`` compares against."""
+
+    #: Bound on retained per-session carries: wrapped sessions mint fresh
+    #: generation-suffixed ids, so an unbounded dict would leak every dead
+    #: generation's K/V carry over a long soak — evicted LRU like the
+    #: engine's slot pool (the baseline must not slow down from its own
+    #: memory growth mid-comparison).
+    MAX_CARRIES = 4096
+
+    def __init__(self, model: Any, params: Any, *, precision=None):
+        from collections import OrderedDict
+
+        from sharetrade_tpu.precision import FP32
+        precision = precision or FP32
+        self.model = model
+        self._params = jax.device_put(precision.cast_compute(params))
+        self._carry0 = precision.cast_carry(model.init_carry(), model)
+        self._apply = jax.jit(model.apply)
+        self._carries: "OrderedDict[Any, Any]" = OrderedDict()
+        self._q: "deque[tuple]" = deque()
+        self._cv = threading.Condition()
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="b1-server", daemon=True)
+        self._thread.start()
+
+    def warmup(self) -> None:
+        obs_dim = getattr(self.model, "obs_dim", 0) or 3
+        out, _ = self._apply(self._params,
+                             np.full((obs_dim,), 10.0, np.float32),
+                             self._carry0)
+        np.asarray(out.logits)
+
+    def submit(self, session_id: Any, obs: Any,
+               callback: Callable | None = None):
+        event = threading.Event()
+        slot: list = [None]
+        with self._cv:
+            self._q.append((session_id, np.asarray(obs, np.float32),
+                            callback, event, slot, time.perf_counter()))
+            self._cv.notify()
+
+        class _H:                   # minimal handle mirroring _Request
+            def wait(_self, timeout=None):
+                event.wait(timeout)
+                return slot[0]
+        return _H()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._cv:
+                if not self._q:
+                    return True
+            time.sleep(0.002)
+        return False
+
+    def stop(self, **_kw) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify()
+        self._thread.join(10.0)
+
+    def _loop(self) -> None:
+        from sharetrade_tpu.serve.engine import ServeResult
+        while True:
+            with self._cv:
+                while not self._q and not self._stopped:
+                    self._cv.wait(0.05)
+                if self._stopped and not self._q:
+                    return
+                if not self._q:
+                    continue
+                sid, obs, callback, event, slot, t_enq = self._q.popleft()
+            carry = self._carries.get(sid)
+            if carry is None:
+                carry = self._carry0
+            else:
+                self._carries.move_to_end(sid)
+            out, carry = self._apply(self._params, obs, carry)
+            logits = np.asarray(out.logits)      # the per-request sync
+            self._carries[sid] = carry
+            if len(self._carries) > self.MAX_CARRIES:
+                self._carries.popitem(last=False)
+            result = ServeResult(
+                session_id=sid, action=int(np.argmax(logits)),
+                logits=logits, value=float(np.asarray(out.value)),
+                params_step=0,
+                latency_ms=(time.perf_counter() - t_enq) * 1e3)
+            slot[0] = result
+            event.set()
+            if callback is not None:
+                callback(result)
+
+
+_percentiles = latency_percentiles   # one quantile convention, serve-wide
+
+
+def run_closed_loop(server: Any, sessions: list[SessionSim], *,
+                    concurrency: int, duration_s: float,
+                    stop: threading.Event | None = None) -> dict:
+    """``concurrency`` sessions each keep one request in flight for
+    ``duration_s``; returns achieved QPS + latency percentiles."""
+    lock = threading.Lock()
+    lat: list[float] = []
+    done_evt = threading.Event()
+    state = {"inflight": 0, "closing": False}
+    t_end = time.perf_counter() + duration_s
+
+    def resubmit(sess: SessionSim) -> None:
+        def cb(result, _sess=sess):
+            # result None = the request's batch failed to dispatch: the
+            # session didn't advance; keep it in the loop without
+            # recording a latency.
+            if result is not None:
+                with lock:
+                    lat.append(result.latency_ms)
+                _sess.advance(result.action)
+            now = time.perf_counter()
+            if now < t_end and not (stop is not None and stop.is_set()):
+                server.submit(_sess.sid, _sess.observation(), cb)
+            else:
+                with lock:
+                    state["inflight"] -= 1
+                    if state["inflight"] == 0:
+                        done_evt.set()
+        server.submit(sess.sid, sess.observation(), cb)
+
+    t0 = time.perf_counter()
+    with lock:
+        state["inflight"] = min(concurrency, len(sessions))
+    for sess in sessions[:concurrency]:
+        resubmit(sess)
+    done_evt.wait(duration_s + 30.0)
+    elapsed = time.perf_counter() - t0
+    with lock:
+        n = len(lat)
+    return {"mode": "closed_loop", "concurrency": concurrency,
+            "completed": n, "elapsed_s": elapsed,
+            "qps": n / max(elapsed, 1e-9), **_percentiles(lat)}
+
+
+def run_open_loop(server: Any, sessions: list[SessionSim], *,
+                  rate_qps: float, duration_s: float,
+                  stop: threading.Event | None = None) -> dict:
+    """Offered-rate arrivals for ``duration_s``: each arrival picks the
+    next session with no request in flight (round-robin); arrivals finding
+    every session busy count as ``dropped``. Returns offered vs achieved
+    QPS + latency percentiles."""
+    lock = threading.Lock()
+    lat: list[float] = []
+    ready: deque[SessionSim] = deque(sessions)
+    offered = dropped = 0
+    inflight = {"n": 0, "last_done": time.perf_counter()}
+    idle_evt = threading.Event()
+
+    def cb_for(sess: SessionSim):
+        def cb(result, _sess=sess):
+            with lock:
+                if result is not None:
+                    lat.append(result.latency_ms)
+                    inflight["last_done"] = time.perf_counter()
+                inflight["n"] -= 1
+                if inflight["n"] == 0:
+                    idle_evt.set()
+            if result is not None:
+                _sess.advance(result.action)
+            with lock:
+                ready.append(_sess)      # failed or not, back in rotation
+        return cb
+
+    spacing = 1.0 / max(rate_qps, 1e-9)
+    t0 = time.perf_counter()
+    t_end = t0 + duration_s
+    gen_end = t_end
+    issued = 0
+    while True:
+        now = time.perf_counter()
+        if now >= t_end or (stop is not None and stop.is_set()):
+            gen_end = now
+            break
+        # Burst catch-up: issue every arrival DUE by the wall clock in one
+        # go, so sleep jitter and GIL contention shift arrival timing but
+        # never silently lower the offered rate.
+        due = int((now - t0) / spacing) + 1 - issued
+        if due <= 0:
+            time.sleep(min(t0 + issued * spacing - now, 0.001))
+            continue
+        for _ in range(min(due, 512)):
+            issued += 1
+            offered += 1
+            with lock:
+                sess = ready.popleft() if ready else None
+            if sess is None:
+                dropped += 1
+                continue
+            with lock:
+                inflight["n"] += 1
+                idle_evt.clear()
+            server.submit(sess.sid, sess.observation(), cb_for(sess))
+    # Let the tail of in-flight requests complete before measuring; QPS is
+    # counted over [start, max(last completion, generation span)] — a long
+    # drain tail doesn't dilute the achieved rate, a generator that idled
+    # out its full window still divides by that window, and an
+    # early-STOPPED run (SIGTERM preemption) divides by the span it
+    # actually ran, not the requested duration.
+    idle_evt.wait(10.0)
+    with lock:
+        n = len(lat)
+        elapsed = max(inflight["last_done"] - t0,
+                      min(duration_s, gen_end - t0))
+    return {"mode": "open_loop", "rate_qps": rate_qps,
+            "offered": offered, "dropped": dropped, "completed": n,
+            "elapsed_s": elapsed, "qps": n / max(elapsed, 1e-9),
+            **_percentiles(lat)}

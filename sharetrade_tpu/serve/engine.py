@@ -1,0 +1,649 @@
+"""Continuous-batching inference engine: one device program per tick.
+
+The training side of this repo compiles everything; nothing served. This
+module is ROADMAP item 2's serving tier: a policy-inference engine that
+coalesces per-user ``(window, portfolio)`` queries into padded device
+batches under a deadline (``serve.max_batch`` / ``serve.batch_timeout_ms``)
+and keeps a fixed-capacity device-resident SESSION SLOT POOL — a
+``(slots + max_batch, ...)`` arena of per-session recurrent carries, the
+episode transformer's incremental K/V cache repurposed as a per-session
+serving cache — so steady-state serving is ONE jitted batched program per
+tick instead of a dispatch per request. That is the TF-Agents
+batched-simulation thesis (arxiv 1709.02878) applied to inference, and
+RLAX's TPU inference/learner decoupling (arxiv 2512.06392): throughput
+comes from keeping one big batched program resident, not from many small
+calls.
+
+Structure (mirrors ``runtime/pipeline.py``'s dispatcher/consumer split):
+
+- **submit** (any thread): enqueue a request; returns a waitable handle.
+- **dispatcher thread** (``_serve_loop``): coalesce a batch (first request
+  waits at most ``batch_timeout_ms``; a full batch never waits), admit
+  sessions into the slot pool (LRU eviction; evicted sessions restart COLD
+  through the batched prefill), and dispatch the jitted program(s) for the
+  tick — asynchronously, so collection of tick k+1 overlaps device compute
+  of tick k. No blocking host work happens here (tools/lint_hot_loop.py
+  check 8).
+- **consumer thread** (``_complete_batch``): device readback, request
+  completion (events + callbacks), latency accounting, SLO gauge
+  publication through ``MetricsRegistry`` (→ ``metrics.prom`` when obs
+  export is on). The dispatcher→consumer queue is bounded, so in-flight
+  device buffers are bounded and dispatch backpressures instead of racing
+  ahead.
+
+Weight swaps are ATOMIC between batches: :meth:`ServeEngine.swap_params`
+replaces one ``(params, step)`` reference; the dispatcher reads it exactly
+once per tick, so every response is attributable to exactly one checkpoint
+step and no batch ever sees mixed weights (serve/swap.py is the
+``tag_best`` watcher that calls it through the verified restore path).
+
+Model contract: models providing ``apply_prefill``/``apply_serve_batch``
+(the episode transformer) get the two-program cold/warm split — per-row
+episode clocks, heterogeneous sessions in one batch. Everything else is
+served through ``apply_batched`` in one program with an in-program cold-row
+carry reset (stateless models like the MLP carry ``()`` and the pool is
+structurally empty).
+
+Parity contract (tests/test_serve.py): under fp32 the batched engine
+returns BIT-IDENTICAL logits/actions to threading each session one at a
+time through ``model.apply`` — batching is a scheduling optimization,
+never a numerics change. bf16_mixed serving inherits the PR-7 tolerance
+contract instead.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sharetrade_tpu.config import ConfigError, ServeConfig
+from sharetrade_tpu.models.core import apply_batched
+from sharetrade_tpu.precision import FP32, PrecisionPolicy
+from sharetrade_tpu.utils.logging import get_logger
+from sharetrade_tpu.utils.metrics import MetricsRegistry
+
+log = get_logger("serve")
+
+_SHUTDOWN = object()
+
+
+def latency_percentiles(values) -> dict[str, float]:
+    """p50/p99/mean over a latency sample, ONE quantile convention for the
+    whole serving tier (the SLO gauges here and the load harnesses in
+    serve/driver.py — BASELINE.md compares the two directly, so their
+    percentile math must never diverge)."""
+    if not len(values):
+        return {"p50_ms": 0.0, "p99_ms": 0.0, "mean_ms": 0.0}
+    arr = np.sort(np.asarray(values, np.float64))
+    return {
+        "p50_ms": float(arr[int(0.50 * (len(arr) - 1))]),
+        "p99_ms": float(arr[int(0.99 * (len(arr) - 1))]),
+        "mean_ms": float(arr.mean()),
+    }
+
+
+class ServeResult(NamedTuple):
+    """One completed inference: the action plus enough provenance to audit
+    it (``params_step`` names the exact checkpoint that produced it — the
+    hot-swap atomicity observable)."""
+
+    session_id: Any
+    action: int
+    logits: np.ndarray
+    value: float
+    params_step: int
+    latency_ms: float
+
+
+class _Live(NamedTuple):
+    """The serving weights as ONE immutable reference: swapped atomically
+    (a single attribute store), read exactly once per dispatch tick."""
+
+    params: Any
+    step: int
+
+
+class _Request:
+    """A submitted query; completed by the consumer thread."""
+
+    __slots__ = ("session_id", "obs", "t_enq", "callback", "_event",
+                 "result", "error")
+
+    def __init__(self, session_id: Any, obs: np.ndarray,
+                 callback: Callable[[ServeResult | None], None] | None):
+        self.session_id = session_id
+        self.obs = obs
+        self.t_enq = time.perf_counter()
+        self.callback = callback
+        self._event = threading.Event()
+        self.result: ServeResult | None = None
+        #: Set when the request's batch failed to dispatch — lets callers
+        #: distinguish a served-nothing failure from a wait() timeout.
+        self.error: BaseException | None = None
+
+    def wait(self, timeout: float | None = None) -> ServeResult | None:
+        """Block until the response is ready; None on timeout or when the
+        request's batch failed (then :attr:`error` carries the cause)."""
+        self._event.wait(timeout)
+        return self.result
+
+
+class _DoneBatch(NamedTuple):
+    """One dispatched tick handed dispatcher→consumer: per-program request
+    groups with their (still device-resident) outputs."""
+
+    groups: list[tuple[list[_Request], Any, Any, Any]]  # (reqs, act, log, val)
+    step: int
+    n: int                 # real rows in the tick
+    cold: int              # rows served through the prefill
+    evicted: int           # sessions evicted to admit this tick's rows
+
+
+class SlotPool:
+    """Host-side session→slot map with LRU eviction.
+
+    The carries themselves live on DEVICE in the engine's arena; this class
+    owns only the mapping and the recency order. ``admit`` never evicts a
+    session pinned by the current batch (its slot is about to be read or
+    written) — with ``capacity >= max_batch`` an unpinned victim or a free
+    slot always exists."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._lru: OrderedDict[Any, int] = OrderedDict()  # oldest first
+        self._free = list(range(capacity))
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def lookup(self, session_id: Any) -> int | None:
+        """Slot of a WARM session (refreshes its recency); None when the
+        session is absent (never admitted, or evicted — cold either way)."""
+        slot = self._lru.get(session_id)
+        if slot is not None:
+            self._lru.move_to_end(session_id)
+        return slot
+
+    def drop(self, session_id: Any) -> None:
+        """Forget a session (its slot returns to the free list) — the
+        dispatch-fault path, where an admitted slot may never have
+        received its prefilled carry."""
+        slot = self._lru.pop(session_id, None)
+        if slot is not None:
+            self._free.append(slot)
+
+    def admit(self, session_id: Any, pinned: set) -> tuple[int, Any | None]:
+        """Assign a slot to a NEW session; returns ``(slot, evicted_sid)``
+        (``evicted_sid`` None when a free slot absorbed the admission)."""
+        if self._free:
+            slot = self._free.pop()
+            self._lru[session_id] = slot
+            return slot, None
+        for victim in self._lru:                       # oldest first
+            if victim not in pinned:
+                slot = self._lru.pop(victim)
+                self._lru[session_id] = slot
+                self.evictions += 1
+                return slot, victim
+        raise RuntimeError(
+            "slot pool exhausted by pinned sessions (capacity < max_batch "
+            "should have been rejected at construction)")
+
+
+class ServeEngine:
+    """See the module docstring. Construct, :meth:`warmup` (optional but
+    recommended — compiles the serving programs before traffic), submit
+    from any thread, :meth:`stop` when done."""
+
+    def __init__(self, model: Any, cfg: ServeConfig, params: Any, *,
+                 params_step: int = 0,
+                 precision: PrecisionPolicy = FP32,
+                 registry: MetricsRegistry | None = None,
+                 obs: Any = None,
+                 done_depth: int = 4):
+        if cfg.max_batch < 1:
+            raise ConfigError(
+                f"serve.max_batch must be >= 1, got {cfg.max_batch}")
+        if cfg.slots < cfg.max_batch:
+            raise ConfigError(
+                f"serve.slots ({cfg.slots}) must be >= serve.max_batch "
+                f"({cfg.max_batch}): every session of a full batch needs a "
+                "live slot")
+        if cfg.batch_timeout_ms < 0:
+            raise ConfigError(
+                f"serve.batch_timeout_ms must be >= 0, got "
+                f"{cfg.batch_timeout_ms}")
+        self.model = model
+        self.cfg = cfg
+        self._precision = precision
+        self._registry = registry if registry is not None else MetricsRegistry()
+        self._obs = obs
+        self._episode = (model.apply_prefill is not None
+                         and model.apply_serve_batch is not None)
+        self._live = _Live(jax.device_put(precision.cast_compute(params)),
+                           int(params_step))
+        self._slots = SlotPool(cfg.slots)
+
+        # Device arena: one carry row per slot, plus max_batch SCRATCH rows
+        # (indices >= cfg.slots) that padding rows read/write so a partial
+        # batch can never touch a live session's slot.
+        carry0 = precision.cast_carry(model.init_carry(), model)
+        n_arena = cfg.slots + cfg.max_batch
+        self._pool = jax.tree.map(
+            lambda x: jnp.repeat(jnp.asarray(x)[None], n_arena, axis=0),
+            carry0)
+        # Per-row init carries for the generic path's in-program cold reset.
+        self._carry0_rows = jax.tree.map(
+            lambda x: jnp.repeat(jnp.asarray(x)[None], cfg.max_batch,
+                                 axis=0), carry0)
+
+        # The arena is DONATED on every backend: scatter into an aliased
+        # buffer updates in place, a non-donated pool round-trips a full
+        # arena copy per tick (measured 5.5x tick cost at the soak shape).
+        # The PR-4 CPU donation carve-out (runtime/orchestrator.py) does
+        # not apply here: its segfault was a consumer device_get racing a
+        # dispatch that donated the very state the readback came from; the
+        # pool never leaves the device, and the consumer reads only the
+        # action/logit/value outputs, which are never donated.
+        donate = (1,)
+        if self._episode:
+            self._warm_fn = jax.jit(self._warm_program, donate_argnums=donate)
+            self._cold_fn = jax.jit(self._cold_program, donate_argnums=donate)
+        else:
+            self._step_fn = jax.jit(self._generic_program,
+                                    donate_argnums=donate)
+
+        self._q: queue.Queue = queue.Queue()
+        self._deferred: deque[_Request] = deque()
+        self._done_q: queue.Queue = queue.Queue(maxsize=done_depth)
+        self._stop_event = threading.Event()
+        self._pending = 0
+        self._pending_lock = threading.Lock()
+
+        # SLO accounting (consumer-thread-owned except the latency ring's
+        # bounded deque, which is append-only from one thread anyway).
+        self._lat: deque[float] = deque(maxlen=cfg.latency_window)
+        self._stats_t = time.perf_counter()
+        self._stats_completed = 0
+        self._stats_occupancy: list[float] = []
+
+        self._dispatcher = threading.Thread(
+            target=self._serve_loop, name="serve-dispatcher", daemon=True)
+        self._consumer = threading.Thread(
+            target=self._complete_loop, name="serve-consumer", daemon=True)
+        self._dispatcher.start()
+        self._consumer.start()
+
+    # -- device programs --------------------------------------------------
+
+    def _warm_program(self, params, pool, obs, idx):
+        """One incremental step for a warm batch: gather slot carries,
+        per-row-clock serve step, scatter back. THE steady-state program."""
+        rows = jax.tree.map(lambda x: x[idx], pool)
+        out, new_rows = self.model.apply_serve_batch(params, obs, rows)
+        new_pool = jax.tree.map(lambda p, r: p.at[idx].set(r), pool,
+                                new_rows)
+        actions = jnp.argmax(out.logits, axis=-1).astype(jnp.int32)
+        return actions, out.logits, out.value, new_pool
+
+    def _cold_program(self, params, pool, obs, idx):
+        """Batched re-prefill: cold sessions (fresh or evicted) compute
+        their episode-start pass and land their carries in their slots."""
+        out, new_rows = self.model.apply_prefill(params, obs)
+        new_pool = jax.tree.map(lambda p, r: p.at[idx].set(r), pool,
+                                new_rows)
+        actions = jnp.argmax(out.logits, axis=-1).astype(jnp.int32)
+        return actions, out.logits, out.value, new_pool
+
+    def _generic_program(self, params, pool, obs, idx, cold):
+        """Single program for models without a prefill/incremental split:
+        cold rows take a fresh init carry in-program, everything else runs
+        ``apply_batched`` (no cross-row constraint to honor)."""
+        rows = jax.tree.map(lambda x: x[idx], pool)
+
+        def reset_cold(init_row, row):
+            mask = cold.reshape((-1,) + (1,) * (row.ndim - 1))
+            return jnp.where(mask, init_row, row)
+
+        rows = jax.tree.map(reset_cold, self._carry0_rows, rows)
+        out, new_rows = apply_batched(self.model, params, obs, rows)
+        new_pool = jax.tree.map(lambda p, r: p.at[idx].set(r), pool,
+                                new_rows)
+        actions = jnp.argmax(out.logits, axis=-1).astype(jnp.int32)
+        return actions, out.logits, out.value, new_pool
+
+    # -- public surface ---------------------------------------------------
+
+    def submit(self, session_id: Any, obs: Any,
+               callback: Callable[[ServeResult], None] | None = None
+               ) -> _Request:
+        """Enqueue one ``(window, portfolio)`` query; thread-safe. Returns
+        a handle whose :meth:`_Request.wait` blocks for the response;
+        ``callback(result)`` additionally fires on the consumer thread."""
+        if self._stop_event.is_set():
+            raise RuntimeError("serve engine is stopped")
+        req = _Request(session_id, np.asarray(obs, np.float32), callback)
+        with self._pending_lock:
+            self._pending += 1
+        self._registry.inc("serve_requests_total")
+        self._q.put(req)
+        return req
+
+    @property
+    def params_step(self) -> int:
+        """Checkpoint step of the CURRENT serving weights."""
+        return self._live.step
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        """The engine's metrics registry (counters + SLO gauges)."""
+        return self._registry
+
+    def swap_params(self, master_params: Any, step: int) -> None:
+        """Atomically install new serving weights between batches. The
+        dispatcher reads the live reference once per tick, so a batch
+        computes entirely under one step's weights — in-flight ticks keep
+        the old params alive until their buffers are read back."""
+        params = jax.device_put(self._precision.cast_compute(master_params))
+        self._live = _Live(params, int(step))
+        self._registry.inc("serve_swaps_total")
+        log.info("serving params swapped to step %d", int(step))
+
+    def warmup(self) -> None:
+        """Compile every serving program with a scratch-only batch (live
+        slots untouched). Call before traffic so the first real request
+        doesn't pay the compile. Must run before concurrent submits."""
+        cfg = self.cfg
+        obs_dim = getattr(self.model, "obs_dim", 0) or 3
+        obs = np.full((cfg.max_batch, obs_dim), 10.0, np.float32)
+        idx = np.arange(cfg.slots, cfg.slots + cfg.max_batch, dtype=np.int32)
+        if self._episode:
+            _, _, _, pool = self._cold_fn(self._live.params, self._pool,
+                                          obs, idx)
+            self._pool = pool
+            _, _, _, pool = self._warm_fn(self._live.params, self._pool,
+                                          obs, idx)
+            self._pool = pool
+        else:
+            cold = np.ones((cfg.max_batch,), bool)
+            _, _, _, pool = self._step_fn(self._live.params, self._pool,
+                                          obs, idx, cold)
+            self._pool = pool
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Block until every submitted request has been answered (the
+        SIGTERM drain of ``cli serve``); False on timeout."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._pending_lock:
+                if self._pending == 0:
+                    return True
+            time.sleep(0.002)
+        with self._pending_lock:
+            return self._pending == 0
+
+    def stop(self, *, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Drain (optionally), stop both threads, publish final gauges."""
+        if drain:
+            self.drain(timeout_s)
+        self._stop_event.set()
+        self._dispatcher.join(timeout_s)
+        self._done_q.put(_SHUTDOWN)
+        self._consumer.join(timeout_s)
+        self._publish_stats(force=True)
+
+    def latencies_ms(self) -> list[float]:
+        """Snapshot of the per-request latency ring (percentile source)."""
+        return list(self._lat)
+
+    # -- dispatcher thread ------------------------------------------------
+
+    def _serve_loop(self) -> None:
+        while not self._stop_event.is_set():
+            batch = self._collect_batch()
+            if not batch:
+                continue
+            live = self._live       # ONE read per tick: the atomicity seam
+            try:
+                done = self._dispatch_batch(batch, live)
+            except Exception as exc:    # noqa: BLE001 — one malformed
+                # request (bad obs shape) must fail ITS batch, not wedge
+                # the dispatcher and hang every later session.
+                self._fail_batch(batch, exc)
+                continue
+            # Bounded handoff: blocking here is the backpressure that
+            # keeps in-flight device buffers bounded (pipeline.py's put).
+            self._done_q.put(done)
+
+    def _fail_batch(self, batch: list[_Request], exc: Exception) -> None:
+        """Dispatch-fault path (off the lint-guarded closure): release the
+        batch's waiters with no result and keep serving."""
+        log.exception("serve dispatch failed for a %d-request batch: %s",
+                      len(batch), exc)
+        with self._pending_lock:
+            self._pending -= len(batch)
+        for req in batch:
+            # An admitted slot may hold a stale/garbage carry (the prefill
+            # may never have run): drop the session so its next request
+            # re-enters cold instead of reading a poisoned slot.
+            self._slots.drop(req.session_id)
+            req.error = exc
+            req._event.set()        # result stays None: waiters unblock
+            if req.callback is not None:
+                # Callback-driven clients (the load harnesses, a network
+                # front-end) must see the failure too, or the session
+                # silently leaks out of their bookkeeping.
+                try:
+                    req.callback(None)
+                except Exception:   # noqa: BLE001
+                    log.exception("serve failure callback failed")
+
+    def _collect_batch(self) -> list[_Request]:
+        """Coalesce one tick's batch: deferred same-session requests first
+        (sequential consistency per session — a session's second in-flight
+        request must see its first one's carry), then drain the queue until
+        ``max_batch`` or the deadline anchored at the FIRST request."""
+        cfg = self.cfg
+        batch: list[_Request] = []
+        seen: set = set()
+        kept: deque[_Request] = deque()
+        while self._deferred:
+            req = self._deferred.popleft()
+            if req.session_id in seen or len(batch) >= cfg.max_batch:
+                kept.append(req)
+            else:
+                batch.append(req)
+                seen.add(req.session_id)
+        self._deferred = kept
+        if not batch:
+            try:
+                req = self._q.get(timeout=0.05)
+            except queue.Empty:
+                return []
+            batch.append(req)
+            seen.add(req.session_id)
+        deadline = time.perf_counter() + cfg.batch_timeout_ms / 1e3
+        while len(batch) < cfg.max_batch:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            try:
+                req = self._q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if req.session_id in seen:
+                self._deferred.append(req)
+            else:
+                batch.append(req)
+                seen.add(req.session_id)
+        return batch
+
+    def _dispatch_batch(self, batch: list[_Request],
+                        live: _Live) -> _DoneBatch:
+        """Admit, partition cold/warm, dispatch the tick's program(s).
+        Runs on the dispatch critical path: NO blocking host ops here
+        (tools/lint_hot_loop.py check 8) — jit calls return asynchronously
+        and readback belongs to ``_complete_batch``."""
+        pinned = {r.session_id for r in batch}
+        cold_reqs: list[_Request] = []
+        cold_idx: list[int] = []
+        warm_reqs: list[_Request] = []
+        warm_idx: list[int] = []
+        evicted = 0
+        for req in batch:
+            slot = self._slots.lookup(req.session_id)
+            if slot is None:
+                slot, victim = self._slots.admit(req.session_id, pinned)
+                if victim is not None:
+                    evicted += 1
+                cold_reqs.append(req)
+                cold_idx.append(slot)
+            else:
+                warm_reqs.append(req)
+                warm_idx.append(slot)
+        # self._pool is reassigned IMMEDIATELY after each program call:
+        # the calls donate the arena, so holding the old reference across
+        # a later failure (the warm group's _pad raising after the cold
+        # program already consumed the buffer) would leave the field
+        # pointing at a deleted array and wedge every future tick.
+        groups: list[tuple[list[_Request], Any, Any, Any]] = []
+        if self._episode:
+            if cold_reqs:
+                obs, idx = self._pad(cold_reqs, cold_idx)
+                act, logit, val, self._pool = self._cold_fn(
+                    live.params, self._pool, obs, idx)
+                groups.append((cold_reqs, act, logit, val))
+            if warm_reqs:
+                obs, idx = self._pad(warm_reqs, warm_idx)
+                act, logit, val, self._pool = self._warm_fn(
+                    live.params, self._pool, obs, idx)
+                groups.append((warm_reqs, act, logit, val))
+        else:
+            reqs = cold_reqs + warm_reqs
+            cold_mask = np.zeros((self.cfg.max_batch,), bool)
+            cold_mask[:len(cold_reqs)] = True
+            obs, idx = self._pad(reqs, cold_idx + warm_idx)
+            act, logit, val, self._pool = self._step_fn(
+                live.params, self._pool, obs, idx, cold_mask)
+            groups.append((reqs, act, logit, val))
+        return _DoneBatch(groups=groups, step=live.step, n=len(batch),
+                          cold=len(cold_reqs), evicted=evicted)
+
+    def _pad(self, reqs: list[_Request],
+             idx: list[int]) -> tuple[np.ndarray, np.ndarray]:
+        """Pad a group to the static ``max_batch`` shape: padding rows
+        repeat the first real observation (finite by construction) and
+        index SCRATCH arena rows, never a live slot."""
+        cfg = self.cfg
+        obs = np.empty((cfg.max_batch, reqs[0].obs.shape[-1]), np.float32)
+        out_idx = np.empty((cfg.max_batch,), np.int32)
+        for i, req in enumerate(reqs):
+            obs[i] = req.obs
+            out_idx[i] = idx[i]
+        for i in range(len(reqs), cfg.max_batch):
+            obs[i] = reqs[0].obs
+            out_idx[i] = cfg.slots + i
+        return obs, out_idx
+
+    # -- consumer thread --------------------------------------------------
+
+    def _complete_loop(self) -> None:
+        while True:
+            item = self._done_q.get()
+            if item is _SHUTDOWN:
+                return
+            try:
+                self._complete_batch(item)
+            except Exception as exc:  # noqa: BLE001 — a completion fault
+                # (readback error, device fault) must neither wedge the
+                # dispatcher behind a full done queue NOR leak the batch's
+                # waiters: release every request not already completed,
+                # mirroring the dispatcher's _fail_batch contract.
+                log.exception("serve consumer failed completing a batch")
+                for reqs, *_ in item.groups:
+                    for req in reqs:
+                        if req._event.is_set():
+                            continue
+                        req.error = exc
+                        req._event.set()
+                        if req.callback is not None:
+                            try:
+                                req.callback(None)
+                            except Exception:   # noqa: BLE001
+                                log.exception(
+                                    "serve failure callback failed")
+
+    def _complete_batch(self, done: _DoneBatch) -> None:
+        """Readback + request completion + SLO accounting — the consumer
+        side of the split; blocking host work is EXPECTED here. The
+        pending count decrements in a finally so a mid-completion fault
+        (handled by :meth:`_complete_loop`) can never strand
+        :meth:`drain`."""
+        try:
+            for reqs, act_dev, logit_dev, val_dev in done.groups:
+                # serve-host-ok: consumer-side readback — the dispatcher
+                # never blocks on these buffers.
+                actions, logits, values = jax.device_get(
+                    (act_dev, logit_dev, val_dev))
+                now = time.perf_counter()
+                for i, req in enumerate(reqs):
+                    result = ServeResult(
+                        session_id=req.session_id,
+                        action=int(actions[i]),
+                        logits=logits[i],
+                        value=float(values[i]),
+                        params_step=done.step,
+                        latency_ms=(now - req.t_enq) * 1e3)
+                    req.result = result
+                    req._event.set()
+                    self._lat.append(result.latency_ms)
+                    if req.callback is not None:
+                        try:
+                            req.callback(result)
+                        except Exception:   # noqa: BLE001
+                            log.exception("serve result callback failed")
+        finally:
+            with self._pending_lock:
+                self._pending -= done.n
+        self._stats_completed += done.n
+        self._stats_occupancy.append(done.n / self.cfg.max_batch)
+        reg = self._registry
+        reg.inc("serve_responses_total", done.n)
+        reg.inc("serve_batches_total")
+        if done.cold:
+            reg.inc("serve_prefills_total", done.cold)
+        if done.evicted:
+            reg.inc("serve_evictions_total", done.evicted)
+        self._publish_stats()
+
+    def _publish_stats(self, *, force: bool = False) -> None:
+        """SLO gauges at ``stats_interval_s`` cadence (consumer thread)."""
+        now = time.perf_counter()
+        interval = now - self._stats_t
+        if not force and interval < self.cfg.stats_interval_s:
+            return
+        if interval <= 0:
+            return
+        row: dict[str, float] = {
+            "serve_qps": self._stats_completed / interval,
+            "serve_queue_depth": float(self._q.qsize()),
+        }
+        if self._lat:
+            pct = latency_percentiles(list(self._lat))
+            row["serve_p50_ms"] = pct["p50_ms"]
+            row["serve_p99_ms"] = pct["p99_ms"]
+        if self._stats_occupancy:
+            row["serve_batch_occupancy"] = (
+                sum(self._stats_occupancy) / len(self._stats_occupancy))
+        self._registry.record_many(row)
+        self._stats_t = now
+        self._stats_completed = 0
+        self._stats_occupancy = []

@@ -1,0 +1,124 @@
+"""Hot weight swaps for the serving tier.
+
+A background watcher polls the TRAINING run's crash-safe tagged checkpoint
+(``tag_best`` by default — the best-greedy-eval policy the orchestrator
+retains) and, when it advances, restores it through the PR-5 verified path:
+per-file SHA-256 checksums, deserializability against the template, finite
+params, and the PR-7 precision-mode check (the :class:`CheckpointManager`
+is constructed with the run's ``precision.mode``). The restored master
+weights are handed to :meth:`ServeEngine.swap_params`, which installs them
+ATOMICALLY between batches — no in-flight batch ever sees mixed weights,
+and every response names the checkpoint step that produced it.
+
+A candidate that fails verification is REFUSED without interrupting
+serving: the engine keeps its current weights, the rejection is counted
+(``serve_swap_rejected_total``), and the corrupt payload is quarantined by
+the manager's own machinery (never deleted). The watcher marks the bad
+candidate's stamp as seen so a wedged checkpoint is not re-verified every
+poll — the next genuine save carries a fresh ``saved_at`` and is picked up
+normally.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from sharetrade_tpu.checkpoint.manager import (
+    CheckpointCorruptError,
+    CheckpointIntegrityError,
+    CheckpointManager,
+)
+from sharetrade_tpu.utils.logging import get_logger
+
+log = get_logger("serve.swap")
+
+
+class WeightSwapWatcher:
+    """Poll ``tag_<tag>`` every ``poll_s`` seconds and hot-swap the engine.
+
+    ``template`` is the TrainState pytree the checkpoint deserializes into
+    (the same template a ``--resume`` would use). ``seen_meta`` seeds the
+    already-applied stamp — pass the metadata of the checkpoint the engine
+    was BOOTED from so the first poll doesn't redundantly re-swap it."""
+
+    def __init__(self, engine: Any, manager: CheckpointManager,
+                 template: Any, *, tag: str = "best",
+                 poll_s: float = 5.0, seen_meta: dict | None = None):
+        self._engine = engine
+        self._manager = manager
+        self._template = template
+        self._tag = tag
+        self._poll_s = max(float(poll_s), 0.05)
+        self._seen = self._stamp(seen_meta)
+        self._stop = threading.Event()
+        self.swaps = 0
+        self.rejected = 0
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-swap-watcher",
+                                        daemon=True)
+
+    @staticmethod
+    def _stamp(meta: dict | None):
+        if not meta:
+            return None
+        return (meta.get("saved_at"), meta.get("updates"), meta.get("step"))
+
+    def start(self) -> "WeightSwapWatcher":
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout_s)
+
+    # ------------------------------------------------------------------
+
+    def poll_once(self) -> bool:
+        """One poll: True when a swap was applied. Public so tests (and a
+        manual operator nudge) can drive the watcher synchronously."""
+        meta = self._manager.tagged_metadata(self._tag)
+        stamp = self._stamp(meta)
+        if stamp is None or stamp == self._seen:
+            return False
+        registry = getattr(self._engine, "registry", None)
+        try:
+            state, restored_meta = self._manager.restore_tagged(
+                self._template, self._tag)
+        except CheckpointCorruptError as exc:
+            # Both the tag and its .old crash-window copy failed
+            # verification: refuse, keep serving, don't re-hammer.
+            self._reject(stamp, registry, exc)
+            return False
+        except FileNotFoundError:
+            return False            # no tag yet (or quarantined away)
+        except (CheckpointIntegrityError, ValueError) as exc:
+            # ValueError = intact bytes that don't fit this run (template
+            # shape change, precision-mode mismatch): a config problem,
+            # refused loudly but serving continues.
+            self._reject(stamp, registry, exc)
+            return False
+        step = restored_meta.get("updates", restored_meta.get("step", 0))
+        self._engine.swap_params(state.params, int(step))
+        self._seen = self._stamp(restored_meta)
+        self.swaps += 1
+        return True
+
+    def _reject(self, stamp, registry, exc: BaseException) -> None:
+        self.rejected += 1
+        self._seen = stamp
+        if registry is not None:
+            registry.inc("serve_swap_rejected_total")
+        log.warning("hot-swap candidate %r refused; serving continues on "
+                    "step %d (%s: %s)", self._tag,
+                    getattr(self._engine, "params_step", -1),
+                    type(exc).__name__, exc)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            try:
+                self.poll_once()
+            except Exception:       # noqa: BLE001 — the watcher must
+                log.exception("hot-swap poll failed; serving continues")
+                # outlive any single bad poll.

@@ -67,6 +67,18 @@ in tests/test_megachunk.py:
    ``replace-fsync-ok`` naming why durability is not needed there (e.g.
    quarantining bytes that are already known-corrupt).
 
+6. **Roofline capture stays at compile time** (the roofline PR's guard) —
+   ``cost_analysis()`` / ``memory_analysis()`` / ``RooflineCapture
+   .capture()`` AOT-lower and compile a program, seconds of work that
+   must happen ONCE at build time (the ``cost_hook`` seam in
+   ``parallel/sharding.py``, the orchestrator's fallback capture), never
+   per chunk. FAILS when such a call site appears in the dispatcher
+   section (``_run_supervised``/``_boundary_actions``) or inside a
+   nested (traced) function of the device packages — the run-time half
+   of the roofline (gauge math on already-captured static costs) rides
+   the pipeline consumer and never needs these calls. Escape hatch:
+   ``roofline-capture-ok`` naming why a capture is intentionally there.
+
 7. **Params/grads casts go through the precision policy** (the
    mixed-precision PR's guard) — a bare ``.astype(`` touching params or
    gradients inside ``_run_supervised`` or a traced step closure
@@ -82,17 +94,17 @@ in tests/test_megachunk.py:
    (activation casts — a dot output that merely MENTIONS params on the
    same line — use the same marker).
 
-6. **Roofline capture stays at compile time** (the roofline PR's guard) —
-   ``cost_analysis()`` / ``memory_analysis()`` / ``RooflineCapture
-   .capture()`` AOT-lower and compile a program, seconds of work that
-   must happen ONCE at build time (the ``cost_hook`` seam in
-   ``parallel/sharding.py``, the orchestrator's fallback capture), never
-   per chunk. FAILS when such a call site appears in the dispatcher
-   section (``_run_supervised``/``_boundary_actions``) or inside a
-   nested (traced) function of the device packages — the run-time half
-   of the roofline (gauge math on already-captured static costs) rides
-   the pipeline consumer and never needs these calls. Escape hatch:
-   ``roofline-capture-ok`` naming why a capture is intentionally there.
+8. **No blocking host ops in the serve batch-dispatch closure** (the
+   serving PR's guard) — the continuous-batching engine's dispatcher
+   (``sharetrade_tpu/serve/engine.py`` ``_serve_loop`` / ``_collect_batch``
+   / ``_dispatch_batch`` / ``_pad``) sits on the per-tick critical path:
+   a ``jax.device_get`` / ``os.fsync`` / ``time.sleep`` / ``log.*()`` /
+   ``print()`` there stalls EVERY queued session's latency behind one
+   host call (check 4's dispatcher/consumer inversion, applied to
+   serving). Readback, completion, and telemetry belong to the consumer
+   side (``_complete_batch`` / ``_complete_loop``), whose existence the
+   check also enforces. Escape hatch: ``serve-host-ok`` naming why a host
+   op intentionally rides the dispatch path.
 """
 
 from __future__ import annotations
@@ -203,14 +215,16 @@ def lint_parallel_device_put() -> list[tuple[str, int, str]]:
     return bad
 
 
-def _scan_named_funcs(names, pattern, marker, *, also_find=()
+def _scan_named_funcs(names, pattern, marker, *, also_find=(),
+                      target: pathlib.Path | None = None
                       ) -> tuple[list[tuple[str, int, str]], set[str]]:
-    """Shared traversal for the orchestrator-section checks: pattern hits
-    on non-comment lines inside the named functions of TARGET (comment-
-    only lines can't dispatch anything, so prose ABOUT device_get never
-    trips a check). Returns (hits, found-function-names over ``names`` +
-    ``also_find`` — existence checks ride the same walk)."""
-    src = TARGET.read_text()
+    """Shared traversal for the named-function checks: pattern hits on
+    non-comment lines inside the named functions of ``target`` (default
+    TARGET — comment-only lines can't dispatch anything, so prose ABOUT
+    device_get never trips a check). Returns (hits, found-function-names
+    over ``names`` + ``also_find`` — existence checks ride the same
+    walk)."""
+    src = (target or TARGET).read_text()
     lines = src.splitlines()
     bad: list[tuple[str, int, str]] = []
     found: set[str] = set()
@@ -266,8 +280,37 @@ def _scan_nested_funcs(pattern, marker) -> list[tuple[str, int, str, str]]:
     return bad
 
 
+#: Check 8 (the serving PR): the serve engine's BATCH-DISPATCH closure —
+#: batch collection + program dispatch on the tick critical path — must
+#: never block on a device readback or host IO: a device_get / fsync /
+#: sleep / log call there serializes every session's latency behind one
+#: host stall (the same inversion as check 4, applied to serving). That
+#: work belongs to the engine's consumer side (``_complete_batch`` /
+#: ``_complete_loop``), which must keep existing.
+SERVE_TARGET = (pathlib.Path(__file__).resolve().parent.parent
+                / "sharetrade_tpu" / "serve" / "engine.py")
+SERVE_DISPATCH_FUNCS = ("_serve_loop", "_collect_batch", "_dispatch_batch",
+                        "_pad")
+SERVE_CONSUMER_FUNCS = ("_complete_batch", "_complete_loop")
+SERVE_BLOCK_PATTERN = re.compile(
+    r"device_get\(|os\.fsync\(|time\.sleep\(|\blog\.\w+\s*\(|"
+    r"block_until_ready\(|(?<![\w.])print\s*\(")
+#: Escape hatch for an intentional host op on the serve dispatch path.
+SERVE_MARKER = "serve-host-ok"
+
+
 def lint_hot_loop_syncs() -> tuple[list[tuple[str, int, str]], set[str]]:
     return _scan_named_funcs(HOT_FUNCS, PATTERN, MARKER)
+
+
+def lint_serve_dispatch() -> tuple[list[tuple[str, int, str]], set[str]]:
+    """Check 8: no blocking host ops (device_get / os.fsync / time.sleep /
+    logging / print) in the serve engine's batch-dispatch closure; the
+    consumer-side functions must still exist. Returns (hits, found
+    function names over SERVE_DISPATCH_FUNCS + SERVE_CONSUMER_FUNCS)."""
+    return _scan_named_funcs(SERVE_DISPATCH_FUNCS, SERVE_BLOCK_PATTERN,
+                             SERVE_MARKER, also_find=SERVE_CONSUMER_FUNCS,
+                             target=SERVE_TARGET)
 
 
 def lint_dispatcher_blocking() -> tuple[list[tuple[str, int, str]], set[str]]:
@@ -422,6 +465,26 @@ def main() -> int:
               f"'# {PRECISION_MARKER}: <why this cast is policy-"
               "sanctioned>'")
         return 1
+    serve_bad, serve_found = lint_serve_dispatch()
+    serve_missing = (set(SERVE_DISPATCH_FUNCS)
+                     | set(SERVE_CONSUMER_FUNCS)) - serve_found
+    if serve_missing:
+        print(f"serve dispatch lint: function(s) {sorted(serve_missing)} "
+              f"not found in {SERVE_TARGET} — the serve engine's "
+              "dispatcher/consumer split was renamed; update "
+              "tools/lint_hot_loop.py SERVE_DISPATCH_FUNCS/"
+              "SERVE_CONSUMER_FUNCS")
+        return 1
+    if serve_bad:
+        print(f"serve batch-dispatch lint FAILED ({SERVE_TARGET.name}):")
+        for fn, ln, text in serve_bad:
+            print(f"  {fn}:{ln}: {text}")
+        print("a blocking device_get/fsync/sleep/log in the serve "
+              "dispatch closure stalls every queued session's latency; "
+              "move it to the consumer side (_complete_batch), or tag the "
+              f"line '# {SERVE_MARKER}: <why this host op is on the "
+              "dispatch path on purpose>'")
+        return 1
     dur_bad = lint_durable_replace()
     if dur_bad:
         print("durable-rename fsync lint FAILED:")
@@ -440,6 +503,7 @@ def main() -> int:
           f"({', '.join(DISPATCHER_FUNCS)}); "
           f"roofline capture lint OK; "
           f"precision-cast lint OK; "
+          f"serve batch-dispatch lint OK ({', '.join(SERVE_DISPATCH_FUNCS)}); "
           f"durable-rename fsync lint OK ({', '.join(DURABLE_WRITE_FILES)})")
     return 0
 

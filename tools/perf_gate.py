@@ -26,8 +26,12 @@ tool turns the trajectory into a gate (``make perf-gate``, wired into
    per-quantity relative tolerance band: ``value`` (steps/s) and ``mfu``
    each default to 25% — wide enough for the measured round-to-round host
    noise (r01→r03 qlearn moved -11% with no code regression), tight
-   enough to catch a real floor change. A series with fewer than two
-   points records a note, never a failure.
+   enough to catch a real floor change. Direction is metric-aware
+   (``lower_is_better``): throughput rows (``serve_qps``, steps/s) fail
+   when they FALL below the band, latency rows (``serve_p99_ms`` — any
+   ``*_ms`` metric) fail when they RISE above it, both on the same 25%
+   band. A series with fewer than two points records a note, never a
+   failure — absent-history rows (the serve tier's first round) seed.
 
 Exit 0 = no regression; exit 1 = at least one metric fell out of its
 band (each named with its series, prior best, and observed value).
@@ -53,6 +57,15 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 
 #: Relative drop tolerated before a series fails, per gated quantity.
 DEFAULT_TOLERANCES = {"value": 0.25, "mfu": 0.25}
+
+
+def lower_is_better(metric: str) -> bool:
+    """Gate direction per metric: throughput-like metrics fail when the
+    newest value falls BELOW the band; latency-like metrics (``*_ms`` —
+    the serve tier's ``serve_p99_ms``/``serve_p50_ms``) fail when it rises
+    ABOVE it. Suffix-based so future latency rows inherit the right
+    direction without touching the gate."""
+    return metric.endswith("_ms") or metric.endswith("_latency")
 
 
 def _legacy_backend(path_keys: tuple[str, ...], row: dict) -> str:
@@ -193,8 +206,26 @@ def gate(series: dict[tuple, list[dict]],
             continue
         checked += 1
         newest = points[-1]
-        prior_best = max(points[:-1], key=lambda p: p["value"])
         tol = tolerances.get(quantity, 0.25)
+        if lower_is_better(metric):
+            # Latency series: prior best is the MINIMUM, regression is a
+            # rise past the (1 + tol) ceiling.
+            prior_best = min(points[:-1], key=lambda p: p["value"])
+            ceiling = prior_best["value"] * (1.0 + tol)
+            if newest["value"] > ceiling:
+                failures.append(
+                    f"{name}: {newest['value']:.6g} ({newest['path']}) is "
+                    f"{100 * (newest['value'] / max(prior_best['value'], 1e-12) - 1):.1f}% "
+                    f"above prior best {prior_best['value']:.6g} "
+                    f"({prior_best['path']}); tolerance {tol:.0%} "
+                    "(lower is better)")
+            else:
+                notes.append(
+                    f"{name}: {newest['value']:.6g} vs prior best "
+                    f"{prior_best['value']:.6g} — within {tol:.0%} "
+                    "(lower is better)")
+            continue
+        prior_best = max(points[:-1], key=lambda p: p["value"])
         floor = prior_best["value"] * (1.0 - tol)
         if newest["value"] < floor:
             failures.append(
