@@ -8,7 +8,7 @@
 
 PYTHON ?= python
 
-.PHONY: check test slow native bench bench-async bench-ckpt bench-dispatch bench-obs bench-precision bench-reshard bench-roofline bench-serve crash-soak obs-demo lint perf-gate serve-soak shard-audit clean
+.PHONY: check test slow native bench bench-async bench-ckpt bench-dispatch bench-obs bench-precision bench-replay bench-reshard bench-roofline bench-serve crash-soak obs-demo lint perf-gate serve-soak shard-audit clean
 
 check: native lint
 	$(PYTHON) -m pytest tests/ -q -m "not slow" -x
@@ -104,6 +104,15 @@ bench-precision:
 bench-serve:
 	$(PYTHON) -c "import json, bench; \
 	print(json.dumps(bench.bench_serve(), indent=2))"
+
+# Replay data plane A/B (journaled DQN uniform vs PER steps/s, in-chunk
+# sum-tree sample latency, journal bytes/record with rotation on, and the
+# seeded PER sample-efficiency race): the numbers behind BASELINE.md
+# "Replay data plane" and the replay_* / journal_* perf-gate series.
+# Runnable on CPU in a few minutes.
+bench-replay:
+	$(PYTHON) -c "import json, bench; \
+	print(json.dumps(bench.bench_replay(), indent=2))"
 
 # Perf-regression gate (also part of check): the newest BENCH_*.json row
 # per (metric, backend, precision) series must sit within the tolerance

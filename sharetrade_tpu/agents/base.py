@@ -172,6 +172,17 @@ def exploit_probability(step: jax.Array, cfg: LearnerConfig) -> jax.Array:
                        step.astype(jnp.float32) / cfg.epsilon_ramp_steps)
 
 
+def per_beta(step: jax.Array, cfg: LearnerConfig) -> jax.Array:
+    """Importance-sampling exponent schedule for prioritized replay:
+    anneal from ``per_beta0`` to 1 over ``per_beta_steps`` env steps (the
+    Schaul et al. schedule — bias correction tightens as the policy
+    stabilizes), the PER sibling of :func:`exploit_probability`."""
+    frac = step.astype(jnp.float32) / max(1, cfg.per_beta_steps)
+    return jnp.minimum(
+        jnp.float32(1.0),
+        jnp.float32(cfg.per_beta0) + (1.0 - cfg.per_beta0) * frac)
+
+
 def epsilon_greedy(key: jax.Array, q_values: jax.Array, step: jax.Array,
                    cfg: LearnerConfig) -> jax.Array:
     """One agent's Buy/Sell/Hold choice (QDecisionPolicyActor.scala:58-62)."""

@@ -23,12 +23,13 @@ from flax import struct
 
 from sharetrade_tpu.agents.base import (
     Agent, TrainState, batched_carry, batched_reset, build_optimizer,
-    epsilon_greedy, exploit_probability, make_update_fn, portfolio_metrics,
-    quarantine_mask,
+    epsilon_greedy, exploit_probability, make_update_fn, per_beta,
+    portfolio_metrics, quarantine_mask,
 )
-from sharetrade_tpu.config import LearnerConfig
+from sharetrade_tpu.config import ConfigError, LearnerConfig
 from sharetrade_tpu.env.core import TradingEnv
 from sharetrade_tpu.models.core import Model, apply_batched
+from sharetrade_tpu.ops import sum_tree
 from sharetrade_tpu.precision import FP32
 
 
@@ -56,7 +57,24 @@ class ReplayBuffer:
         """Insert a batch of B transitions (wrapping). ``valid`` masks agents
         whose episode already ended — their slots are written then un-counted
         by pointing them at already-valid rows (weight-neutral because the
-        write happens before the pointer advances past them)."""
+        write happens before the pointer advances past them). XLA
+        dead-code-eliminates the unused plan outputs, so this traced
+        program is the pre-plan one bit-for-bit (golden-pinned)."""
+        return self.push_with_plan(obs, action, reward, next_obs, valid)[0]
+
+    def sample(self, key: jax.Array, batch: int):
+        idx = jax.random.randint(key, (batch,), 0,
+                                 jnp.maximum(self.size, 1))
+        return (self.obs[idx], self.action[idx],
+                self.reward[idx], self.next_obs[idx])
+
+    def push_with_plan(self, obs, action, reward, next_obs, valid):
+        """:meth:`push` plus its write plan ``(buffer, slot_idx,
+        write_mask)`` so a priority structure (the PER sum-tree) can
+        mirror exactly the slots the circular buffer touched. ``push``
+        delegates here (one copy of the circular-write plan; the golden
+        trajectory pins that the delegation kept the compiled uniform
+        program bit-identical)."""
         batch = obs.shape[0]
         capacity = self.obs.shape[0]
         # Only advance through valid transitions: compact them to the front.
@@ -67,7 +85,7 @@ class ReplayBuffer:
         idx = (self.pos + jnp.arange(batch, dtype=jnp.int32)) % capacity
         write = jnp.arange(batch) < n_valid
         safe_idx = jnp.where(write, idx, (self.pos - 1) % capacity)
-        return self.replace(
+        buf = self.replace(
             obs=self.obs.at[safe_idx].set(
                 jnp.where(write[:, None], obs, self.obs[safe_idx])),
             action=self.action.at[safe_idx].set(
@@ -79,18 +97,36 @@ class ReplayBuffer:
             pos=(self.pos + n_valid) % capacity,
             size=jnp.minimum(self.size + n_valid, capacity),
         )
-
-    def sample(self, key: jax.Array, batch: int):
-        idx = jax.random.randint(key, (batch,), 0,
-                                 jnp.maximum(self.size, 1))
-        return (self.obs[idx], self.action[idx],
-                self.reward[idx], self.next_obs[idx])
+        return buf, safe_idx, write
 
 
 @struct.dataclass
 class DQNExtras:
     target_params: object
     replay: ReplayBuffer
+
+
+@struct.dataclass
+class PerState:
+    """Prioritized-replay state riding next to the circular arrays: the
+    fixed-shape sum-tree (leaf i = stored priority of replay slot i,
+    already ``per_alpha``-exponentiated) and the running max stored
+    priority new transitions enter at."""
+
+    tree: sum_tree.SumTree
+    max_priority: jax.Array   # f32 scalar, stored-domain
+
+
+@struct.dataclass
+class DQNExtrasPER:
+    """``DQNExtras`` + the PER sum-tree (``learner.replay_priority="per"``).
+    A separate class — not an optional field — so the uniform default's
+    pytree (and therefore its traced program and checkpoint layout) stays
+    byte-identical to the pre-data-plane code."""
+
+    target_params: object
+    replay: ReplayBuffer
+    per: PerState
 
 
 def make_dqn_agent(model: Model, env: TradingEnv,
@@ -100,7 +136,29 @@ def make_dqn_agent(model: Model, env: TradingEnv,
                    precision=None) -> Agent:
     """``collect_transitions`` makes each chunk additionally return its raw
     transition batch under ``metrics["transitions"]`` so the host can journal
-    them (the runtime's ``learner.journal_replay`` switch)."""
+    them (the runtime's ``learner.journal_replay`` switch).
+
+    ``learner.replay_priority`` selects the sampler: ``"uniform"``
+    (default) is the pre-data-plane code path bit-for-bit (golden-pinned,
+    tests/golden/replay_uniform_golden.json); ``"per"`` adds the
+    sum-tree prioritized sampler (ops/sum_tree.py) — priority update,
+    stratified sample, and TD-error write-back all inside this one traced
+    step, with the importance-sampling weights folded into the TD loss."""
+    if cfg.replay_priority not in ("uniform", "per"):
+        raise ConfigError(
+            f"unknown learner.replay_priority {cfg.replay_priority!r} "
+            "(expected 'uniform' or 'per')")
+    if cfg.replay_capacity <= num_agents:
+        # The circular push aliases masked rows onto (pos-1): with the
+        # batch spanning the whole buffer, a masked row can collide with
+        # a valid write and the scatter winner is implementation-defined
+        # (buffer AND sum-tree). A capacity this small is a config error,
+        # not a samplable buffer.
+        raise ConfigError(
+            f"learner.replay_capacity ({cfg.replay_capacity}) must exceed "
+            f"the agent batch ({num_agents}): a push spanning the whole "
+            "circular buffer has implementation-defined slot winners")
+    use_per = cfg.replay_priority == "per"
     optimizer = build_optimizer(cfg)
     precision = precision or FP32
     apply_update = make_update_fn(optimizer, cfg, precision)
@@ -110,15 +168,21 @@ def make_dqn_agent(model: Model, env: TradingEnv,
     def init(key: jax.Array) -> TrainState:
         k_params, k_rng = jax.random.split(key)
         params = model.init(k_params)
+        replay = ReplayBuffer.create(cfg.replay_capacity, obs_dim)
+        target = jax.tree.map(jnp.copy, params)
+        extras = (DQNExtrasPER(
+            target_params=target, replay=replay,
+            per=PerState(tree=sum_tree.create(cfg.replay_capacity),
+                         max_priority=jnp.float32(1.0)))
+            if use_per else
+            DQNExtras(target_params=target, replay=replay))
         return TrainState(
             params=params, opt_state=optimizer.init(params),
             carry=precision.cast_carry(
                 batched_carry(model, num_agents), model),
             env_state=batched_reset(env, num_agents),
             rng=k_rng, env_steps=jnp.int32(0), updates=jnp.int32(0),
-            extras=DQNExtras(
-                target_params=jax.tree.map(jnp.copy, params),
-                replay=ReplayBuffer.create(cfg.replay_capacity, obs_dim)),
+            extras=extras,
         )
 
     def q_batch(params, obs_batch):
@@ -160,20 +224,61 @@ def make_dqn_agent(model: Model, env: TradingEnv,
         next_obs = jnp.where(healthy[:, None],
                              jax.vmap(env.observe)(env_state), 0.0)
 
-        replay = ts.extras.replay.push(obs, actions, rewards, next_obs, active)
-
-        def td_loss(params):
-            b_obs, b_act, b_rew, b_next = replay.sample(k_sample, cfg.replay_batch)
+        def td_core(params, b_obs, b_act, b_rew, b_next, weights=None):
+            """ONE copy of the TD math for both samplers (a target-rule
+            fix must never land in one branch only): ``weights=None`` is
+            the uniform loss — literally the pre-PER ops, golden-pinned;
+            PER passes its IS weights in."""
             q_s, aux = q_batch_with_aux(params, b_obs)
             q_next = jax.lax.stop_gradient(q_batch(target_c, b_next))
             target = b_rew + cfg.gamma * jnp.max(q_next, axis=-1)
-            predicted = jnp.take_along_axis(q_s, b_act[:, None], axis=-1)[:, 0]
-            return (jnp.mean(jnp.square(predicted - target))
-                    + cfg.aux_loss_coef * aux)
+            predicted = jnp.take_along_axis(
+                q_s, b_act[:, None], axis=-1)[:, 0]
+            td_err = predicted - target
+            sq = (jnp.square(td_err) if weights is None
+                  else weights * jnp.square(td_err))
+            return jnp.mean(sq) + cfg.aux_loss_coef * aux, td_err
 
-        # Learn only once the buffer can fill a batch.
-        ready = replay.size >= cfg.replay_batch
-        loss, grads = jax.value_and_grad(td_loss)(params_c)
+        if use_per:
+            # Prioritized path: the push mirrors its write plan into the
+            # sum-tree (new transitions enter at the running max stored
+            # priority), the stratified sample + IS weights come from the
+            # tree, and the TD errors below re-prioritize the sampled
+            # leaves — all inside this traced step.
+            per = ts.extras.per
+            replay, push_idx, push_write = ts.extras.replay.push_with_plan(
+                obs, actions, rewards, next_obs, active)
+            tree = sum_tree.set_priorities(
+                per.tree, push_idx,
+                jnp.broadcast_to(per.max_priority, push_idx.shape),
+                push_write)
+            sample_idx, sample_probs = sum_tree.sample_stratified(
+                tree, k_sample, cfg.replay_batch)
+            beta = per_beta(ts.env_steps, cfg)
+            weights = jax.lax.stop_gradient(
+                sum_tree.is_weights(sample_probs, replay.size, beta))
+
+            def td_loss(params):
+                return td_core(params, replay.obs[sample_idx],
+                               replay.action[sample_idx],
+                               replay.reward[sample_idx],
+                               replay.next_obs[sample_idx], weights)
+
+            ready = replay.size >= cfg.replay_batch
+            (loss, td_err), grads = jax.value_and_grad(
+                td_loss, has_aux=True)(params_c)
+        else:
+            replay = ts.extras.replay.push(obs, actions, rewards, next_obs, active)
+
+            def td_loss(params):
+                b_obs, b_act, b_rew, b_next = replay.sample(k_sample, cfg.replay_batch)
+                # The unused td_err aux is dead-code-eliminated: the
+                # compiled uniform program is the pre-PER one bit-for-bit.
+                return td_core(params, b_obs, b_act, b_rew, b_next)[0]
+
+            # Learn only once the buffer can fill a batch.
+            ready = replay.size >= cfg.replay_batch
+            loss, grads = jax.value_and_grad(td_loss)(params_c)
         new_params, opt_state = apply_update(grads, ts.opt_state, ts.params)
         params = jax.tree.map(lambda new, old: jnp.where(ready, new, old),
                               new_params, ts.params)
@@ -187,11 +292,31 @@ def make_dqn_agent(model: Model, env: TradingEnv,
             lambda t, p: jnp.where(sync, p, t),
             ts.extras.target_params, params)
 
+        if use_per:
+            # TD-error write-back, gated on ready THROUGH THE MASK: an
+            # unready sample ran on garbage strata and must not touch
+            # real priorities. The mask (not a post-hoc where over old
+            # and new trees) keeps the pre-write tree dead after this
+            # call, so XLA scatters the levels in place instead of
+            # copying them — the difference between PER riding along and
+            # PER costing a tree copy per env step.
+            new_p = (jnp.abs(td_err) + cfg.per_eps) ** cfg.per_alpha
+            tree = sum_tree.set_priorities(
+                tree, sample_idx, new_p,
+                mask=jnp.broadcast_to(ready, sample_idx.shape))
+            max_p = jnp.where(
+                ready, jnp.maximum(per.max_priority, jnp.max(new_p)),
+                per.max_priority)
+            extras = DQNExtrasPER(
+                target_params=target_params, replay=replay,
+                per=PerState(tree=tree, max_priority=max_p))
+        else:
+            extras = DQNExtras(target_params=target_params, replay=replay)
         ts = ts.replace(
             params=params, opt_state=opt_state, env_state=env_state, rng=rng,
             env_steps=ts.env_steps + jnp.where(jnp.any(active), 1, 0),
             updates=n_updates,
-            extras=DQNExtras(target_params=target_params, replay=replay),
+            extras=extras,
         )
         out = (jnp.where(ready, loss, 0.0), jnp.sum(rewards))
         if collect_transitions:
@@ -211,6 +336,12 @@ def make_dqn_agent(model: Model, env: TradingEnv,
             "updates": ts.updates,
             **portfolio_metrics(env, ts.env_state),
         }
+        if use_per:
+            # PER gauges (obs/metrics.prom via the chunk metric stream);
+            # only in per mode — the uniform metrics dict is part of the
+            # golden-pinned pre-PR surface.
+            metrics["per_max_priority"] = ts.extras.per.max_priority
+            metrics["per_beta"] = per_beta(ts.env_steps, cfg)
         if collect_transitions:
             t_obs, t_act, t_rew, t_next, t_valid = outs[2]
             metrics["transitions"] = {
@@ -221,6 +352,24 @@ def make_dqn_agent(model: Model, env: TradingEnv,
     return Agent(name="dqn", init=init, step=step,
                  num_agents=num_agents, steps_per_chunk=steps_per_chunk,
                  model=model)
+
+
+def reseed_per_priorities(extras, *, priority: float | None = None):
+    """Rebuild the PER sum-tree after an out-of-band buffer fill (the
+    resume-time journal warm start): priorities are not journaled, so the
+    ``warm.size`` recovered rows re-enter at the running max stored
+    priority (exactly how a fresh push would admit them) and every empty
+    slot goes massless. No-op for uniform-mode extras."""
+    if not isinstance(extras, DQNExtrasPER):
+        return extras
+    per = extras.per
+    n_leaves = per.tree.num_leaves
+    p = per.max_priority if priority is None else jnp.float32(priority)
+    leaves = jnp.where(
+        jnp.arange(n_leaves) < extras.replay.size, p, 0.0
+    ).astype(jnp.float32)
+    return extras.replace(per=per.replace(
+        tree=sum_tree.from_leaves(leaves)))
 
 
 def fill_replay_from_journal(replay: ReplayBuffer, journal) -> ReplayBuffer:

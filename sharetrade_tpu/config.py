@@ -62,6 +62,26 @@ class DataConfig:
     # ignores these knobs.
     journal_fsync_every_records: int = 64
     journal_fsync_interval_s: float = 0.5
+    # Bounded journal: rotate the transitions journal into sealed segment
+    # files once the ACTIVE segment holds this many records (checked at
+    # watermark commit time — a sealed segment is fsynced before its
+    # rename publishes it, so torn tails only ever live in the newest
+    # segment; the CRC-framed recovery contract is per-segment). Retired
+    # by compaction: segments wholly older than the replay-capacity
+    # horizon (2x learner.replay_capacity rows of newer data) are deleted,
+    # so multi-day journaled runs hold a bounded segment set instead of
+    # rewriting one ever-growing file, and resume reads only the tail
+    # segments. 0 (default) = single-file journal, the pre-segment
+    # behavior (in-place compact_transitions rewrites). Rotation uses the
+    # Python journal backend — the C++ async writer appends to one file
+    # and is bypassed when this is set.
+    journal_segment_records: int = 0
+    # Streaming ingest (PriceDataService.tail): path of an append-only
+    # "price, date" feed (a growing file or FIFO; "{symbol}" substituted)
+    # that tail(symbol) consumes incrementally — the learner trains from a
+    # stream it doesn't own, the seam actor/learner disaggregation cuts
+    # at. None = tail() requires an explicitly attached feed.
+    feed_path: str | None = None
     # Auto-compact the price-event journal once its REDUNDANCY — events
     # beyond the one snapshot per symbol a compaction would leave — exceeds
     # this count (events replayed at recovery included, so a bloated
@@ -165,6 +185,24 @@ class LearnerConfig:
     # the replay buffer from it on resume (the reference's event-sourced
     # persistence generalized to experience data, SURVEY.md §7.4).
     journal_replay: bool = False
+    # Replay sampling discipline (DQN): "uniform" (default) keeps the
+    # pre-PER sampler — BIT-IDENTICAL to the pre-data-plane code, pinned
+    # by the golden trajectory in tests/golden/replay_uniform_golden.json
+    # (the same exactness contract as precision.mode="fp32"). "per" turns
+    # on prioritized replay (Schaul et al., arxiv 1511.05952): a
+    # fixed-shape sum-tree (ops/sum_tree.py) lives in the DQN extras next
+    # to the circular replay arrays, so priority update -> stratified
+    # sample -> TD-error write-back all run INSIDE the jitted (mega)chunk
+    # — no host round-trip, no new host syncs (lint_hot_loop check 9).
+    # New transitions enter at the running max priority; sampled
+    # transitions re-prioritize to (|td_error| + per_eps)^per_alpha; the
+    # TD loss folds in importance-sampling weights (N*P(i))^-beta with
+    # beta annealed from per_beta0 to 1 over per_beta_steps env steps.
+    replay_priority: str = "uniform"   # "uniform" | "per"
+    per_alpha: float = 0.6
+    per_beta0: float = 0.4
+    per_beta_steps: int = 100_000
+    per_eps: float = 1e-3
     # Weight on the model's auxiliary loss (ModelOut.aux — the MoE balance
     # regularizer); inert (aux = 0) for dense models.
     aux_loss_coef: float = 0.01

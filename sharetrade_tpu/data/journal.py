@@ -34,6 +34,34 @@ log = get_logger("data.journal")
 
 _HEADER = struct.Struct("<II")  # length, crc32
 
+#: Sealed-segment suffix (``journal_segment_records`` rotation): the active
+#: log at ``path`` rotates into ``path.seg00000001``, ``path.seg00000002``,
+#: ... — zero-padded so lexical order IS age order.
+_SEG_SUFFIX = ".seg"
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the directory holding ``path`` so a rename/unlink published
+    there survives power loss (the checkpoint manager's protocol)."""
+    fd = os.open(os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def segment_paths(path: str) -> list[str]:
+    """Sealed segments of ``path``, oldest first ([] for single-file
+    journals). The active segment — ``path`` itself — is not included."""
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path) + _SEG_SUFFIX
+    try:
+        names = sorted(n for n in os.listdir(directory)
+                       if n.startswith(base) and n[len(base):].isdigit())
+    except FileNotFoundError:
+        return []
+    return [os.path.join(directory, n) for n in names]
+
 
 def write_framed_bytes(path: str, payloads: list[bytes]) -> None:
     """Write raw payloads as a complete framed log at ``path`` (fsynced).
@@ -115,24 +143,39 @@ class Journal:
 
     def __init__(self, path: str, *, fsync: bool = False,
                  fsync_every_records: int = 1,
-                 fsync_interval_s: float = 0.0):
+                 fsync_interval_s: float = 0.0,
+                 segment_records: int = 0):
         self.path = path
         self._fsync = fsync
         self._every = max(0, int(fsync_every_records))
         self._interval = max(0.0, float(fsync_interval_s))
         #: Group-commit mode: batch appends, fsync on a watermark.
         self._group = self._every > 1 or self._interval > 0.0
+        #: Segment rotation (``data.journal_segment_records``): once the
+        #: ACTIVE file holds this many records it is fsynced and renamed
+        #: aside as a sealed ``.segNNNNNNNN`` sibling at the next commit,
+        #: and appends continue in a fresh active file. Sealed segments
+        #: are immutable and fully durable; a torn tail can only ever
+        #: live in the active segment (the same recovery contract,
+        #: per segment). 0 = single-file journal.
+        self._segment_records = max(0, int(segment_records))
         self._buf: list[bytes] = []
         self._buf_records = 0
         self._last_commit = time.monotonic()
         self._lock = threading.Lock()
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         valid = self._scan_valid_prefix()
-        # Truncate any torn tail so appends continue from a clean boundary.
+        # Truncate any torn tail so appends continue from a clean boundary
+        # (sealed segments were fsynced before publication — only the
+        # active segment can tear).
         if valid is not None:
             with open(self.path, "r+b") as f:
                 f.truncate(valid)
         self._fh = open(self.path, "ab")
+        #: Records currently in the active segment — counted during the
+        #: torn-tail prefix scan above (one walk of the active file, not
+        #: a second one; a migrating pre-rotation journal can be large).
+        self._seg_records = self._scanned_records
 
     # ---- write path ----
 
@@ -163,6 +206,8 @@ class Journal:
             self._fh.flush()
             if self._fsync:
                 os.fsync(self._fh.fileno())
+            self._seg_records += 1
+            self._maybe_rotate_locked()
 
     def _commit_locked(self) -> None:
         """Flush the batched records as one write + one fsync (group-commit
@@ -171,12 +216,41 @@ class Journal:
             return
         if self._buf:
             self._fh.write(b"".join(self._buf))
+            self._seg_records += self._buf_records
             self._buf.clear()
             self._buf_records = 0
         self._fh.flush()
         if self._group or self._fsync:
             os.fsync(self._fh.fileno())
         self._last_commit = time.monotonic()
+        self._maybe_rotate_locked()
+
+    def _maybe_rotate_locked(self) -> None:
+        """Seal the active segment once it reaches ``segment_records``
+        (checked at commit/append time — "rotate on watermark flush"): the
+        active file is fsynced, renamed to the next ``.segNNNNNNNN`` name
+        (so its bytes are durable BEFORE the rename publishes it), the
+        directory entry is fsynced, and a fresh active file opens. Lock
+        held by caller; every committed record lands in exactly one
+        segment."""
+        if (not self._segment_records
+                or self._seg_records < self._segment_records
+                or self._fh.closed):
+            return
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._fh.close()
+        seals = segment_paths(self.path)
+        prefix = os.path.basename(self.path) + _SEG_SUFFIX
+        last = (int(os.path.basename(seals[-1])[len(prefix):])
+                if seals else 0)
+        sealed = f"{self.path}{_SEG_SUFFIX}{last + 1:08d}"
+        os.replace(self.path, sealed)
+        _fsync_dir(self.path)
+        self._fh = open(self.path, "ab")
+        self._seg_records = 0
+        log.info("journal %s: sealed segment %s", self.path,
+                 os.path.basename(sealed))
 
     def flush(self) -> None:
         """Make every append that returned durable (and visible to readers
@@ -188,14 +262,16 @@ class Journal:
     # ---- read path ----
 
     def replay(self) -> Iterator[dict[str, Any]]:
-        """Yield all intact events from the start of the log."""
+        """Yield all intact events from the start of the log — sealed
+        segments oldest-first, then the active segment."""
         self.flush()
-        for _offset, payload in iter_framed_records(self.path):
-            if payload[:4] == b"STR1":
-                # Packed binary transition record (data/transitions.py):
-                # not a JSON event — decoded by read_tail_transitions.
-                continue
-            yield json.loads(payload)
+        for path in (*segment_paths(self.path), self.path):
+            for _offset, payload in iter_framed_records(path):
+                if payload[:4] == b"STR1":
+                    # Packed binary transition record (data/transitions.py):
+                    # not a JSON event — decoded by read_tail_transitions.
+                    continue
+                yield json.loads(payload)
 
     def _scan_valid_prefix(self) -> int | None:
         """Byte offset of the last intact record boundary, or None if the file
@@ -203,12 +279,15 @@ class Journal:
         partial header counts as torn — appending after one would bury every
         later record behind an unreadable frame (the C++ ``stj_open`` already
         truncates that case)."""
+        self._scanned_records = 0
         if not os.path.exists(self.path):
             return None
         end = 0
         # warn=False: this path logs its own, action-bearing message below.
+        # The record count rides the same walk (seeds _seg_records for
+        # rotation — no second full scan of the active file).
         for end, _payload in iter_framed_records(self.path, warn=False):
-            pass
+            self._scanned_records += 1
         if end == os.path.getsize(self.path):
             return None
         log.warning("journal %s: torn tail at offset %d, truncating",
@@ -243,7 +322,14 @@ class Journal:
             write_framed_bytes(tmp_path, payloads)
             self._fh.close()
             os.replace(tmp_path, self.path)
+            # Compaction replaces the WHOLE log: sealed segments are part
+            # of it, so they go too (their content is superseded by the
+            # caller's payload set, same as the active file's).
+            for sealed in segment_paths(self.path):
+                os.remove(sealed)
+            _fsync_dir(self.path)
             self._fh = open(self.path, "ab")
+            self._seg_records = len(payloads)
             self._last_commit = time.monotonic()
         log.info("journal %s compacted to %d records", self.path, len(payloads))
 

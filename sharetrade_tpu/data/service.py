@@ -110,6 +110,96 @@ def http_provider(url_template: str, *,
     return fetch
 
 
+class FileTailFeed:
+    """Incremental reader of an append-only ``price, date`` feed — the
+    streaming-ingest half of the replay data plane: a producer (live
+    market tap, the synthetic generator, another process) APPENDS rows to
+    a file or FIFO it owns, and each :meth:`poll` consumes exactly the
+    complete rows added since the previous poll. The consumer never owns
+    or rewrites the feed — the decoupled-dataflow seam actor/learner
+    disaggregation cuts at (MindSpeed RL's decoupled design,
+    arxiv 2507.19017).
+
+    Durability/parse contract matches the batch CSV loader
+    (data/ingest.py ``parse_price_lines``: malformed rows dropped,
+    date-sorted), so consuming a feed incrementally converges to exactly
+    the series a one-shot ``load_price_csv`` of the final file returns —
+    the parity the tests pin. A trailing partial line (a producer caught
+    mid-append) is held back until its newline arrives; a FIFO is read
+    non-blocking so a quiet producer yields an empty delta, never a hang."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offset = 0
+        self._partial = b""
+        #: FIFO read end, opened once and HELD across polls: closing it
+        #: between polls would leave the pipe reader-less, and the
+        #: producer's next write would raise SIGPIPE/BrokenPipeError (or
+        #: its O_NONBLOCK open would fail ENXIO) — a persistent producer
+        #: must survive an idle consumer.
+        self._fifo_fd: int | None = None
+
+    def close(self) -> None:
+        if self._fifo_fd is not None:
+            os.close(self._fifo_fd)
+            self._fifo_fd = None
+
+    def _read_new_bytes(self) -> bytes:
+        try:
+            st = os.stat(self.path)
+        except FileNotFoundError:
+            return b""
+        import stat as stat_mod
+        if stat_mod.S_ISFIFO(st.st_mode):
+            # FIFO: non-blocking drain of whatever the producer has
+            # written; EAGAIN / no-writer-yet reads as an empty delta.
+            if self._fifo_fd is None:
+                try:
+                    self._fifo_fd = os.open(
+                        self.path, os.O_RDONLY | os.O_NONBLOCK)
+                except OSError:
+                    return b""
+            chunks = []
+            while True:
+                try:
+                    chunk = os.read(self._fifo_fd, 1 << 16)
+                except BlockingIOError:
+                    break
+                if not chunk:
+                    break
+                chunks.append(chunk)
+            return b"".join(chunks)
+        if st.st_size <= self._offset:
+            return b""
+        with open(self.path, "rb") as f:
+            f.seek(self._offset)
+            data = f.read()
+        self._offset += len(data)
+        return data
+
+    def poll(self, symbol: str) -> PriceSeries:
+        """Parse the rows appended since the last poll (possibly none)."""
+        from sharetrade_tpu.data.ingest import parse_price_lines
+        data = self._partial + self._read_new_bytes()
+        head, sep, tail = data.rpartition(b"\n")
+        if not sep:
+            # No complete line yet: everything stays buffered.
+            self._partial = data
+            return parse_price_lines(symbol, [])
+        self._partial = tail
+        return parse_price_lines(
+            symbol, head.decode("utf-8", errors="replace").splitlines())
+
+
+def append_feed_rows(path: str, series: PriceSeries) -> None:
+    """Producer-side helper: append a series as ``price, date`` rows to a
+    feed file (the synthetic generator behind the file/FIFO provider).
+    Append-only by contract — the consumer tracks byte offsets."""
+    with open(path, "a", encoding="utf-8") as f:
+        for d, p in zip(series.dates, series.prices):
+            f.write(f"{float(p)}, {d}\n")
+
+
 def synthetic_provider(length: int = 6046, seed: int = 1992) -> Callable[..., PriceSeries]:
     def fetch(symbol: str, start=None, end=None) -> PriceSeries:
         # Per-symbol seed derivation: distinct symbols get distinct (but
@@ -146,6 +236,11 @@ class PriceDataService:
         # bounded without anyone remembering to call compact().
         self._compact_every = cfg.price_compact_every_events
         self._journal_events = 0
+        # Streaming ingest (tail): per-symbol incremental feed readers,
+        # lazily attached from data.feed_path ("{symbol}" substituted) or
+        # explicitly via attach_feed.
+        self._feed_path = cfg.feed_path
+        self._feeds: dict[str, FileTailFeed] = {}
         self._recover()
 
     # ---- public protocol (the RequestStockPrice equivalent) ----
@@ -176,6 +271,51 @@ class PriceDataService:
         self._maybe_compact()
         return StockDataResponse(symbol, self._cache[symbol])
 
+    def attach_feed(self, symbol: str, feed: FileTailFeed) -> None:
+        """Wire an append-only feed for ``symbol`` (tests / embedders that
+        don't route through ``data.feed_path``)."""
+        self._feeds[symbol] = feed
+
+    def tail(self, symbol: str) -> StockDataResponse:
+        """Streaming ingest: consume the rows APPENDED to the symbol's
+        feed since the last tail() call, merge them into the cache, and
+        persist the delta as a journal event (the same ``prices_fetched``
+        event recovery already replays). Returns the DELTA series —
+        only dates genuinely NEW to the cache, so a restarted consumer
+        (whose in-memory feed offset reset to zero) re-scans the file's
+        bytes but re-ingests nothing: rows the journal already recovered
+        filter out, and only rows appended while the process was down
+        come back as delta. Possibly empty — a quiet feed is not an
+        error; read the full merged history with ``request``. The feed
+        is append-only and producer-owned: the learner trains from a
+        stream it doesn't own, which is the seam actor/learner
+        disaggregation cuts at."""
+        feed = self._feeds.get(symbol)
+        if feed is None:
+            if not self._feed_path:
+                raise ValueError(
+                    f"no feed attached for {symbol!r}: set data.feed_path "
+                    "or call attach_feed()")
+            feed = FileTailFeed(self._feed_path.replace("{symbol}", symbol))
+            self._feeds[symbol] = feed
+        delta = feed.poll(symbol)
+        cached = self._cache.get(symbol)
+        if len(delta) and cached is not None and len(cached):
+            # Restart dedupe: drop rows the (journal-recovered) cache
+            # already holds — without this, the first poll after a
+            # restart would return AND re-journal the whole history as
+            # one giant "delta".
+            import numpy as np
+            fresh = ~np.isin(delta.dates, cached.dates)
+            if not fresh.all():
+                delta = PriceSeries(symbol, delta.dates[fresh],
+                                    delta.prices[fresh])
+        if len(delta):
+            self._persist(symbol, delta)
+            self._merge(symbol, delta)
+            self._maybe_compact()
+        return StockDataResponse(symbol, delta)
+
     def cached_symbols(self) -> list[str]:
         return sorted(self._cache)
 
@@ -191,6 +331,10 @@ class PriceDataService:
         self._journal_events = len(events)
 
     def close(self) -> None:
+        for feed in self._feeds.values():
+            close_feed = getattr(feed, "close", None)
+            if close_feed is not None:
+                close_feed()
         self._journal.close()
 
     # ---- event sourcing ----

@@ -22,6 +22,7 @@ built, with a numpy fallback of identical semantics.
 
 from __future__ import annotations
 
+import os
 import struct
 
 import numpy as np
@@ -105,6 +106,16 @@ def read_tail_transitions(path: str, max_rows: int, *,
     flush = getattr(journal, "flush", None)
     if flush is not None:
         flush()
+    from sharetrade_tpu.data.journal import segment_paths
+    seals = segment_paths(path)
+    if seals:
+        # Segmented journal (data.journal_segment_records): walk the TAIL
+        # segments only — newest first, stopping once the kept rows cover
+        # max_rows — instead of scanning the whole history. env_steps
+        # stamps are monotone in append order (the orchestrator's
+        # high-water guard), so the high-water mark recovered from the
+        # scanned tail IS the global one.
+        return _read_tail_paths([*seals, path], max_rows, cutoff_env_steps)
     native = _native_read_tail(path, max_rows, cutoff_env_steps)
     if native is not NotImplemented:
         return native
@@ -142,26 +153,45 @@ def _native_read_tail(path, max_rows, cutoff):
 
 def _python_read_tail(path, max_rows, cutoff):
     """Same semantics as the C++ reader, pure numpy."""
-    recs = []
-    high_water = 0
-    for _offset, payload in iter_framed_records(path):
-        decoded = decode_transitions(payload)
-        if decoded is None:
-            continue
-        high_water = max(high_water, decoded[4])
-        recs.append(decoded)
-    if not recs:
-        return None
-    kept, rows, obs_dim = [], 0, recs[-1][0].shape[1]
-    for rec in reversed(recs):
-        if cutoff and rec[4] > cutoff:
-            continue
-        if rec[0].shape[1] != obs_dim:
-            continue
-        kept.append(rec)
-        rows += rec[0].shape[0]
-        if max_rows and rows >= max_rows:
+    return _read_tail_paths([path], max_rows, cutoff)
+
+
+def _read_tail_paths(paths, max_rows, cutoff):
+    """Tail walk over an ordered (oldest-first) list of journal files:
+    files are scanned newest-first and each is decoded whole, but the walk
+    stops descending into OLDER files once the kept records cover
+    ``max_rows`` — the bounded-recovery property segmentation buys. The
+    high-water mark covers every scanned record (== the global maximum
+    when stamps are monotone in append order, which the journaling
+    high-water guard enforces)."""
+    kept, rows, obs_dim, high_water = [], 0, None, 0
+    seen_any = False
+    for path in reversed(paths):          # newest file first
+        recs = []
+        for _offset, payload in iter_framed_records(path):
+            decoded = decode_transitions(payload)
+            if decoded is not None:
+                recs.append(decoded)
+        if recs:
+            seen_any = True
+            high_water = max(high_water, max(r[4] for r in recs))
+            if obs_dim is None:
+                obs_dim = recs[-1][0].shape[1]
+        satisfied = False
+        for rec in reversed(recs):
+            if cutoff and rec[4] > cutoff:
+                continue
+            if rec[0].shape[1] != obs_dim:
+                continue
+            kept.append(rec)
+            rows += rec[0].shape[0]
+            if max_rows and rows >= max_rows:
+                satisfied = True
+                break
+        if satisfied:
             break
+    if not seen_any:
+        return None
     if not kept:
         # Every record excluded by the cutoff: the high-water mark (the
         # double-journaling guard) must still come back — zero rows, not None.
@@ -174,6 +204,47 @@ def _python_read_tail(path, max_rows, cutoff):
     reward = np.concatenate([r[2] for r in kept])
     next_obs = np.concatenate([r[3] for r in kept])
     return obs, action, reward, next_obs, high_water
+
+
+def count_transition_rows(path: str) -> int:
+    """Transition rows in one journal file — header-only decode (magic +
+    batch count), no array copies."""
+    rows = 0
+    for _offset, payload in iter_framed_records(path):
+        if len(payload) >= _HEAD.size and payload[:4] == MAGIC:
+            _magic, batch, _obs_dim, _steps = _HEAD.unpack_from(payload)
+            rows += batch
+    return rows
+
+
+def retire_transition_segments(journal, keep_rows: int) -> tuple[int, int]:
+    """Segment-granular compaction (``data.journal_segment_records``):
+    delete sealed segments wholly OLDER than the newest ``keep_rows``
+    transition rows — the replay-capacity horizon; nothing newer is ever
+    touched, and the active segment never is. Work is bounded: counting
+    stops at the first segment the newer tail already covers, and
+    everything older is deleted by size alone. Returns
+    ``(retired_segments, freed_bytes)``."""
+    from sharetrade_tpu.data.journal import _fsync_dir, segment_paths
+    flush = getattr(journal, "flush", None)
+    if flush is not None:
+        flush()
+    seals = segment_paths(journal.path)
+    if not seals:
+        return 0, 0
+    covered = count_transition_rows(journal.path)   # active segment
+    retired = freed = 0
+    for i in range(len(seals) - 1, -1, -1):         # newest sealed first
+        if covered >= keep_rows:
+            for victim in seals[:i + 1]:
+                freed += os.path.getsize(victim)
+                os.remove(victim)
+                retired += 1
+            break
+        covered += count_transition_rows(seals[i])
+    if retired:
+        _fsync_dir(journal.path)
+    return retired, freed
 
 
 def compact_transitions(journal, keep_rows: int) -> bool:
@@ -193,6 +264,13 @@ def compact_transitions(journal, keep_rows: int) -> bool:
     flush = getattr(journal, "flush", None)
     if flush is not None:
         flush()
+    from sharetrade_tpu.data.journal import segment_paths
+    if segment_paths(journal.path):
+        # Segmented journal: the rewrite below would compute its keep-set
+        # from the ACTIVE file alone while compact_payloads deletes every
+        # sealed segment — destroying the horizon this function promises
+        # to keep. Segment-granular retirement IS this contract there.
+        return retire_transition_segments(journal, keep_rows)[0] > 0
     payloads = [p for _off, p in iter_framed_records(journal.path)]
     rows = 0
     boundary = len(payloads)

@@ -205,6 +205,22 @@ def summarize_run_dir(run_dir: str) -> dict:
                 os.path.join(run_dir, "metrics.prom")),
         }
         gauges = (last_rec or {}).get("gauges") or {}
+        if ("replay_size" in gauges
+                or any(k.startswith(("per_", "journal_"))
+                       for k in list(gauges) + list(counters))):
+            # Replay data plane (journaled DQN runs): buffer fill, PER
+            # priority/anneal state, and the bounded-journal segment
+            # telemetry in one glanceable block.
+            out["replay"] = {
+                "replay_size": gauges.get("replay_size"),
+                "per_max_priority": gauges.get("per_max_priority"),
+                "per_beta": gauges.get("per_beta"),
+                "journal_segments": gauges.get("journal_segments"),
+                "journal_segments_retired_total": counters.get(
+                    "journal_segments_retired_total", 0.0),
+                "journal_compacted_bytes_total": counters.get(
+                    "journal_compacted_bytes_total", 0.0),
+            }
         if any(k.startswith("serve_") for k in list(gauges)
                + list(counters)):
             # Serving tier (``cli serve`` run dirs): the SLO surface in

@@ -155,7 +155,10 @@ def train_state_shardings(ts: TrainState, mesh: Mesh, *,
         # which can coincide with the batch size while the sampling indices
         # assume the whole buffer.
         keys = _path_keys(path)
-        if "replay" in keys:
+        if "replay" in keys or "per" in keys:
+            # "per": the PER sum-tree + max-priority scalar replicate with
+            # the replay arrays they index — the tree's (2L,) leading dim
+            # is a capacity, never the batch.
             return replicate
         match = opt_leaf(path, leaf)
         if match is not replicate:

@@ -246,7 +246,19 @@ class Orchestrator:
             from sharetrade_tpu.data.service import _open_journal
             path = os.path.join(cfg.data.journal_dir, "transitions.journal")
             self._transitions_journal = None
-            if cfg.data.async_transition_writer and cfg.data.use_native_journal:
+            if cfg.data.journal_segment_records > 0:
+                # Bounded journal: segment rotation + retirement
+                # (data.journal_segment_records). Rotation lives in the
+                # Python backend — the C++ async writer appends to one
+                # file, so it is bypassed here; group-commit watermarks
+                # still apply per segment.
+                from sharetrade_tpu.data.journal import Journal
+                self._transitions_journal = Journal(
+                    path,
+                    fsync_every_records=cfg.data.journal_fsync_every_records,
+                    fsync_interval_s=cfg.data.journal_fsync_interval_s,
+                    segment_records=cfg.data.journal_segment_records)
+            elif cfg.data.async_transition_writer and cfg.data.use_native_journal:
                 # Hot-path appends drain through the C++ background thread;
                 # the step loop never blocks on journal IO.
                 from sharetrade_tpu.data.native import (
@@ -1516,13 +1528,36 @@ class Orchestrator:
         # drop records older than the recoverable tail (2x capacity keeps a
         # full buffer recoverable at any resume cutoff inside the last
         # capacity rows). Record boundaries/stamps survive compaction, so
-        # cutoff filtering stays exact.
+        # cutoff filtering stays exact. With segment rotation on
+        # (data.journal_segment_records) compaction is segment-granular:
+        # whole sealed segments older than the horizon are deleted —
+        # never a rewrite of live data, never a segment newer than the
+        # horizon — and the journal_segments / journal_compacted_bytes
+        # telemetry tracks the bound.
         capacity = self.cfg.learner.replay_capacity
         self._journal_rows_since_compact += int(valid.sum())
+        segmented = self.cfg.data.journal_segment_records > 0
         if self._journal_rows_since_compact >= capacity:
-            from sharetrade_tpu.data.transitions import compact_transitions
-            compact_transitions(self._transitions_journal, 2 * capacity)
+            if segmented:
+                from sharetrade_tpu.data.transitions import (
+                    retire_transition_segments)
+                retired, freed = retire_transition_segments(
+                    self._transitions_journal, 2 * capacity)
+                if freed:
+                    self.metrics.inc("journal_compacted_bytes_total", freed)
+                if retired:
+                    self.metrics.inc("journal_segments_retired_total",
+                                     retired)
+            else:
+                from sharetrade_tpu.data.transitions import (
+                    compact_transitions)
+                compact_transitions(self._transitions_journal, 2 * capacity)
             self._journal_rows_since_compact = 0
+        if segmented:
+            from sharetrade_tpu.data.journal import segment_paths
+            self.metrics.record(
+                "journal_segments",
+                len(segment_paths(self._transitions_journal.path)) + 1)
 
     def _warm_start_replay(self, state: TrainState) -> TrainState:
         """Rebuild the DQN replay buffer from the transitions journal. The
@@ -1538,8 +1573,13 @@ class Orchestrator:
         from sharetrade_tpu.data.transitions import read_tail_transitions
         capacity = self.cfg.learner.replay_capacity
         cutoff = int(state.env_steps)
-        # Legacy JSON "transitions" events (older logs); binary records in
-        # the same file are skipped by replay() and decoded below.
+        # Legacy JSON "transitions" events (older logs — a pre-rotation
+        # journal may carry them INTO its first sealed segment, so the
+        # scan covers every segment); binary records are skipped by
+        # replay() and decoded below. This stays bounded: segment
+        # retirement caps the whole journal near the 2x-capacity horizon,
+        # and the binary fast path below walks only the tail segments
+        # newest-first (the bounded-recovery fix).
         events = [e for e in self._transitions_journal.replay()
                   if e.get("type") == "transitions"]
         # Packed binary tail (the fast path): one C++/numpy pass returns the
@@ -1571,7 +1611,12 @@ class Orchestrator:
         log.info("warm-started replay buffer with %d journaled transitions",
                  int(warm.size))
         self.events.emit("replay_warm_started", size=int(warm.size))
-        return state.replace(extras=state.extras.replace(replay=warm))
+        from sharetrade_tpu.agents.dqn import reseed_per_priorities
+        # PER mode: priorities are not journaled — the recovered rows
+        # re-enter the sum-tree at the checkpointed max priority (no-op
+        # for uniform extras).
+        return state.replace(extras=reseed_per_priorities(
+            state.extras.replace(replay=warm)))
 
     # ------------------------------------------------------------------
     # queries (IsEverythingDone / GetAvg / GetStd; ShareTradeHelper.scala:35-39)

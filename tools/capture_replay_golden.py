@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Capture the uniform-replay DQN golden trajectory (run at the PRE-PR
+commit): fixed-seed 2-chunk DQN metrics + state digests, pinned by
+tests/test_replay.py so ``learner.replay_priority="uniform"`` (the default)
+stays bit-identical to the pre-PR sampler — the same contract (and capture
+recipe) as tests/golden/precision_fp32_golden.json."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import jax
+import numpy as np
+
+from sharetrade_tpu.agents import build_agent
+from sharetrade_tpu.config import FrameworkConfig
+from sharetrade_tpu.data.synthetic import synthetic_price_series
+from sharetrade_tpu.env import trading
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "golden", "replay_uniform_golden.json")
+
+
+def _tree_digest(tree):
+    h = hashlib.sha256()
+    for path, leaf in sorted(
+            jax.tree_util.tree_flatten_with_path(tree)[0],
+            key=lambda kv: str(kv[0])):
+        a = np.asarray(leaf)
+        h.update(str(path).encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def golden_cfg() -> FrameworkConfig:
+    cfg = FrameworkConfig()
+    cfg.learner.algo = "dqn"
+    cfg.parallel.num_workers = 4
+    cfg.env.window = 16
+    cfg.runtime.chunk_steps = 25
+    cfg.model.hidden_dim = 16
+    cfg.learner.replay_capacity = 512
+    cfg.learner.replay_batch = 32
+    cfg.learner.target_update_every = 10
+    return cfg
+
+
+def main() -> None:
+    cfg = golden_cfg()
+    series = synthetic_price_series(length=256, seed=7)
+    env = trading.env_from_prices(series.prices, window=cfg.env.window,
+                                  initial_budget=cfg.env.initial_budget)
+    agent = build_agent(cfg, env)
+    step = jax.jit(agent.step)
+    ts = agent.init(jax.random.PRNGKey(0))
+    metrics_rows = []
+    for _ in range(2):
+        ts, metrics = step(ts)
+        metrics_rows.append(
+            {k: float(np.asarray(v)) for k, v in sorted(metrics.items())
+             if np.asarray(v).ndim == 0})
+    golden = {"dqn": {
+        "metrics": metrics_rows,
+        "params_sha256": _tree_digest(ts.params),
+        "opt_state_sha256": _tree_digest(ts.opt_state),
+        "state_sha256": _tree_digest(ts),
+    }}
+    with open(OUT, "w") as f:
+        json.dump(golden, f, indent=2, sort_keys=True)
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -83,13 +83,23 @@ def build_config(workdir: str, *, algo: str, episodes: int,
             "async_transition_writer": False,
             "journal_fsync_every_records": 1,
             "journal_fsync_interval_s": 0.0,
+            # Segment rotation ON (small segments so kills land across
+            # rotation boundaries): the soak's journal invariants —
+            # tail >= checkpoint env_steps, clean torn-tail recovery —
+            # must survive rotation AND segment retirement, and the
+            # segment count must stay bounded over the whole soak
+            # (assert_segments_bounded).
+            "journal_segment_records": 12,
         },
         "env": {"window": 8},
         "model": {"hidden_dim": 8},
         "learner": {
             "algo": algo,
             "journal_replay": algo == "dqn",
-            "replay_capacity": 4096,
+            # Small capacity so segment RETIREMENT actually fires inside
+            # the soak window (the compaction cadence is one capacity's
+            # worth of new rows).
+            "replay_capacity": 256,
             "replay_batch": 32,
         },
         "parallel": {"num_workers": 4},
@@ -209,6 +219,38 @@ def journal_high_water(journal_dir: str) -> int | None:
         return None
     tail = read_tail_transitions(path, 1)
     return None if tail is None else int(tail[4])
+
+
+def count_sealed_segments(journal_dir: str) -> int:
+    from sharetrade_tpu.data.journal import segment_paths
+    return len(segment_paths(
+        os.path.join(journal_dir, "transitions.journal")))
+
+
+def assert_segments_bounded(journal_dir: str, cfg: dict) -> None:
+    """Bounded-disk invariant with rotation on: the sealed-segment set
+    must stay within what retirement promises to keep — the newest
+    segments covering 2x replay_capacity rows plus rotation/cadence
+    slack — instead of growing with the run's whole history. The bound is
+    generous (row counts per record vary near episode ends) but FINITE
+    and run-length-independent, which is the property under test."""
+    from sharetrade_tpu.data.journal import segment_paths
+    path = os.path.join(journal_dir, "transitions.journal")
+    if not os.path.exists(path):
+        return
+    seals = segment_paths(path)
+    keep_rows = 2 * cfg["learner"]["replay_capacity"]
+    # Worst-case rows per record ~= workers (one env step per record row
+    # set) is far below the typical chunk_steps x workers; allow a 4x
+    # cadence/rotation slack on top of the horizon's segment count.
+    seg_records = cfg["data"]["journal_segment_records"]
+    min_rows_per_seg = seg_records          # >= 1 row per record
+    bound = 4 * (keep_rows // min_rows_per_seg + 2)
+    if len(seals) > bound:
+        raise SoakError(
+            f"journal segment set grew past the retirement bound: "
+            f"{len(seals)} sealed segments > {bound} "
+            f"(keep_rows={keep_rows}, segment_records={seg_records})")
 
 
 def assert_no_stale_tmp(ckpt_dir: str) -> None:
@@ -333,6 +375,15 @@ def run_soak(*, kills: int, seed: int, algo: str, workdir: str | None,
                     f"kill {i}: journal high-water {hw} behind newest "
                     f"checkpoint env_steps {restored} despite per-append "
                     "flushing")
+            # Rotation invariants after every kill: the segment set stays
+            # bounded (retirement never falls behind), and the tail walk
+            # above already proved recovery reads cleanly across however
+            # many rotation boundaries this kill landed on.
+            assert_segments_bounded(journal_dir, cfg)
+            if algo == "dqn":
+                summary["max_segments_seen"] = max(
+                    summary.get("max_segments_seen", 0),
+                    count_sealed_segments(journal_dir))
 
         # ---- corruption scenario: bit-flip every preferred resume source
         # (tag_preempt AND the newest step checkpoint), so the final resume
@@ -426,6 +477,18 @@ def run_soak(*, kills: int, seed: int, algo: str, workdir: str | None,
                         "ckpt_restore_fallbacks_total missing from the "
                         "metrics export after a walk-back restore")
         assert_no_stale_tmp(ckpt_dir)
+        assert_segments_bounded(journal_dir, cfg)
+        if algo == "dqn":
+            summary["max_segments_seen"] = max(
+                summary.get("max_segments_seen", 0),
+                count_sealed_segments(journal_dir))
+        if algo == "dqn" and kills >= 4 and not summary.get(
+                "max_segments_seen"):
+            # A full soak that never sealed a segment did not exercise
+            # the rotation-boundary scenario it claims to cover.
+            raise SoakError(
+                "no segment rotation observed over the whole soak "
+                "(journal_segment_records misconfigured?)")
         say(f"soak PASSED: {kills} kills "
             f"({summary['sigterm_preempts']} graceful), "
             f"{summary['resumes']} resumes, "

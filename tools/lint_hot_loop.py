@@ -105,6 +105,20 @@ in tests/test_megachunk.py:
    side (``_complete_batch`` / ``_complete_loop``), whose existence the
    check also enforces. Escape hatch: ``serve-host-ok`` naming why a host
    op intentionally rides the dispatch path.
+
+9. **No host work in the traced replay sample/priority-update path** (the
+   replay-data-plane PR's guard) — the PER sum-tree ops
+   (``sharetrade_tpu/ops/sum_tree.py``) and the DQN step closure
+   (``agents/dqn.py`` ``one_step``) run INSIDE the jitted (mega)chunk:
+   journal IO (``journal`` / ``append_bytes`` / ``open``), ``os.*``
+   calls, or host RNG (``np.random`` / stdlib ``random`` — anything but
+   ``jax.random``) there either freezes into the trace or adds a host
+   sync to the chunk path, exactly what keeping replay device-resident
+   exists to avoid. The host half of the data plane — journaling,
+   segment rotation/retirement, warm starts — belongs to the consumer
+   side (``_journal_transitions`` / ``_warm_start_replay`` in the
+   orchestrator), whose existence the check also enforces. Escape hatch:
+   ``replay-host-ok`` naming why a host call is trace-safe there.
 """
 
 from __future__ import annotations
@@ -298,6 +312,31 @@ SERVE_BLOCK_PATTERN = re.compile(
 #: Escape hatch for an intentional host op on the serve dispatch path.
 SERVE_MARKER = "serve-host-ok"
 
+#: Check 9 (the replay-data-plane PR): the traced replay sample /
+#: priority-update path. The sum-tree module's functions run inside the
+#: jitted chunk wholesale; in agents/dqn.py the traced closure is
+#: ``one_step`` (td_loss nests inside it).
+REPLAY_TREE_TARGET = (pathlib.Path(__file__).resolve().parent.parent
+                      / "sharetrade_tpu" / "ops" / "sum_tree.py")
+REPLAY_DQN_TARGET = (pathlib.Path(__file__).resolve().parent.parent
+                     / "sharetrade_tpu" / "agents" / "dqn.py")
+#: Sum-tree ops that ARE the device-side sample/priority-update path —
+#: a rename must update this lint, not silently un-guard it.
+REPLAY_TREE_FUNCS = ("set_priorities", "sample_stratified", "is_weights",
+                     "from_leaves")
+REPLAY_DQN_FUNCS = ("one_step",)
+#: Consumer-side functions (runtime/orchestrator.py) the device/host split
+#: moves journal IO INTO — they must keep existing.
+REPLAY_CONSUMER_FUNCS = ("_journal_transitions", "_warm_start_replay")
+#: Journal IO, os.* calls, and host RNG (np.random / stdlib random —
+#: jax.random stays legal via the dotted-receiver exclusion).
+REPLAY_BLOCK_PATTERN = re.compile(
+    r"\bos\.\w+\s*\(|(?<![\w.])(?:np|numpy)\.random\.|"
+    r"(?<!\.)\brandom\.\w+\s*\(|\bjournal\b|append_bytes\(|"
+    r"(?<![\w.])open\s*\(")
+#: Escape hatch for an intentionally trace-safe host call there.
+REPLAY_MARKER = "replay-host-ok"
+
 
 def lint_hot_loop_syncs() -> tuple[list[tuple[str, int, str]], set[str]]:
     return _scan_named_funcs(HOT_FUNCS, PATTERN, MARKER)
@@ -311,6 +350,24 @@ def lint_serve_dispatch() -> tuple[list[tuple[str, int, str]], set[str]]:
     return _scan_named_funcs(SERVE_DISPATCH_FUNCS, SERVE_BLOCK_PATTERN,
                              SERVE_MARKER, also_find=SERVE_CONSUMER_FUNCS,
                              target=SERVE_TARGET)
+
+
+def lint_replay_device_path() -> tuple[list[tuple[str, int, str]], set[str]]:
+    """Check 9: no journal IO / os.* / host RNG in the traced replay
+    sample + priority-update path (ops/sum_tree.py functions, the DQN
+    ``one_step`` closure); the orchestrator's consumer-side journal
+    functions must still exist. Returns (hits, found names over all
+    three watch sets)."""
+    tree_bad, tree_found = _scan_named_funcs(
+        REPLAY_TREE_FUNCS, REPLAY_BLOCK_PATTERN, REPLAY_MARKER,
+        target=REPLAY_TREE_TARGET)
+    dqn_bad, dqn_found = _scan_named_funcs(
+        REPLAY_DQN_FUNCS, REPLAY_BLOCK_PATTERN, REPLAY_MARKER,
+        target=REPLAY_DQN_TARGET)
+    _none, orch_found = _scan_named_funcs(
+        (), REPLAY_BLOCK_PATTERN, REPLAY_MARKER,
+        also_find=REPLAY_CONSUMER_FUNCS)
+    return tree_bad + dqn_bad, tree_found | dqn_found | orch_found
 
 
 def lint_dispatcher_blocking() -> tuple[list[tuple[str, int, str]], set[str]]:
@@ -485,6 +542,26 @@ def main() -> int:
               f"line '# {SERVE_MARKER}: <why this host op is on the "
               "dispatch path on purpose>'")
         return 1
+    replay_bad, replay_found = lint_replay_device_path()
+    replay_missing = (set(REPLAY_TREE_FUNCS) | set(REPLAY_DQN_FUNCS)
+                      | set(REPLAY_CONSUMER_FUNCS)) - replay_found
+    if replay_missing:
+        print(f"replay device-path lint: function(s) "
+              f"{sorted(replay_missing)} not found — the replay data "
+              "plane's device/host split was renamed; update "
+              "tools/lint_hot_loop.py REPLAY_TREE_FUNCS/REPLAY_DQN_FUNCS/"
+              "REPLAY_CONSUMER_FUNCS")
+        return 1
+    if replay_bad:
+        print("replay device-path lint FAILED:")
+        for fn, ln, text in replay_bad:
+            print(f"  {fn}:{ln}: {text}")
+        print("journal IO / os.* / host RNG in the traced replay sample "
+              "or priority-update path either freezes at trace time or "
+              "adds a host sync to the chunk; move it to the consumer "
+              "side (_journal_transitions / _warm_start_replay), or tag "
+              f"the line '# {REPLAY_MARKER}: <why this is trace-safe>'")
+        return 1
     dur_bad = lint_durable_replace()
     if dur_bad:
         print("durable-rename fsync lint FAILED:")
@@ -504,6 +581,7 @@ def main() -> int:
           f"roofline capture lint OK; "
           f"precision-cast lint OK; "
           f"serve batch-dispatch lint OK ({', '.join(SERVE_DISPATCH_FUNCS)}); "
+          f"replay device-path lint OK ({', '.join(REPLAY_TREE_FUNCS + REPLAY_DQN_FUNCS)}); "
           f"durable-rename fsync lint OK ({', '.join(DURABLE_WRITE_FILES)})")
     return 0
 
