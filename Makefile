@@ -8,12 +8,13 @@
 
 PYTHON ?= python
 
-.PHONY: check test slow native bench bench-async bench-ckpt bench-dispatch bench-obs bench-precision bench-replay bench-reshard bench-roofline bench-serve crash-soak obs-demo lint perf-gate serve-soak shard-audit clean
+.PHONY: check test slow native bench bench-async bench-ckpt bench-dispatch bench-obs bench-precision bench-replay bench-reshard bench-roofline bench-serve bench-serve-overload crash-soak obs-demo lint perf-gate serve-chaos serve-soak shard-audit clean
 
 check: native lint
 	$(PYTHON) -m pytest tests/ -q -m "not slow" -x
 	$(PYTHON) tools/smoke_compile.py
 	$(PYTHON) tools/obs_demo.py
+	$(PYTHON) tools/serve_chaos.py --injections 2
 	$(PYTHON) tools/shard_audit.py
 	$(PYTHON) tools/perf_gate.py
 
@@ -125,6 +126,24 @@ perf-gate:
 # enforces the >=3x-QPS-at-equal-or-better-p99 acceptance (ISSUE 8).
 serve-soak:
 	$(PYTHON) tools/serve_soak.py --strict
+
+# Serve chaos soak: >= 20 seeded fault injections (dispatch exception,
+# slow consumer, corrupt swap candidate, queue flood, deadline burst)
+# against the real continuous-batching engine, asserting after every one:
+# no wedge (every request reaches a terminal outcome), queue depth stays
+# <= serve.max_queue, post-restart sessions match fresh sessions bitwise,
+# and shed/restart/breaker counters reconcile exactly with the injected
+# counts (tools/serve_chaos.py; the 2-injection quick profile runs in
+# tier-1 and in `make check`).
+serve-chaos:
+	$(PYTHON) tools/serve_chaos.py --injections 20
+
+# Serving-tier overload A/B (bounded+shedding engine vs the unbounded
+# PR-8 shape at 8x the engine's own saturation rate): shed rate + p99,
+# the numbers behind BASELINE.md "Serve under overload".
+bench-serve-overload:
+	$(PYTHON) -c "import json, bench; \
+	print(json.dumps(bench.bench_serve_overload(), indent=2))"
 
 # Process-kill chaos soak: >= 20 seeded SIGKILL/SIGTERM injections into real
 # training subprocesses (journaled DQN config), each followed by --resume,

@@ -873,6 +873,134 @@ def bench_serve(*, duration_s: float = 2.5, sessions: int = 512,
     return result
 
 
+def bench_serve_overload(*, duration_s: float = 2.5, sessions: int = 2048,
+                         max_batch: int = 16, max_queue: int = 256,
+                         overload_multiple: float = 8.0) -> dict:
+    """Serve-under-overload A/B (ISSUE 10; BASELINE.md "Serve under
+    overload"): open-loop arrivals at ``overload_multiple`` x the
+    engine's OWN measured saturation QPS (the self-normalizing framing —
+    8x saturation is unambiguous overload on any host, where 8x the
+    batch=1 baseline can still be below engine capacity), against
+
+    - the **shedding engine** (``serve.max_queue``, ``shed_policy=
+      "oldest"``): queueing delay is bounded by the queue bound, so p99
+      on ADMITTED requests stays finite while the excess is shed with
+      explicit terminal outcomes; and
+    - the **unbounded PR-8 shape** (``max_queue`` effectively infinite):
+      every arrival queues, so waiting time — and host memory — grows
+      with the backlog; p99 runs away with offered load x duration (on
+      this harness the backlog is capped by the generator's one-in-
+      flight-per-session rule at ``sessions``, so the reported runaway
+      p99 is a LOWER bound on the true unbounded behavior).
+
+    Gate row: ``serve_overload_p99_ms`` = the shedding engine's p99 at
+    8x (HIGHER is worse — the gate inverts its band for ``*_ms``
+    metrics). The runaway arm's p99 is recorded but NOT gated — it
+    measures the backlog, i.e. scheduler noise at saturation, not a
+    servable latency."""
+    import os
+    import sys
+    import threading
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import serve_soak
+
+    from sharetrade_tpu.config import ServeConfig
+    from sharetrade_tpu.serve import ServeEngine
+    from sharetrade_tpu.serve.driver import (
+        make_sessions,
+        run_closed_loop,
+        run_open_loop,
+    )
+    from sharetrade_tpu.utils.metrics import MetricsRegistry
+
+    cfg_env = FrameworkConfig()
+    model, params, prices, window = serve_soak.build_workload(mlp=True)
+    slots = max(4 * max_batch, sessions // 4)
+
+    def build(queue_bound: int, policy: str):
+        registry = MetricsRegistry()
+        engine = ServeEngine(
+            model,
+            ServeConfig(max_batch=max_batch, slots=slots,
+                        batch_timeout_ms=2.0, swap_poll_s=0.0,
+                        stats_interval_s=0.5, max_queue=queue_bound,
+                        shed_policy=policy),
+            params, registry=registry)
+        engine.warmup()
+        return engine, registry
+
+    def watch_depth(engine, stop_evt, peak):
+        while not stop_evt.is_set():
+            peak[0] = max(peak[0], engine.queue_depth())
+            stop_evt.wait(0.005)
+
+    # The engine's own capacity anchors the overload rate.
+    engine, _ = build(max_queue, "oldest")
+    saturation = run_closed_loop(
+        engine, make_sessions(prices, window, sessions, prefix="sat-"),
+        concurrency=2 * max_batch, duration_s=min(duration_s, 2.0))
+    engine.stop()
+    rate = overload_multiple * saturation["qps"]
+
+    arms = {}
+    for arm, (queue_bound, policy) in {
+        "shedding": (max_queue, "oldest"),
+        # 2**31: the pre-ISSUE-10 unbounded ingress, reproduced under
+        # the same engine so ONLY admission control differs.
+        "unbounded": (2 ** 31, "reject"),
+    }.items():
+        engine, registry = build(queue_bound, policy)
+        stop_evt = threading.Event()
+        peak = [0]
+        watcher = threading.Thread(target=watch_depth,
+                                   args=(engine, stop_evt, peak),
+                                   daemon=True)
+        watcher.start()
+        run = run_open_loop(
+            engine, make_sessions(prices, window, sessions,
+                                  prefix=f"{arm}-"),
+            rate_qps=rate, duration_s=duration_s)
+        stop_evt.set()
+        watcher.join(5.0)
+        engine.stop(drain=False)
+        counters = registry.counters()
+        arms[arm] = {
+            "qps": round(run["qps"], 1),
+            "p50_ms": round(run["p50_ms"], 3),
+            "p99_ms": round(run["p99_ms"], 3),
+            "completed": run["completed"],
+            "failed": run["failed"],
+            "generator_dropped": run["dropped"],
+            "shed_total": int(counters.get("serve_shed_total", 0)),
+            "queue_rejected_total": int(
+                counters.get("serve_queue_rejected_total", 0)),
+            "queue_depth_peak": peak[0],
+        }
+    shed = arms["shedding"]
+    shed_events = shed["shed_total"] + shed["queue_rejected_total"]
+    offered_to_engine = shed["completed"] + shed["failed"]
+    precision = cfg_env.precision.mode
+    return {
+        **_result_envelope(cfg_env),
+        "metric": "serve_overload_p99_ms",
+        "value": shed["p99_ms"],
+        "unit": "ms",
+        "precision": precision,
+        "note": "shedding-engine p99 on admitted requests at "
+                f"{overload_multiple:g}x its own saturation rate; "
+                "higher is worse (gate band inverted)",
+        "saturation_qps": round(saturation["qps"], 1),
+        "offered_rate_qps": round(rate, 1),
+        "overload_multiple": overload_multiple,
+        "max_queue": max_queue,
+        "sessions": sessions,
+        "shed_rate": round(shed_events / max(offered_to_engine, 1), 4),
+        "shedding": shed,
+        "unbounded": arms["unbounded"],
+    }
+
+
 def bench_replay(*, chunks: int = 24, trials: int = 2,
                  sample_iters: int = 100,
                  eff_max_chunks: int = 150) -> dict:
@@ -1388,6 +1516,7 @@ def _await_devices(attempts: int = 3, timeout_s: float = 180.0,
                  "r['roofline'] = bench.bench_roofline(); "
                  "r['precision'] = bench.bench_precision(); "
                  "r['serve'] = bench.bench_serve(); "
+                 "r['serve_overload'] = bench.bench_serve_overload(); "
                  "r['replay'] = bench.bench_replay(); "
                  "print(json.dumps(r))"],
                 env=scrub, cwd=repo,
@@ -1449,6 +1578,7 @@ def main() -> None:
     result["roofline"] = bench_roofline()
     result["precision"] = bench_precision()
     result["serve"] = bench_serve()
+    result["serve_overload"] = bench_serve_overload()
     result["replay"] = bench_replay()
     print(json.dumps(result), flush=True)
 

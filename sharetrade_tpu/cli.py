@@ -297,7 +297,10 @@ def cmd_serve(args) -> int:
         if cfg.serve.swap_poll_s > 0:
             watcher = WeightSwapWatcher(
                 engine, manager, template, tag=cfg.serve.swap_tag,
-                poll_s=cfg.serve.swap_poll_s, seen_meta=boot_meta).start()
+                poll_s=cfg.serve.swap_poll_s, seen_meta=boot_meta,
+                breaker_failures=cfg.serve.swap_breaker_failures,
+                breaker_cooldown_s=cfg.serve.swap_breaker_cooldown_s,
+            ).start()
         # Readiness line (machine-readable: the soak/tests wait on it).
         print(json.dumps({"event": "serving_ready", "params_step": step,
                           "model": agent.model.name,
@@ -314,9 +317,27 @@ def cmd_serve(args) -> int:
                 engine, sessions, concurrency=cfg.serve.max_batch,
                 duration_s=args.duration, stop=stop_evt)
 
-        # Drain inside the preemption grace budget, flush telemetry.
+        # Drain + stop INSIDE the preemption grace budget (the hung-
+        # thread check must run BEFORE the summary so the exit code
+        # can't report a clean shutdown the threads didn't deliver).
+        # The budget is subdivided: stop() waits on up to three seams
+        # sequentially (dispatcher join, shutdown sentinel, consumer
+        # join), so handing it the full grace each time could spend ~4x
+        # grace with a hung consumer — past the point a fleet SIGKILLs
+        # us, losing the summary entirely.
         grace = cfg.runtime.preempt_grace_s
-        drained = engine.drain(timeout_s=grace)
+        drained = engine.drain(timeout_s=grace * 0.5)
+        if watcher is not None:
+            watcher.stop()
+        # Per-seam timeout: the 1 s floor keeps healthy shutdowns from
+        # flaking on a briefly-busy thread, but it must never push the
+        # three sequential seams past the half of the grace budget left
+        # after the drain — grace/6 caps the floor so a small
+        # preempt_grace_s still beats the fleet's SIGKILL.
+        stopped_clean = engine.stop(
+            drain=False,
+            timeout_s=min(max(grace / 8.0, 1.0), grace / 6.0))
+        engine_failed = engine.failed is not None
         obs_bundle.flush()
         counters = registry.counters()
         summary = {
@@ -325,17 +346,38 @@ def cmd_serve(args) -> int:
             "swaps": int(counters.get("serve_swaps_total", 0)),
             "swap_rejected": int(
                 counters.get("serve_swap_rejected_total", 0)),
+            "swap_breaker_opens": int(
+                counters.get("serve_swap_breaker_opens_total", 0)),
             "evictions": int(counters.get("serve_evictions_total", 0)),
             "prefills": int(counters.get("serve_prefills_total", 0)),
             "requests": int(counters.get("serve_requests_total", 0)),
+            "shed": int(counters.get("serve_shed_total", 0)),
+            "queue_rejected": int(
+                counters.get("serve_queue_rejected_total", 0)),
+            "deadline_expired": int(
+                counters.get("serve_deadline_expired_total", 0)),
+            "restarts": int(counters.get("serve_restarts_total", 0)),
             "drained": drained,
+            "stopped_clean": stopped_clean,
+            "engine_failed": engine_failed,
         }
         if preempt_at:
             summary["preempted"] = True
             log.warning("serve run preempted; in-flight requests %s",
                         "drained" if drained else "NOT fully drained")
+        if engine_failed:
+            log.error("serve engine ended in the TERMINAL FAILED state "
+                      "(restart storm past serve.max_restarts): %r",
+                      engine.failed)
         print(json.dumps(summary))
-        return EXIT_PREEMPTED if preempt_at else 0
+        if preempt_at:
+            return EXIT_PREEMPTED
+        if not stopped_clean or engine_failed:
+            # A hung dispatcher/consumer thread — or an engine that died
+            # in its terminal failed state mid-run — must surface as a
+            # failed run, not a quiet success.
+            return 1
+        return 0
     finally:
         for s, h in prev_handlers.items():
             signal.signal(s, h)

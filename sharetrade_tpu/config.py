@@ -487,6 +487,49 @@ class ServeConfig:
     stats_interval_s: float = 1.0
     # Per-request latency ring the percentile gauges are computed over.
     latency_window: int = 8192
+    # --- Overload & failure semantics (README "Serving tier") ---------
+    # Admission control: the ingress queue holds at most this many
+    # requests. A submit past the bound is never silently absorbed into
+    # host memory: under shed_policy="reject" the NEW request is refused
+    # (its handle completes immediately with a ServeRejected error);
+    # under "oldest" the OLDEST queued request is shed instead and the
+    # new one admitted (brownout: bounded queueing delay, finite p99,
+    # at the cost of failing stale work first — BASELINE.md "Serve
+    # under overload"). Must be >= 1: an unbounded ingress queue turns
+    # a request flood into unbounded host memory growth
+    # (tools/lint_hot_loop.py check 10 guards the code side).
+    max_queue: int = 1024
+    shed_policy: str = "reject"          # "reject" | "oldest"
+    # Default per-request deadline (milliseconds), overridable per
+    # submit(..., deadline_ms=). 0 = no deadline. An expired request is
+    # completed with a ServeDeadlineExceeded error BEFORE batch
+    # collection, so dead work never occupies a padded device row; the
+    # batch-coalescing deadline is anchored to the earliest surviving
+    # request's deadline so admission never expires a request it could
+    # have served.
+    default_deadline_ms: float = 0.0
+    # Dispatch supervision: after a dispatch/consumer fault fails its
+    # batch, retry the ENGINE — rebuild the jitted programs and a fresh
+    # slot arena (every session re-enters cold through the batched
+    # prefill, which is bitwise-equivalent to a fresh session suffix)
+    # under seeded exponential backoff. 0 = PR-8 behavior: fail the
+    # batch, keep the arena, never rebuild (a per-request fault like a
+    # malformed observation then costs one batch, not every warm
+    # session's carry). More than max_restarts CONSECUTIVE faults
+    # (the streak resets on a completed batch) trip the engine into a
+    # terminal failed state that fails all queued work loudly instead
+    # of wedging.
+    max_restarts: int = 0
+    restart_backoff_s: float = 0.05      # initial; doubles per attempt
+    restart_backoff_max_s: float = 2.0   # backoff ceiling
+    # Hot-swap circuit breaker: this many CONSECUTIVE verified-restore
+    # failures (distinct corrupt/mismatched candidates) stop the watcher
+    # from polling the wedged tag for swap_breaker_cooldown_s (exported
+    # as the serve_swap_breaker_open gauge); after the cooldown one
+    # probe poll runs — success closes the breaker, failure re-opens
+    # it. 0 disables the breaker (every fresh candidate is verified).
+    swap_breaker_failures: int = 3
+    swap_breaker_cooldown_s: float = 30.0
 
 
 @dataclass

@@ -240,6 +240,20 @@ def summarize_run_dir(run_dir: str) -> dict:
                 "swaps_total": counters.get("serve_swaps_total", 0.0),
                 "swaps_rejected_total": counters.get(
                     "serve_swap_rejected_total", 0.0),
+                # Overload & failure surface (ISSUE 10): shedding,
+                # deadline expiry, supervised restarts, and the hot-swap
+                # breaker in the same glanceable block.
+                "overload": gauges.get("serve_overload"),
+                "shed_total": counters.get("serve_shed_total", 0.0),
+                "queue_rejected_total": counters.get(
+                    "serve_queue_rejected_total", 0.0),
+                "deadline_expired_total": counters.get(
+                    "serve_deadline_expired_total", 0.0),
+                "restarts_total": counters.get("serve_restarts_total", 0.0),
+                "engine_failed": gauges.get("serve_failed"),
+                "swap_breaker_open": gauges.get("serve_swap_breaker_open"),
+                "swap_breaker_opens_total": counters.get(
+                    "serve_swap_breaker_opens_total", 0.0),
             }
     roofline = read_roofline(run_dir)
     if roofline is not None:

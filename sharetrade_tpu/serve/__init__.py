@@ -2,16 +2,23 @@
 
 - :mod:`engine` — :class:`ServeEngine`: deadline-coalesced padded device
   batches over a device-resident session slot pool (LRU admission /
-  eviction, batched re-prefill), dispatcher/consumer split, SLO gauges.
+  eviction, batched re-prefill), dispatcher/consumer split, SLO gauges;
+  overload-safe (bounded ingress + shedding, per-request deadlines) and
+  self-healing (supervised engine rebuild under backoff, terminal failed
+  state) — ISSUE 10's contract, pinned by ``tools/serve_chaos.py``.
 - :mod:`swap` — :class:`WeightSwapWatcher`: hot weight swaps from the
   crash-safe tagged checkpoint through the verified restore path, applied
-  atomically between batches.
+  atomically between batches; repeated verified-restore failures open a
+  circuit breaker instead of re-hammering a wedged tag.
 - :mod:`driver` — synthetic portfolio sessions + closed/open-loop load
   harnesses (``cli serve``, ``tools/serve_soak.py``, ``bench_serve``).
 """
 
 from sharetrade_tpu.serve.engine import (  # noqa: F401
+    ServeDeadlineExceeded,
     ServeEngine,
+    ServeEngineFailed,
+    ServeRejected,
     ServeResult,
     SlotPool,
 )

@@ -162,7 +162,8 @@ class BatchOneServer:
             with self._cv:
                 if not self._q:
                     return True
-            time.sleep(0.002)
+            time.sleep(0.002)   # serve-block-ok: baseline server's drain
+            # poll, caller's thread — not an engine dispatch path.
         return False
 
     def stop(self, **_kw) -> None:
@@ -210,43 +211,90 @@ def run_closed_loop(server: Any, sessions: list[SessionSim], *,
                     concurrency: int, duration_s: float,
                     stop: threading.Event | None = None) -> dict:
     """``concurrency`` sessions each keep one request in flight for
-    ``duration_s``; returns achieved QPS + latency percentiles."""
+    ``duration_s``; returns achieved QPS + latency percentiles (plus a
+    ``failed`` count — requests that terminated without a result: batch
+    failures, sheds, deadline expiries)."""
     lock = threading.Lock()
     lat: list[float] = []
     done_evt = threading.Event()
-    state = {"inflight": 0, "closing": False}
+    state = {"inflight": 0, "failed": 0}
+    #: Sessions whose request FAILED, parked for the main thread to
+    #: resubmit. An overload-shedding engine completes a rejected submit
+    #: synchronously on the submitting thread — resubmitting from inside
+    #: the callback would recurse submit→reject→callback→submit without
+    #: bound under sustained overload, so the failure path always defers.
+    retry: deque[SessionSim] = deque()
     t_end = time.perf_counter() + duration_s
 
-    def resubmit(sess: SessionSim) -> None:
+    def cb_for(sess: SessionSim):
         def cb(result, _sess=sess):
-            # result None = the request's batch failed to dispatch: the
-            # session didn't advance; keep it in the loop without
-            # recording a latency.
             if result is not None:
                 with lock:
                     lat.append(result.latency_ms)
                 _sess.advance(result.action)
-            now = time.perf_counter()
-            if now < t_end and not (stop is not None and stop.is_set()):
-                server.submit(_sess.sid, _sess.observation(), cb)
+                now = time.perf_counter()
+                if now < t_end and not (stop is not None
+                                        and stop.is_set()):
+                    try:
+                        server.submit(_sess.sid, _sess.observation(), cb)
+                        return
+                    except Exception:   # noqa: BLE001 — engine stopped
+                        # or terminally failed between the completion and
+                        # this resubmit: retire the session below instead
+                        # of letting the engine's callback guard swallow
+                        # the raise and strand done_evt.
+                        pass
             else:
                 with lock:
-                    state["inflight"] -= 1
-                    if state["inflight"] == 0:
-                        done_evt.set()
-        server.submit(sess.sid, sess.observation(), cb)
+                    state["failed"] += 1
+                now = time.perf_counter()
+                if now < t_end and not (stop is not None
+                                        and stop.is_set()):
+                    with lock:
+                        retry.append(_sess)
+                    return
+            with lock:
+                state["inflight"] -= 1
+                if state["inflight"] == 0:
+                    done_evt.set()
+        return cb
 
     t0 = time.perf_counter()
     with lock:
         state["inflight"] = min(concurrency, len(sessions))
     for sess in sessions[:concurrency]:
-        resubmit(sess)
-    done_evt.wait(duration_s + 30.0)
+        server.submit(sess.sid, sess.observation(), cb_for(sess))
+    deadline = time.monotonic() + duration_s + 30.0
+    while not done_evt.is_set() and time.monotonic() < deadline:
+        with lock:
+            parked = list(retry)
+            retry.clear()
+        if parked:
+            now = time.perf_counter()
+            for sess in parked:
+                resubmitted = False
+                if now < t_end and not (stop is not None
+                                        and stop.is_set()):
+                    try:
+                        server.submit(sess.sid, sess.observation(),
+                                      cb_for(sess))
+                        resubmitted = True
+                    except Exception:   # noqa: BLE001 — engine gone
+                        # terminal mid-harness: retire the session, keep
+                        # the measurement loop accountable.
+                        pass
+                if not resubmitted:
+                    with lock:
+                        state["inflight"] -= 1
+                        if state["inflight"] == 0:
+                            done_evt.set()
+        done_evt.wait(0.01)
     elapsed = time.perf_counter() - t0
     with lock:
         n = len(lat)
+        failed = state["failed"]
     return {"mode": "closed_loop", "concurrency": concurrency,
-            "completed": n, "elapsed_s": elapsed,
+            "completed": n, "failed": failed, "elapsed_s": elapsed,
             "qps": n / max(elapsed, 1e-9), **_percentiles(lat)}
 
 
@@ -261,7 +309,7 @@ def run_open_loop(server: Any, sessions: list[SessionSim], *,
     lat: list[float] = []
     ready: deque[SessionSim] = deque(sessions)
     offered = dropped = 0
-    inflight = {"n": 0, "last_done": time.perf_counter()}
+    inflight = {"n": 0, "failed": 0, "last_done": time.perf_counter()}
     idle_evt = threading.Event()
 
     def cb_for(sess: SessionSim):
@@ -270,6 +318,8 @@ def run_open_loop(server: Any, sessions: list[SessionSim], *,
                 if result is not None:
                     lat.append(result.latency_ms)
                     inflight["last_done"] = time.perf_counter()
+                else:
+                    inflight["failed"] += 1
                 inflight["n"] -= 1
                 if inflight["n"] == 0:
                     idle_evt.set()
@@ -294,7 +344,8 @@ def run_open_loop(server: Any, sessions: list[SessionSim], *,
         # never silently lower the offered rate.
         due = int((now - t0) / spacing) + 1 - issued
         if due <= 0:
-            time.sleep(min(t0 + issued * spacing - now, 0.001))
+            time.sleep(min(t0 + issued * spacing - now, 0.001))  # serve-block-ok:
+            # the load GENERATOR's pacing sleep — its own thread, not the engine.
             continue
         for _ in range(min(due, 512)):
             issued += 1
@@ -307,7 +358,18 @@ def run_open_loop(server: Any, sessions: list[SessionSim], *,
             with lock:
                 inflight["n"] += 1
                 idle_evt.clear()
-            server.submit(sess.sid, sess.observation(), cb_for(sess))
+            try:
+                server.submit(sess.sid, sess.observation(), cb_for(sess))
+            except Exception:   # noqa: BLE001 — engine stopped or
+                # terminally failed mid-run: count the arrival as failed,
+                # release the in-flight slot, keep the generator
+                # accountable (cmd_serve still prints its summary).
+                with lock:
+                    inflight["failed"] += 1
+                    inflight["n"] -= 1
+                    if inflight["n"] == 0:
+                        idle_evt.set()
+                    ready.append(sess)
     # Let the tail of in-flight requests complete before measuring; QPS is
     # counted over [start, max(last completion, generation span)] — a long
     # drain tail doesn't dilute the achieved rate, a generator that idled
@@ -317,9 +379,11 @@ def run_open_loop(server: Any, sessions: list[SessionSim], *,
     idle_evt.wait(10.0)
     with lock:
         n = len(lat)
+        failed = inflight["failed"]
         elapsed = max(inflight["last_done"] - t0,
                       min(duration_s, gen_end - t0))
     return {"mode": "open_loop", "rate_qps": rate_qps,
             "offered": offered, "dropped": dropped, "completed": n,
-            "elapsed_s": elapsed, "qps": n / max(elapsed, 1e-9),
+            "failed": failed, "elapsed_s": elapsed,
+            "qps": n / max(elapsed, 1e-9),
             **_percentiles(lat)}

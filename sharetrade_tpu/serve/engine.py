@@ -49,11 +49,40 @@ returns BIT-IDENTICAL logits/actions to threading each session one at a
 time through ``model.apply`` — batching is a scheduling optimization,
 never a numerics change. bf16_mixed serving inherits the PR-7 tolerance
 contract instead.
+
+Overload & failure semantics (ISSUE 10; tools/serve_chaos.py pins them):
+
+- **Admission control**: the ingress queue is bounded at
+  ``serve.max_queue``; a submit past the bound never blocks and never
+  grows host memory — the new request is refused
+  (``shed_policy="reject"``) or the oldest queued request is shed
+  (``"oldest"``), the loser completing immediately with
+  :class:`ServeRejected`. Counters ``serve_queue_rejected_total`` /
+  ``serve_shed_total``, gauge ``serve_overload``.
+- **Deadlines**: ``submit(..., deadline_ms=)`` (default
+  ``serve.default_deadline_ms``) expires un-dispatched requests with
+  :class:`ServeDeadlineExceeded` at batch-collection time, before they
+  can occupy a padded device row; coalescing waits are clamped to the
+  earliest surviving deadline. Counter ``serve_deadline_expired_total``.
+- **Supervision** (``serve.max_restarts > 0``): a dispatch/consumer
+  fault fails its batch, then the engine itself is retried — fresh
+  jitted programs + fresh slot arena under seeded exponential backoff
+  (``serve.restart_backoff_s``); sessions re-enter cold through the
+  batched prefill (bitwise-equivalent to a fresh session, the PR-8
+  eviction contract). More than ``max_restarts`` CONSECUTIVE faults trip
+  a terminal failed state that fails all queued work loudly
+  (:class:`ServeEngineFailed`) instead of wedging. Counter
+  ``serve_restarts_total``, gauge ``serve_failed``.
+
+Every submitted request reaches exactly one terminal outcome — result,
+rejection, deadline error, batch failure, or engine failure — the chaos
+soak's core invariant.
 """
 
 from __future__ import annotations
 
 import queue
+import random
 import threading
 import time
 from collections import OrderedDict, deque
@@ -72,6 +101,34 @@ from sharetrade_tpu.utils.metrics import MetricsRegistry
 log = get_logger("serve")
 
 _SHUTDOWN = object()
+
+
+class ServeRejected(RuntimeError):
+    """The request was refused admission (ingress queue at
+    ``serve.max_queue`` under ``shed_policy="reject"``) or shed from the
+    queue under overload (``shed_policy="oldest"``). Always delivered as a
+    completed handle (``wait()`` returns None, :attr:`_Request.error`
+    carries this), never as a silent block of the caller's thread.
+    ``reason`` is ``"queue_full"`` / ``"shed_oldest"`` /
+    ``"deferred_overflow"``."""
+
+    def __init__(self, message: str, *, reason: str):
+        super().__init__(message)
+        self.reason = reason
+
+
+class ServeDeadlineExceeded(RuntimeError):
+    """The request's deadline (``submit(..., deadline_ms=)`` or
+    ``serve.default_deadline_ms``) expired before it reached a device
+    batch; it was completed with this error instead of occupying a padded
+    device row."""
+
+
+class ServeEngineFailed(RuntimeError):
+    """The engine tripped its terminal failed state: more than
+    ``serve.max_restarts`` consecutive dispatch/consumer faults. All
+    queued and future work fails loudly with this error (wrapping the
+    last underlying fault) instead of wedging."""
 
 
 def latency_percentiles(values) -> dict[str, float]:
@@ -111,26 +168,37 @@ class _Live(NamedTuple):
 
 
 class _Request:
-    """A submitted query; completed by the consumer thread."""
+    """A submitted query; completed by the consumer thread (or, for
+    rejected/expired work, by the thread that discovered the terminal
+    outcome)."""
 
-    __slots__ = ("session_id", "obs", "t_enq", "callback", "_event",
-                 "result", "error")
+    __slots__ = ("session_id", "obs", "t_enq", "t_deadline", "callback",
+                 "_event", "result", "error")
 
     def __init__(self, session_id: Any, obs: np.ndarray,
-                 callback: Callable[[ServeResult | None], None] | None):
+                 callback: Callable[[ServeResult | None], None] | None,
+                 deadline_ms: float = 0.0):
         self.session_id = session_id
         self.obs = obs
         self.t_enq = time.perf_counter()
+        #: Absolute expiry on the perf_counter clock; None = no deadline.
+        #: A NEGATIVE deadline_ms (a client whose latency budget already
+        #: ran out before submit) means already-expired — clamped to the
+        #: enqueue instant, NOT silently promoted to "no deadline".
+        self.t_deadline = (self.t_enq + max(deadline_ms, 0.0) / 1e3
+                           if deadline_ms else None)
         self.callback = callback
         self._event = threading.Event()
         self.result: ServeResult | None = None
-        #: Set when the request's batch failed to dispatch — lets callers
-        #: distinguish a served-nothing failure from a wait() timeout.
+        #: Set when the request failed terminally without a result —
+        #: ServeRejected (admission/shedding), ServeDeadlineExceeded,
+        #: ServeEngineFailed, or the dispatch fault that failed its batch
+        #: — so callers can distinguish failure from a wait() timeout.
         self.error: BaseException | None = None
 
     def wait(self, timeout: float | None = None) -> ServeResult | None:
         """Block until the response is ready; None on timeout or when the
-        request's batch failed (then :attr:`error` carries the cause)."""
+        request failed (then :attr:`error` carries the cause)."""
         self._event.wait(timeout)
         return self.result
 
@@ -144,6 +212,11 @@ class _DoneBatch(NamedTuple):
     n: int                 # real rows in the tick
     cold: int              # rows served through the prefill
     evicted: int           # sessions evicted to admit this tick's rows
+    #: Supervision fault epoch at dispatch time: only a batch dispatched
+    #: AFTER the latest fault may reset the consecutive-fault streak —
+    #: pre-fault batches draining out of the done queue during a backoff
+    #: attest nothing about post-fault engine health.
+    epoch: int = 0
 
 
 class SlotPool:
@@ -208,7 +281,8 @@ class ServeEngine:
                  precision: PrecisionPolicy = FP32,
                  registry: MetricsRegistry | None = None,
                  obs: Any = None,
-                 done_depth: int = 4):
+                 done_depth: int = 4,
+                 restart_seed: int | None = None):
         if cfg.max_batch < 1:
             raise ConfigError(
                 f"serve.max_batch must be >= 1, got {cfg.max_batch}")
@@ -221,6 +295,28 @@ class ServeEngine:
             raise ConfigError(
                 f"serve.batch_timeout_ms must be >= 0, got "
                 f"{cfg.batch_timeout_ms}")
+        if cfg.max_queue < 1:
+            raise ConfigError(
+                f"serve.max_queue must be >= 1 (an unbounded ingress queue "
+                f"turns a request flood into unbounded host memory), got "
+                f"{cfg.max_queue}")
+        if cfg.shed_policy not in ("reject", "oldest"):
+            raise ConfigError(
+                f"serve.shed_policy must be 'reject' or 'oldest', got "
+                f"{cfg.shed_policy!r}")
+        if cfg.default_deadline_ms < 0:
+            raise ConfigError(
+                f"serve.default_deadline_ms must be >= 0 (0 = none), got "
+                f"{cfg.default_deadline_ms}")
+        if cfg.max_restarts < 0:
+            raise ConfigError(
+                f"serve.max_restarts must be >= 0 (0 = no engine rebuild), "
+                f"got {cfg.max_restarts}")
+        if cfg.restart_backoff_s <= 0 or cfg.restart_backoff_max_s <= 0:
+            raise ConfigError(
+                "serve.restart_backoff_s / restart_backoff_max_s must be "
+                f"> 0, got {cfg.restart_backoff_s}/"
+                f"{cfg.restart_backoff_max_s}")
         self.model = model
         self.cfg = cfg
         self._precision = precision
@@ -230,43 +326,47 @@ class ServeEngine:
                          and model.apply_serve_batch is not None)
         self._live = _Live(jax.device_put(precision.cast_compute(params)),
                            int(params_step))
-        self._slots = SlotPool(cfg.slots)
+        self._carry0 = precision.cast_carry(model.init_carry(), model)
+        self._build_arena_and_programs()
 
-        # Device arena: one carry row per slot, plus max_batch SCRATCH rows
-        # (indices >= cfg.slots) that padding rows read/write so a partial
-        # batch can never touch a live session's slot.
-        carry0 = precision.cast_carry(model.init_carry(), model)
-        n_arena = cfg.slots + cfg.max_batch
-        self._pool = jax.tree.map(
-            lambda x: jnp.repeat(jnp.asarray(x)[None], n_arena, axis=0),
-            carry0)
-        # Per-row init carries for the generic path's in-program cold reset.
-        self._carry0_rows = jax.tree.map(
-            lambda x: jnp.repeat(jnp.asarray(x)[None], cfg.max_batch,
-                                 axis=0), carry0)
-
-        # The arena is DONATED on every backend: scatter into an aliased
-        # buffer updates in place, a non-donated pool round-trips a full
-        # arena copy per tick (measured 5.5x tick cost at the soak shape).
-        # The PR-4 CPU donation carve-out (runtime/orchestrator.py) does
-        # not apply here: its segfault was a consumer device_get racing a
-        # dispatch that donated the very state the readback came from; the
-        # pool never leaves the device, and the consumer reads only the
-        # action/logit/value outputs, which are never donated.
-        donate = (1,)
-        if self._episode:
-            self._warm_fn = jax.jit(self._warm_program, donate_argnums=donate)
-            self._cold_fn = jax.jit(self._cold_program, donate_argnums=donate)
-        else:
-            self._step_fn = jax.jit(self._generic_program,
-                                    donate_argnums=donate)
-
-        self._q: queue.Queue = queue.Queue()
+        # Bounded ingress: depth caps at serve.max_queue, the overload
+        # surface (submit sheds/rejects instead of growing host memory).
+        self._q: queue.Queue = queue.Queue(maxsize=cfg.max_queue)
         self._deferred: deque[_Request] = deque()
         self._done_q: queue.Queue = queue.Queue(maxsize=done_depth)
+        #: Sessions whose slot carry is suspect after a CONSUMER fault
+        #: (the device program advanced their carries, the readback
+        #: failed): appended by the consumer, drained — and dropped from
+        #: the pool — by the DISPATCHER, which owns the SlotPool (a
+        #: cross-thread drop would race admit()'s LRU iteration).
+        self._poisoned: deque = deque()
         self._stop_event = threading.Event()
         self._pending = 0
         self._pending_lock = threading.Lock()
+
+        # Supervision state (serve.max_restarts > 0): consecutive-fault
+        # streak (guarded by _sup_lock — the dispatcher increments, the
+        # consumer resets), the fault epoch gating those resets, a
+        # consumer-side restart request, and the terminal fault.
+        self._restart_streak = 0
+        self._sup_lock = threading.Lock()
+        self._fault_epoch = 0
+        # Backoff jitter seed: None (the production default — cli serve
+        # never passes one) draws per-process OS entropy, so a fleet of
+        # replicas does NOT share a jitter sequence and restart in
+        # lockstep; tests/the chaos soak pass an int for replayability.
+        self._restart_rng = random.Random(restart_seed)
+        self._restart_requested = threading.Event()
+        self._consumer_fault: BaseException | None = None
+        #: Fault epoch of the batch whose completion faulted: a fault
+        #: from a batch dispatched BEFORE the latest restart is stale —
+        #: the rebuild already cured it — and must not burn another
+        #: restart from the streak.
+        self._consumer_fault_epoch = 0
+        self._failed: BaseException | None = None
+        # Overload events since the last stats publication (guarded by
+        # _pending_lock; feeds the serve_overload gauge).
+        self._overload_events = 0
 
         # SLO accounting (consumer-thread-owned except the latency ring's
         # bounded deque, which is append-only from one thread anyway).
@@ -281,6 +381,43 @@ class ServeEngine:
             target=self._complete_loop, name="serve-consumer", daemon=True)
         self._dispatcher.start()
         self._consumer.start()
+
+    def _build_arena_and_programs(self) -> None:
+        """Fresh slot pool, fresh device arena, fresh jitted programs —
+        construction AND the supervised-restart rebuild path (a restart
+        discards every compiled program and every slot carry; sessions
+        re-enter cold through the batched prefill, which PR 8 pinned as
+        bitwise-equivalent to a fresh session suffix).
+
+        Device arena: one carry row per slot, plus max_batch SCRATCH rows
+        (indices >= cfg.slots) that padding rows read/write so a partial
+        batch can never touch a live session's slot.
+
+        The arena is DONATED on every backend: scatter into an aliased
+        buffer updates in place, a non-donated pool round-trips a full
+        arena copy per tick (measured 5.5x tick cost at the soak shape).
+        The PR-4 CPU donation carve-out (runtime/orchestrator.py) does
+        not apply here: its segfault was a consumer device_get racing a
+        dispatch that donated the very state the readback came from; the
+        pool never leaves the device, and the consumer reads only the
+        action/logit/value outputs, which are never donated."""
+        cfg = self.cfg
+        self._slots = SlotPool(cfg.slots)
+        n_arena = cfg.slots + cfg.max_batch
+        self._pool = jax.tree.map(
+            lambda x: jnp.repeat(jnp.asarray(x)[None], n_arena, axis=0),
+            self._carry0)
+        # Per-row init carries for the generic path's in-program cold reset.
+        self._carry0_rows = jax.tree.map(
+            lambda x: jnp.repeat(jnp.asarray(x)[None], cfg.max_batch,
+                                 axis=0), self._carry0)
+        donate = (1,)
+        if self._episode:
+            self._warm_fn = jax.jit(self._warm_program, donate_argnums=donate)
+            self._cold_fn = jax.jit(self._cold_program, donate_argnums=donate)
+        else:
+            self._step_fn = jax.jit(self._generic_program,
+                                    donate_argnums=donate)
 
     # -- device programs --------------------------------------------------
 
@@ -323,24 +460,105 @@ class ServeEngine:
     # -- public surface ---------------------------------------------------
 
     def submit(self, session_id: Any, obs: Any,
-               callback: Callable[[ServeResult], None] | None = None
-               ) -> _Request:
+               callback: Callable[[ServeResult], None] | None = None,
+               *, deadline_ms: float | None = None) -> _Request:
         """Enqueue one ``(window, portfolio)`` query; thread-safe. Returns
         a handle whose :meth:`_Request.wait` blocks for the response;
-        ``callback(result)`` additionally fires on the consumer thread."""
+        ``callback(result)`` additionally fires on the consumer thread.
+
+        ``deadline_ms`` bounds how long the request may wait before it is
+        completed with a :class:`ServeDeadlineExceeded` error instead of
+        being served (None = ``serve.default_deadline_ms``; 0 = none).
+
+        NEVER blocks on a full queue: past ``serve.max_queue`` the
+        request is refused (``shed_policy="reject"``) or the oldest
+        queued request is shed to make room (``"oldest"``) — either way
+        the loser's handle completes immediately with
+        :class:`ServeRejected` (its callback fires with None on the
+        CALLER's thread, the one place completion doesn't ride the
+        consumer)."""
         if self._stop_event.is_set():
             raise RuntimeError("serve engine is stopped")
-        req = _Request(session_id, np.asarray(obs, np.float32), callback)
+        if self._failed is not None:
+            raise ServeEngineFailed(
+                "serve engine is in the terminal failed state "
+                f"(last fault: {self._failed!r}); rebuild it") \
+                from self._failed
+        if deadline_ms is None:
+            deadline_ms = self.cfg.default_deadline_ms
+        req = _Request(session_id, np.asarray(obs, np.float32), callback,
+                       deadline_ms=deadline_ms)
         with self._pending_lock:
             self._pending += 1
         self._registry.inc("serve_requests_total")
-        self._q.put(req)
-        return req
+        while True:
+            try:
+                self._q.put_nowait(req)
+                if (self._stop_event.is_set()
+                        and not self._dispatcher.is_alive()):
+                    # TOCTOU: stop() completed between our gate check at
+                    # the top and this put — nobody will ever read the
+                    # queue again, so sweep it ourselves (pop-ownership
+                    # makes this race-safe against other sweepers).
+                    self._fail_leftovers()
+                return req
+            except queue.Full:
+                pass
+            with self._pending_lock:
+                self._overload_events += 1
+            if self.cfg.shed_policy == "reject":
+                self._registry.inc("serve_queue_rejected_total")
+                self._registry.record("serve_overload", 1.0)
+                self._finish_failed(req, ServeRejected(
+                    f"ingress queue full ({self.cfg.max_queue}); request "
+                    "rejected under shed_policy='reject'",
+                    reason="queue_full"))
+                return req
+            # shed_policy == "oldest": drop the oldest queued request and
+            # retry the admission (the dispatcher may race us for it —
+            # an Empty get just means the queue drained; retry the put).
+            try:
+                victim = self._q.get_nowait()
+            except queue.Empty:
+                continue
+            self._registry.inc("serve_shed_total")
+            self._registry.record("serve_overload", 1.0)
+            self._finish_failed(victim, ServeRejected(
+                f"shed from the ingress queue under overload "
+                f"(shed_policy='oldest', max_queue={self.cfg.max_queue})",
+                reason="shed_oldest"))
+
+    def _finish_failed(self, req: _Request, exc: BaseException) -> None:
+        """Complete a request with a terminal error outcome (rejection,
+        shed, deadline expiry, engine failure): release its waiter, fire
+        its callback with None, and un-count it from the drain-pending
+        total — a failed request must never strand :meth:`drain`."""
+        with self._pending_lock:
+            self._pending -= 1
+        req.error = exc
+        req._event.set()
+        if req.callback is not None:
+            try:
+                req.callback(None)
+            except Exception:   # noqa: BLE001
+                log.exception("serve failure callback failed")
 
     @property
     def params_step(self) -> int:
         """Checkpoint step of the CURRENT serving weights."""
         return self._live.step
+
+    @property
+    def failed(self) -> BaseException | None:
+        """The terminal fault, when the engine tripped its failed state
+        (None while healthy). Terminal = submits raise ServeEngineFailed
+        and all queued work has been failed loudly."""
+        return self._failed
+
+    def queue_depth(self) -> int:
+        """Current ingress-queue depth (bounded by ``serve.max_queue`` —
+        the chaos soak's queue invariant reads this)."""
+        return self._q.qsize()
 
     @property
     def registry(self) -> MetricsRegistry:
@@ -386,19 +604,44 @@ class ServeEngine:
             with self._pending_lock:
                 if self._pending == 0:
                     return True
-            time.sleep(0.002)
+            time.sleep(0.002)   # serve-block-ok: drain's bounded poll runs
+            # on the CALLER's thread (cli shutdown), never the dispatch path.
         with self._pending_lock:
             return self._pending == 0
 
-    def stop(self, *, drain: bool = True, timeout_s: float = 30.0) -> None:
-        """Drain (optionally), stop both threads, publish final gauges."""
+    def stop(self, *, drain: bool = True, timeout_s: float = 30.0) -> bool:
+        """Drain (optionally), stop both threads, publish final gauges.
+
+        Returns False — loudly — when either thread is still alive after
+        its join timeout: a hung dispatcher/consumer means in-flight work
+        may never complete, and the caller (``cli serve``'s SIGTERM path)
+        must exit nonzero instead of reporting a clean shutdown."""
         if drain:
             self.drain(timeout_s)
         self._stop_event.set()
         self._dispatcher.join(timeout_s)
-        self._done_q.put(_SHUTDOWN)
+        if not self._dispatcher.is_alive():
+            # The dispatcher failed its leftovers in its own exit path;
+            # this sweep catches requests that raced in between that
+            # sweep and its death (safe now — the owner is gone).
+            self._fail_leftovers()
+        try:
+            # Bounded put: with the consumer hung behind a full done
+            # queue, an unbounded put would hang stop() itself.
+            self._done_q.put(_SHUTDOWN, timeout=timeout_s)
+        except queue.Full:
+            pass
         self._consumer.join(timeout_s)
+        ok = True
+        for thread in (self._dispatcher, self._consumer):
+            if thread.is_alive():
+                log.error(
+                    "serve %s thread still alive %.1fs after stop(): "
+                    "shutdown is NOT clean (in-flight requests may never "
+                    "complete)", thread.name, timeout_s)
+                ok = False
         self._publish_stats(force=True)
+        return ok
 
     def latencies_ms(self) -> list[float]:
         """Snapshot of the per-request latency ring (percentile source)."""
@@ -408,6 +651,29 @@ class ServeEngine:
 
     def _serve_loop(self) -> None:
         while not self._stop_event.is_set():
+            if self._failed is not None:
+                # Terminal failed state: never wedge — every request that
+                # raced past the submit-side gate still gets a loud
+                # terminal outcome.
+                self._drain_failed()
+                continue
+            # Sessions a consumer fault poisoned (their slot carries
+            # advanced but the responses were lost): drop them so their
+            # next request re-enters cold instead of double-stepping a
+            # warm carry. Best-effort — a same-session request already
+            # in flight this tick may still read the advanced carry; the
+            # supervision rebuild (max_restarts > 0) resets even that.
+            while self._poisoned:
+                self._slots.drop(self._poisoned.popleft())
+            if self._restart_requested.is_set():
+                self._restart_requested.clear()
+                # Epoch-gate: a fault from a batch dispatched before the
+                # latest restart was already cured by that rebuild; only
+                # a current-epoch fault earns another restart.
+                if self._consumer_fault_epoch >= self._fault_epoch:
+                    self._supervise(self._consumer_fault
+                                    or RuntimeError("serve consumer fault"))
+                continue
             batch = self._collect_batch()
             if not batch:
                 continue
@@ -418,45 +684,174 @@ class ServeEngine:
                 # request (bad obs shape) must fail ITS batch, not wedge
                 # the dispatcher and hang every later session.
                 self._fail_batch(batch, exc)
+                # ... and with supervision on, retry the ENGINE: rebuild
+                # programs + arena under seeded backoff (no-op at the
+                # default max_restarts=0, the PR-8 contract).
+                self._supervise(exc)
                 continue
             # Bounded handoff: blocking here is the backpressure that
             # keeps in-flight device buffers bounded (pipeline.py's put).
             self._done_q.put(done)
+        # Dispatcher exit: whatever is still queued/deferred can never be
+        # dispatched — fail it terminally HERE, on the thread that owns
+        # these structures (stop() and submit() re-sweep only for racers,
+        # and only once this thread is provably dead).
+        self._fail_leftovers()
+
+    def _fail_leftovers(self) -> None:
+        """Fail every request still in the ingress/deferred queues with a
+        terminal stopped error. Safe concurrently: items transfer to the
+        caller one pop at a time, so each request is completed exactly
+        once even when stop()/submit() racers sweep alongside the
+        dispatcher's own exit sweep."""
+        leftover = RuntimeError(
+            "serve engine stopped before this request was dispatched")
+        while True:
+            try:
+                req = self._deferred.popleft()
+            except IndexError:
+                break
+            self._finish_failed(req, leftover)
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            self._finish_failed(req, leftover)
 
     def _fail_batch(self, batch: list[_Request], exc: Exception) -> None:
         """Dispatch-fault path (off the lint-guarded closure): release the
         batch's waiters with no result and keep serving."""
         log.exception("serve dispatch failed for a %d-request batch: %s",
                       len(batch), exc)
-        with self._pending_lock:
-            self._pending -= len(batch)
         for req in batch:
             # An admitted slot may hold a stale/garbage carry (the prefill
             # may never have run): drop the session so its next request
-            # re-enters cold instead of reading a poisoned slot.
+            # re-enters cold instead of reading a poisoned slot. Callback-
+            # driven clients (the load harnesses, a network front-end) see
+            # the failure as a None result, or the session silently leaks
+            # out of their bookkeeping.
             self._slots.drop(req.session_id)
-            req.error = exc
-            req._event.set()        # result stays None: waiters unblock
-            if req.callback is not None:
-                # Callback-driven clients (the load harnesses, a network
-                # front-end) must see the failure too, or the session
-                # silently leaks out of their bookkeeping.
-                try:
-                    req.callback(None)
-                except Exception:   # noqa: BLE001
-                    log.exception("serve failure callback failed")
+            self._finish_failed(req, exc)
+
+    # -- dispatch supervision (serve.max_restarts > 0) --------------------
+
+    def _supervise(self, exc: BaseException) -> None:
+        """Training-loop restart contract applied to serving: after a
+        fault fails its batch, rebuild the engine (fresh jitted programs +
+        fresh slot arena — sessions re-enter cold through the batched
+        prefill) under seeded exponential backoff. A streak of more than
+        ``max_restarts`` consecutive faults (reset by any completed batch)
+        trips the terminal failed state instead of retrying forever."""
+        if self.cfg.max_restarts <= 0:
+            return                      # PR-8 behavior: no engine rebuild
+        with self._sup_lock:
+            # Bump under the SAME lock as the consumer's compare-and-
+            # reset: either the consumer resets first (pre-fault streak,
+            # harmless) or it sees the new epoch and leaves the streak
+            # alone — a pre-fault completion can never erase this fault.
+            self._fault_epoch += 1
+        while not self._stop_event.is_set():
+            with self._sup_lock:
+                self._restart_streak += 1
+                streak = self._restart_streak
+            if streak > self.cfg.max_restarts:
+                self._enter_failed(exc)
+                return
+            self._registry.inc("serve_restarts_total")
+            self._backoff_sleep(streak)
+            try:
+                self._build_arena_and_programs()
+                # Recompile NOW, on scratch rows, not on the first real
+                # post-restart batch (seconds of XLA compile on the
+                # dispatch path would blow every queued deadline and
+                # shed at max rate); a compile failure folds into the
+                # restart streak instead of failing an innocent batch.
+                self.warmup()
+                log.warning(
+                    "serve engine rebuilt after fault (restart %d/%d): "
+                    "fresh programs + slot arena, all sessions cold",
+                    streak, self.cfg.max_restarts)
+                return
+            except Exception as rebuild_exc:    # noqa: BLE001 — a failed
+                # rebuild is just the next fault in the streak.
+                log.exception("serve engine rebuild failed")
+                exc = rebuild_exc
+
+    def _backoff_sleep(self, attempt: int) -> None:
+        """Seeded exponential backoff between engine rebuilds:
+        initial * 2^(attempt-1), capped, with seeded multiplicative jitter
+        so a fleet of engines doesn't restart in lockstep. Deliberately
+        NOT a ``time.sleep`` (which lint check 10 bans throughout serve/):
+        waiting on the stop event keeps shutdown from blocking behind a
+        backoff."""
+        cfg = self.cfg
+        delay = min(cfg.restart_backoff_s * (2.0 ** (attempt - 1)),
+                    cfg.restart_backoff_max_s)
+        delay *= 0.5 + self._restart_rng.random()
+        self._stop_event.wait(delay)
+
+    def _enter_failed(self, exc: BaseException) -> None:
+        """Trip the terminal failed state: fail ALL queued work loudly and
+        refuse future submits — a restart storm must end in a diagnosable
+        corpse, never a silent wedge."""
+        self._failed = exc
+        self._registry.record("serve_failed", 1.0)
+        log.error(
+            "serve engine TERMINALLY FAILED: %d consecutive faults "
+            "exceeded serve.max_restarts=%d (last: %r); failing all "
+            "queued work", self._restart_streak, self.cfg.max_restarts,
+            exc)
+        self._drain_failed()
+
+    def _drain_failed(self) -> None:
+        """Fail everything queued/deferred with ServeEngineFailed (bounded
+        wait on the empty queue so the loop stays responsive to stop)."""
+        failure = ServeEngineFailed(
+            f"serve engine is terminally failed (last fault: "
+            f"{self._failed!r})")
+        failure.__cause__ = self._failed
+        while self._deferred:
+            self._finish_failed(self._deferred.popleft(), failure)
+        try:
+            while True:
+                self._finish_failed(self._q.get(timeout=0.05), failure)
+        except queue.Empty:
+            pass
+
+    # -- batch collection -------------------------------------------------
+
+    def _expire_if_dead(self, req: _Request, now: float) -> bool:
+        """Deadline gate at collection time: a request whose deadline
+        passed is completed with ServeDeadlineExceeded BEFORE it can
+        occupy a padded device row. Returns True when the request was
+        expired (caller must skip it)."""
+        if req.t_deadline is None or now < req.t_deadline:
+            return False
+        self._registry.inc("serve_deadline_expired_total")
+        self._finish_failed(req, ServeDeadlineExceeded(
+            f"deadline expired {1e3 * (now - req.t_deadline):.1f} ms ago "
+            "before the request reached a batch"))
+        return True
 
     def _collect_batch(self) -> list[_Request]:
         """Coalesce one tick's batch: deferred same-session requests first
         (sequential consistency per session — a session's second in-flight
         request must see its first one's carry), then drain the queue until
-        ``max_batch`` or the deadline anchored at the FIRST request."""
+        ``max_batch`` or the coalescing deadline — anchored at the FIRST
+        request and clamped to the earliest surviving request's
+        per-request deadline, so waiting for batch-mates never expires
+        work the tick could have served. Expired requests are completed
+        with a deadline error at pop time and never join the batch."""
         cfg = self.cfg
         batch: list[_Request] = []
         seen: set = set()
         kept: deque[_Request] = deque()
+        now = time.perf_counter()
         while self._deferred:
             req = self._deferred.popleft()
+            if self._expire_if_dead(req, now):
+                continue
             if req.session_id in seen or len(batch) >= cfg.max_batch:
                 kept.append(req)
             else:
@@ -468,9 +863,14 @@ class ServeEngine:
                 req = self._q.get(timeout=0.05)
             except queue.Empty:
                 return []
+            if self._expire_if_dead(req, time.perf_counter()):
+                return []
             batch.append(req)
             seen.add(req.session_id)
         deadline = time.perf_counter() + cfg.batch_timeout_ms / 1e3
+        for req in batch:           # anchor to the earliest survivor
+            if req.t_deadline is not None:
+                deadline = min(deadline, req.t_deadline)
         while len(batch) < cfg.max_batch:
             remaining = deadline - time.perf_counter()
             if remaining <= 0:
@@ -479,11 +879,39 @@ class ServeEngine:
                 req = self._q.get(timeout=remaining)
             except queue.Empty:
                 break
+            if self._expire_if_dead(req, time.perf_counter()):
+                continue
             if req.session_id in seen:
+                if len(self._deferred) >= cfg.max_queue:
+                    # The deferred side-queue is bounded too: a single-
+                    # session flood must not re-grow the memory the
+                    # ingress bound just capped. The loser follows the
+                    # configured policy: "oldest" sheds the STALEST
+                    # deferred request and admits the new one (the
+                    # brownout contract), "reject" refuses the arrival.
+                    with self._pending_lock:
+                        self._overload_events += 1
+                    if cfg.shed_policy == "oldest":
+                        victim = self._deferred.popleft()
+                        self._registry.inc("serve_shed_total")
+                        self._finish_failed(victim, ServeRejected(
+                            "shed from the same-session backlog under "
+                            "overload (shed_policy='oldest')",
+                            reason="shed_oldest"))
+                        self._deferred.append(req)
+                    else:
+                        self._registry.inc("serve_queue_rejected_total")
+                        self._finish_failed(req, ServeRejected(
+                            "same-session backlog exceeded "
+                            "serve.max_queue", reason="deferred_overflow"))
+                    continue
                 self._deferred.append(req)
             else:
                 batch.append(req)
                 seen.add(req.session_id)
+                if (req.t_deadline is not None
+                        and req.t_deadline < deadline):
+                    deadline = req.t_deadline
         return batch
 
     def _dispatch_batch(self, batch: list[_Request],
@@ -535,7 +963,8 @@ class ServeEngine:
                 live.params, self._pool, obs, idx, cold_mask)
             groups.append((reqs, act, logit, val))
         return _DoneBatch(groups=groups, step=live.step, n=len(batch),
-                          cold=len(cold_reqs), evicted=evicted)
+                          cold=len(cold_reqs), evicted=evicted,
+                          epoch=self._fault_epoch)
 
     def _pad(self, reqs: list[_Request],
              idx: list[int]) -> tuple[np.ndarray, np.ndarray]:
@@ -557,29 +986,68 @@ class ServeEngine:
 
     def _complete_loop(self) -> None:
         while True:
-            item = self._done_q.get()
+            try:
+                item = self._done_q.get(timeout=0.2)
+            except queue.Empty:
+                # Normally the _SHUTDOWN sentinel ends this loop; the
+                # timed poll covers the sentinel stop() had to DROP on a
+                # full queue (consumer stalled past the put timeout) — a
+                # later-recovering consumer drains what remains and then
+                # exits here instead of parking forever on a sentinel
+                # that will never arrive. Exit ONLY once the dispatcher
+                # is gone too, and even then drain once more first: the
+                # dispatcher may have put its final batch between our
+                # empty get and its exit, and those waiters must still
+                # reach a terminal outcome.
+                if (self._stop_event.is_set()
+                        and not self._dispatcher.is_alive()):
+                    while True:
+                        try:
+                            item = self._done_q.get_nowait()
+                        except queue.Empty:
+                            return
+                        if item is not _SHUTDOWN:
+                            self._consume_done(item)
+                continue
             if item is _SHUTDOWN:
                 return
-            try:
-                self._complete_batch(item)
-            except Exception as exc:  # noqa: BLE001 — a completion fault
-                # (readback error, device fault) must neither wedge the
-                # dispatcher behind a full done queue NOR leak the batch's
-                # waiters: release every request not already completed,
-                # mirroring the dispatcher's _fail_batch contract.
-                log.exception("serve consumer failed completing a batch")
-                for reqs, *_ in item.groups:
-                    for req in reqs:
-                        if req._event.is_set():
-                            continue
-                        req.error = exc
-                        req._event.set()
-                        if req.callback is not None:
-                            try:
-                                req.callback(None)
-                            except Exception:   # noqa: BLE001
-                                log.exception(
-                                    "serve failure callback failed")
+            self._consume_done(item)
+
+    def _consume_done(self, item: _DoneBatch) -> None:
+        try:
+            self._complete_batch(item)
+        except Exception as exc:  # noqa: BLE001 — a completion fault
+            # (readback error, device fault) must neither wedge the
+            # dispatcher behind a full done queue NOR leak the batch's
+            # waiters: release every request not already completed,
+            # mirroring the dispatcher's _fail_batch contract.
+            log.exception("serve consumer failed completing a batch")
+            for reqs, *_ in item.groups:
+                for req in reqs:
+                    # The dispatched program already ADVANCED these
+                    # sessions' slot carries; hand them to the
+                    # dispatcher to drop (it owns the SlotPool) so a
+                    # client retry doesn't double-step a warm carry.
+                    self._poisoned.append(req.session_id)
+                    if req._event.is_set():
+                        continue
+                    req.error = exc
+                    req._event.set()
+                    if req.callback is not None:
+                        try:
+                            req.callback(None)
+                        except Exception:   # noqa: BLE001
+                            log.exception("serve failure callback failed")
+            # A consumer fault is an ENGINE fault for the supervisor:
+            # the readback path may hold poisoned device buffers, so ask
+            # the dispatcher to run the restart/backoff contract (no-op
+            # at the default max_restarts=0), stamped with the faulting
+            # batch's epoch so a pre-restart batch draining out of the
+            # done queue can't re-trip a restart the rebuild already
+            # delivered.
+            self._consumer_fault = exc
+            self._consumer_fault_epoch = item.epoch
+            self._restart_requested.set()
 
     def _complete_batch(self, done: _DoneBatch) -> None:
         """Readback + request completion + SLO accounting — the consumer
@@ -613,6 +1081,14 @@ class ServeEngine:
         finally:
             with self._pending_lock:
                 self._pending -= done.n
+        # A completed batch heals the supervisor's consecutive-fault
+        # streak (mirrors the training loop's restart accounting) — but
+        # ONLY a batch dispatched after the latest fault: pre-fault
+        # batches draining out of the done queue during a backoff say
+        # nothing about the rebuilt engine.
+        with self._sup_lock:
+            if done.epoch == self._fault_epoch:
+                self._restart_streak = 0
         self._stats_completed += done.n
         self._stats_occupancy.append(done.n / self.cfg.max_batch)
         reg = self._registry
@@ -632,9 +1108,17 @@ class ServeEngine:
             return
         if interval <= 0:
             return
+        with self._pending_lock:
+            overload_events = self._overload_events
+            self._overload_events = 0
+        depth = self._q.qsize()
         row: dict[str, float] = {
             "serve_qps": self._stats_completed / interval,
-            "serve_queue_depth": float(self._q.qsize()),
+            "serve_queue_depth": float(depth),
+            # Overload gauge: 1 while the engine is shedding/rejecting or
+            # the ingress queue is pinned at its bound, else 0.
+            "serve_overload": float(overload_events > 0
+                                    or depth >= self.cfg.max_queue),
         }
         if self._lat:
             pct = latency_percentiles(list(self._lat))

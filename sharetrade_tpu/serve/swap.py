@@ -17,11 +17,24 @@ the manager's own machinery (never deleted). The watcher marks the bad
 candidate's stamp as seen so a wedged checkpoint is not re-verified every
 poll — the next genuine save carries a fresh ``saved_at`` and is picked up
 normally.
+
+Circuit breaker (ISSUE 10): a training run writing a stream of bad
+candidates (truncating disk, template drift, a flapping precision mode)
+would otherwise make the watcher pay a full checksum+deserialize
+verification for every fresh stamp, forever. After
+``serve.swap_breaker_failures`` CONSECUTIVE rejections the breaker OPENS:
+the watcher stops polling the wedged tag for
+``serve.swap_breaker_cooldown_s`` (gauge ``serve_swap_breaker_open`` = 1,
+counter ``serve_swap_breaker_opens_total``), then lets ONE probe poll
+through — a successful swap closes the breaker, another rejection
+re-opens it. ``breaker_failures=0`` (the direct-construction default)
+disables the breaker entirely.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any
 
 from sharetrade_tpu.checkpoint.manager import (
@@ -44,7 +57,9 @@ class WeightSwapWatcher:
 
     def __init__(self, engine: Any, manager: CheckpointManager,
                  template: Any, *, tag: str = "best",
-                 poll_s: float = 5.0, seen_meta: dict | None = None):
+                 poll_s: float = 5.0, seen_meta: dict | None = None,
+                 breaker_failures: int = 0,
+                 breaker_cooldown_s: float = 30.0):
         self._engine = engine
         self._manager = manager
         self._template = template
@@ -54,6 +69,13 @@ class WeightSwapWatcher:
         self._stop = threading.Event()
         self.swaps = 0
         self.rejected = 0
+        #: Breaker state: 0 disables; the streak counts CONSECUTIVE
+        #: rejections (any successful swap resets it).
+        self._breaker_failures = max(int(breaker_failures), 0)
+        self._breaker_cooldown_s = max(float(breaker_cooldown_s), 0.0)
+        self._fail_streak = 0
+        self._open_until = 0.0          # monotonic; 0 = closed
+        self.breaker_opens = 0
         self._thread = threading.Thread(target=self._loop,
                                         name="serve-swap-watcher",
                                         daemon=True)
@@ -75,14 +97,29 @@ class WeightSwapWatcher:
 
     # ------------------------------------------------------------------
 
+    @property
+    def breaker_open(self) -> bool:
+        """True while the circuit breaker is holding polls off the tag."""
+        return self._open_until > 0.0 and time.monotonic() < self._open_until
+
     def poll_once(self) -> bool:
         """One poll: True when a swap was applied. Public so tests (and a
         manual operator nudge) can drive the watcher synchronously."""
+        registry = getattr(self._engine, "registry", None)
+        if self._open_until > 0.0:
+            if time.monotonic() < self._open_until:
+                return False        # open: the wedged tag is not polled
+            # Cooldown over — half-open: let exactly one probe through
+            # (a rejection in _reject re-opens with a fresh cooldown).
+            self._open_until = 0.0
+            if registry is not None:
+                registry.record("serve_swap_breaker_open", 0.0)
+            log.info("hot-swap breaker half-open: probing tag %r",
+                     self._tag)
         meta = self._manager.tagged_metadata(self._tag)
         stamp = self._stamp(meta)
         if stamp is None or stamp == self._seen:
             return False
-        registry = getattr(self._engine, "registry", None)
         try:
             state, restored_meta = self._manager.restore_tagged(
                 self._template, self._tag)
@@ -103,6 +140,9 @@ class WeightSwapWatcher:
         self._engine.swap_params(state.params, int(step))
         self._seen = self._stamp(restored_meta)
         self.swaps += 1
+        self._fail_streak = 0           # a good candidate heals the breaker
+        if registry is not None:
+            registry.record("serve_swap_breaker_open", 0.0)
         return True
 
     def _reject(self, stamp, registry, exc: BaseException) -> None:
@@ -114,6 +154,21 @@ class WeightSwapWatcher:
                     "step %d (%s: %s)", self._tag,
                     getattr(self._engine, "params_step", -1),
                     type(exc).__name__, exc)
+        self._fail_streak += 1
+        if (self._breaker_failures > 0
+                and self._fail_streak >= self._breaker_failures):
+            # The streak is NOT reset here: a rejected half-open probe
+            # stays past the threshold and re-opens immediately.
+            self._open_until = time.monotonic() + self._breaker_cooldown_s
+            self.breaker_opens += 1
+            if registry is not None:
+                registry.record("serve_swap_breaker_open", 1.0)
+                registry.inc("serve_swap_breaker_opens_total")
+            log.error(
+                "hot-swap circuit breaker OPEN: %d consecutive refused "
+                "candidates on tag %r; not polling for %.1fs",
+                self._breaker_failures, self._tag,
+                self._breaker_cooldown_s)
 
     def _loop(self) -> None:
         while not self._stop.wait(self._poll_s):

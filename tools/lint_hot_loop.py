@@ -119,6 +119,21 @@ in tests/test_megachunk.py:
    side (``_journal_transitions`` / ``_warm_start_replay`` in the
    orchestrator), whose existence the check also enforces. Escape hatch:
    ``replay-host-ok`` naming why a host call is trace-safe there.
+
+10. **Serving stays overload-safe** (the serve-robustness PR's guard) —
+    inside ``sharetrade_tpu/serve/`` an UNBOUNDED ``queue.Queue()`` (no
+    ``maxsize``, or the literal ``maxsize=0``, which ALSO means
+    unbounded) is exactly the admission-control hole ISSUE 10 closed: a
+    request flood grows host memory without bound before any shedding
+    can happen. And a bare ``time.sleep`` anywhere in the package is
+    either a dispatch-path stall (check 8's territory) or an unkillable
+    wait a stop() can't interrupt — NO sleep is sanctioned: even the
+    supervised-restart backoff (``_backoff_sleep``) waits on the stop
+    event instead, precisely so shutdown can interrupt it. FAILS on any
+    unbounded ``queue.Queue(...)`` call and any ``time.sleep`` call in
+    the package — unless the line carries ``serve-block-ok`` naming why
+    the block is off the serving path (e.g. a drain poll on the
+    caller's thread, a load generator's pacing sleep).
 """
 
 from __future__ import annotations
@@ -337,6 +352,16 @@ REPLAY_BLOCK_PATTERN = re.compile(
 #: Escape hatch for an intentionally trace-safe host call there.
 REPLAY_MARKER = "replay-host-ok"
 
+#: Check 10 (the serve-robustness PR): the serve package stays overload-
+#: safe — no unbounded ingress queues, and the ONLY bare sleep is the
+#: supervised-restart backoff helper (everything else marks itself).
+SERVE_PKG = (pathlib.Path(__file__).resolve().parent.parent
+             / "sharetrade_tpu" / "serve")
+#: Escape hatch naming why a block is off the serving path. There is NO
+#: function allowlist: the engine's restart backoff waits on the stop
+#: event, so no serve/ code needs an unmarked time.sleep.
+SERVE_PKG_MARKER = "serve-block-ok"
+
 
 def lint_hot_loop_syncs() -> tuple[list[tuple[str, int, str]], set[str]]:
     return _scan_named_funcs(HOT_FUNCS, PATTERN, MARKER)
@@ -368,6 +393,57 @@ def lint_replay_device_path() -> tuple[list[tuple[str, int, str]], set[str]]:
         (), REPLAY_BLOCK_PATTERN, REPLAY_MARKER,
         also_find=REPLAY_CONSUMER_FUNCS)
     return tree_bad + dqn_bad, tree_found | dqn_found | orch_found
+
+
+def lint_serve_overload_safety(
+        root: pathlib.Path | None = None) -> list[tuple[str, int, str]]:
+    """Check 10: inside ``sharetrade_tpu/serve/`` every ``queue.Queue``
+    construction must be BOUNDED (a non-zero ``maxsize``) and no
+    ``time.sleep`` may appear at all (the restart backoff waits on the
+    stop event instead); a line carrying ``serve-block-ok`` is exempt.
+    Returns (relpath, line, text) hits. ``root`` overrides the scanned
+    directory (tests exercise the pattern semantics on fixtures)."""
+    root = root or SERVE_PKG
+    bad: list[tuple[str, int, str]] = []
+    for path in sorted(root.glob("*.py")):
+        src = path.read_text()
+        lines = src.splitlines()
+        tree = ast.parse(src)
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else getattr(fn, "id", None))
+            text = lines[node.lineno - 1]
+            if SERVE_PKG_MARKER in text:
+                continue
+            if name == "Queue":
+                # Bounded = a maxsize argument that is not the literal 0
+                # (maxsize=0 IS unbounded in queue.Queue — passing it
+                # would green-light exactly the hole this check guards).
+                bound_expr = (node.args[0] if node.args else next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "maxsize"), None))
+                bounded = bound_expr is not None and not (
+                    isinstance(bound_expr, ast.Constant)
+                    and bound_expr.value == 0)
+                if not bounded:
+                    bad.append((f"serve/{path.name}", node.lineno,
+                                text.strip()))
+            elif name == "sleep":
+                # Both forms: ``time.sleep(...)`` and a bare
+                # ``sleep(...)`` from ``from time import sleep`` (other
+                # dotted receivers — somemodule.sleep — stay legal).
+                time_sleep = (isinstance(fn, ast.Name)
+                              or (isinstance(fn, ast.Attribute)
+                                  and isinstance(fn.value, ast.Name)
+                                  and fn.value.id == "time"))
+                if time_sleep:
+                    bad.append((f"serve/{path.name}", node.lineno,
+                                text.strip()))
+    return bad
 
 
 def lint_dispatcher_blocking() -> tuple[list[tuple[str, int, str]], set[str]]:
@@ -562,6 +638,19 @@ def main() -> int:
               "side (_journal_transitions / _warm_start_replay), or tag "
               f"the line '# {REPLAY_MARKER}: <why this is trace-safe>'")
         return 1
+    serve_pkg_bad = lint_serve_overload_safety()
+    if serve_pkg_bad:
+        print("serve overload-safety lint FAILED:")
+        for rel, ln, text in serve_pkg_bad:
+            print(f"  {rel}:{ln}: {text}")
+        print("an unbounded queue.Queue() in serve/ re-opens the "
+              "request-flood memory hole admission control closed, and a "
+              "bare time.sleep there is an uninterruptible stall; bound "
+              "the queue (non-zero maxsize=) / route the wait through "
+              "the stop event (see ServeEngine._backoff_sleep), or tag "
+              f"the line '# {SERVE_PKG_MARKER}: <why this block is off "
+              "the serving path>'")
+        return 1
     dur_bad = lint_durable_replace()
     if dur_bad:
         print("durable-rename fsync lint FAILED:")
@@ -582,6 +671,7 @@ def main() -> int:
           f"precision-cast lint OK; "
           f"serve batch-dispatch lint OK ({', '.join(SERVE_DISPATCH_FUNCS)}); "
           f"replay device-path lint OK ({', '.join(REPLAY_TREE_FUNCS + REPLAY_DQN_FUNCS)}); "
+          f"serve overload-safety lint OK; "
           f"durable-rename fsync lint OK ({', '.join(DURABLE_WRITE_FILES)})")
     return 0
 

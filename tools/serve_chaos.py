@@ -1,0 +1,593 @@
+#!/usr/bin/env python
+"""Serve chaos soak: seeded fault injection against the REAL engine.
+
+tools/crash_soak.py proves the TRAINING loop survives faults (kill /
+resume); this tool is the same contract for the SERVING tier (ISSUE 10).
+It drives a real :class:`ServeEngine` (episode-mode transformer — real
+per-session K/V slot carries, the state that could cross-contaminate)
+under load while injecting five seeded fault classes:
+
+- **dispatch_exception** — a malformed request (wrong observation shape)
+  fails its batch; with supervision on the engine then REBUILDS (fresh
+  jitted programs + fresh slot arena under seeded backoff).
+- **slow_consumer** — a completion callback stalls the consumer thread;
+  backpressure must bound in-flight work without wedging the dispatcher.
+- **corrupt_swap** — a bit-flipped (sometimes genuine) ``tag_best``
+  candidate; the verified-restore path refuses it and repeated refusals
+  open the swap circuit breaker.
+- **queue_flood** — a submit burst far past ``serve.max_queue`` while the
+  consumer is stalled; admission control must shed/reject, never grow.
+- **deadline_burst** — a burst of tightly-deadlined requests behind a
+  stalled consumer; the un-dispatched ones must expire at collection,
+  never occupy a padded device row.
+
+After EVERY injection the invariants are asserted:
+
+1. **No wedge**: every submitted request reaches a terminal outcome —
+   result, ServeRejected, ServeDeadlineExceeded, or batch failure.
+2. **Bounded queue**: a monitor thread samples the ingress depth for the
+   whole soak; it never exceeds ``serve.max_queue``.
+3. **Post-restart bitwise parity**: after an engine rebuild, a session
+   that was WARM before the fault answers bit-identically to a FRESH
+   session under the current weights (no stale-slot cross-contamination
+   from the discarded arena).
+4. **Counter reconciliation**: shed + rejected == observed ServeRejected
+   handles; deadline-expired counter == observed deadline errors;
+   ``serve_restarts_total`` == injected dispatch faults; the swap
+   watcher's rejected/opens counters match an exact state-machine mirror
+   of the injected candidates.
+
+Seeded and deterministic in STRUCTURE (the injection schedule, candidate
+kinds, stall lengths); per-injection outcome counts (how many of a flood
+were shed vs served) depend on scheduling and are reconciled exactly
+rather than predicted.
+
+Usage:
+    python tools/serve_chaos.py                    # full soak (>= 20)
+    python tools/serve_chaos.py --injections 2     # quick profile (tier-1,
+                                                   # also `make check`)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+FAULT_CLASSES = ("dispatch_exception", "slow_consumer", "corrupt_swap",
+                 "queue_flood", "deadline_burst")
+
+WINDOW = 8
+OBS_DIM = WINDOW + 2
+BREAKER_FAILURES = 2
+BREAKER_COOLDOWN_S = 0.25
+
+
+class ChaosError(AssertionError):
+    """An invariant violation — the soak FAILED."""
+
+
+class DepthMonitor(threading.Thread):
+    """Samples the engine's ingress-queue depth for the soak's whole
+    lifetime; the bounded-queue invariant is asserted on the MAX seen,
+    not a single lucky snapshot."""
+
+    def __init__(self, engine):
+        super().__init__(name="chaos-depth-monitor", daemon=True)
+        self._engine = engine
+        self._halt = threading.Event()   # NB: Thread owns a _stop method
+        self.max_depth = 0
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            self.max_depth = max(self.max_depth,
+                                 self._engine.queue_depth())
+            self._halt.wait(0.002)
+
+    def stop(self) -> int:
+        self._halt.set()
+        self.join(5.0)
+        return self.max_depth
+
+
+def _flip_byte(path: str) -> None:
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+class ChaosHarness:
+    """One engine + swap watcher + bookkeeping for the invariants."""
+
+    def __init__(self, *, seed: int, shed_policy: str, workdir: str,
+                 verbose: bool):
+        from sharetrade_tpu.agents.base import TrainState
+        from sharetrade_tpu.checkpoint.manager import CheckpointManager
+        from sharetrade_tpu.config import ServeConfig
+        from sharetrade_tpu.models.transformer_episode import (
+            episode_transformer_policy,
+        )
+        from sharetrade_tpu.serve import ServeEngine, WeightSwapWatcher
+        from sharetrade_tpu.utils.metrics import MetricsRegistry
+
+        self.rng = random.Random(seed)
+        self.verbose = verbose
+        self.model = episode_transformer_policy(
+            obs_dim=OBS_DIM, num_layers=2, num_heads=2, head_dim=8)
+        self.versions = {0: self.model.init(jax.random.PRNGKey(seed))}
+        self.current_step = 0
+        prices_rng = np.random.default_rng(seed)
+        self.prices = prices_rng.uniform(10.0, 20.0, 512).astype(np.float32)
+
+        self.cfg = ServeConfig(
+            max_batch=4, slots=8, batch_timeout_ms=1.0, swap_poll_s=0.0,
+            stats_interval_s=0.2, max_queue=16, shed_policy=shed_policy,
+            max_restarts=3, restart_backoff_s=0.01,
+            restart_backoff_max_s=0.05,
+            swap_breaker_failures=BREAKER_FAILURES,
+            swap_breaker_cooldown_s=BREAKER_COOLDOWN_S)
+        self.registry = MetricsRegistry()
+        # done_depth=1: a SHALLOW dispatcher->consumer pipeline, so a
+        # stalled consumer backpressures the dispatcher after one batch
+        # and floods/deadline bursts actually pile into the ingress queue
+        # (at the default depth, pipeline capacity ~= max_queue and the
+        # stall scenarios would drain through without ever shedding).
+        self.engine = ServeEngine(self.model, self.cfg, self.versions[0],
+                                  params_step=0, registry=self.registry,
+                                  restart_seed=seed, done_depth=1)
+        self.engine.warmup()
+
+        def _train_state(params, updates):
+            return TrainState(params=params, opt_state=(), carry=(),
+                              env_state=(), rng=jax.random.PRNGKey(0),
+                              env_steps=jnp.int32(0),
+                              updates=jnp.int32(updates))
+
+        self._train_state = _train_state
+        self.manager = CheckpointManager(os.path.join(workdir, "ckpt"),
+                                         fsync=False)
+        self.watcher = WeightSwapWatcher(
+            self.engine, self.manager, _train_state(self.versions[0], 0),
+            tag="best", poll_s=60.0,
+            breaker_failures=BREAKER_FAILURES,
+            breaker_cooldown_s=BREAKER_COOLDOWN_S)
+
+        self._ref_apply = jax.jit(self.model.apply)
+        self.monitor = DepthMonitor(self.engine)
+        self.monitor.start()
+
+        #: Every handle ever submitted: (handle, fault_class_or_"traffic").
+        self.handles: list[tuple[object, str]] = []
+        #: Per-session episode clocks for the rolling traffic.
+        self.clock: dict[str, int] = {}
+        self.sid_serial = 0
+        #: Exact mirror of the swap watcher's breaker state machine.
+        self.swap_mirror = {"streak": 0, "opens": 0, "rejected": 0,
+                            "swaps": 0, "pending": None}
+        self.injected = {c: 0 for c in FAULT_CLASSES}
+        self.restarts_expected = 0
+
+    def say(self, msg: str) -> None:
+        if self.verbose:
+            print(f"[serve-chaos] {msg}", file=sys.stderr, flush=True)
+
+    # -- traffic ----------------------------------------------------------
+
+    def obs_for(self, sid: str) -> np.ndarray:
+        import zlib
+        t = self.clock.get(sid, 0)
+        start = zlib.crc32(sid.encode()) % 64   # deterministic across runs
+        lo = start + t
+        self.clock[sid] = t + 1
+        return np.concatenate(
+            [self.prices[lo:lo + WINDOW],
+             np.asarray([2400.0, float(t % 3)], np.float32)]
+        ).astype(np.float32)
+
+    def fresh_sid(self) -> str:
+        self.sid_serial += 1
+        return f"c{self.sid_serial}"
+
+    def traffic(self, sids: list[str], ticks: int = 2,
+                timeout: float = 20.0) -> None:
+        """Normal load between injections: every request must complete
+        with a RESULT (the engine is healthy here); also resets the
+        supervisor's consecutive-fault streak."""
+        for _ in range(ticks):
+            pending = [(sid, self.engine.submit(sid, self.obs_for(sid)))
+                       for sid in sids]
+            for sid, handle in pending:
+                self.handles.append((handle, "traffic"))
+                if handle.wait(timeout) is None:
+                    raise ChaosError(
+                        f"healthy traffic for {sid} failed: "
+                        f"{handle.error!r}")
+
+    def fresh_logits(self, obs: np.ndarray) -> np.ndarray:
+        """What a FRESH session answers for ``obs`` under the CURRENT
+        serving weights — the post-restart parity baseline."""
+        out, _ = self._ref_apply(self.versions[self.current_step], obs,
+                                 self.model.init_carry())
+        return np.asarray(out.logits)
+
+    # -- injections -------------------------------------------------------
+
+    def inject_dispatch_exception(self) -> None:
+        """A malformed request fails its batch, then the supervisor
+        rebuilds the engine; a previously-warm session must afterwards
+        answer bit-identically to a fresh session (fresh arena, no stale
+        slots)."""
+        from sharetrade_tpu.serve import ServeRejected
+
+        warm_sid = self.fresh_sid()
+        self.traffic([warm_sid], ticks=2)           # give it a warm carry
+        restarts0 = self.registry.counters().get("serve_restarts_total", 0)
+        bad = self.engine.submit(self.fresh_sid(),
+                                 np.ones(3, np.float32))
+        self.handles.append((bad, "dispatch_exception"))
+        if bad.wait(30.0) is not None or bad.error is None:
+            raise ChaosError("malformed request did not fail its batch")
+        if isinstance(bad.error, ServeRejected):
+            raise ChaosError("malformed request was shed, not dispatched "
+                             "(flood logic leaked into this injection)")
+        self.restarts_expected += 1
+        # The engine rebuilt: the formerly-warm session is cold now and
+        # must match a fresh session bitwise under the current weights.
+        obs = self.obs_for(warm_sid)
+        result = self.engine.submit(warm_sid, obs).wait(60.0)
+        if result is None:
+            raise ChaosError("engine did not heal after a dispatch fault")
+        expect = self.fresh_logits(obs)
+        if not np.array_equal(result.logits, expect):
+            raise ChaosError(
+                "post-restart response does not match a fresh session: "
+                "stale-slot cross-contamination across the rebuild")
+        restarts = self.registry.counters().get("serve_restarts_total", 0)
+        if restarts != restarts0 + 1:
+            raise ChaosError(
+                f"expected exactly one supervised restart, counter moved "
+                f"{restarts0} -> {restarts}")
+
+    def inject_slow_consumer(self) -> None:
+        """A stalling completion callback backpressures the pipeline;
+        everything still completes and the dispatcher never wedges."""
+        stall_s = self.rng.uniform(0.15, 0.3)
+        stalled = threading.Event()
+
+        def stall_cb(result):
+            stalled.set()
+            time.sleep(stall_s)
+
+        sid = self.fresh_sid()
+        handle = self.engine.submit(sid, self.obs_for(sid),
+                                    callback=stall_cb)
+        self.handles.append((handle, "slow_consumer"))
+        sids = [self.fresh_sid() for _ in range(6)]
+        self.traffic(sids, ticks=2, timeout=30.0)
+        if handle.wait(10.0) is None:
+            raise ChaosError("stalled-callback request never completed")
+        if not stalled.is_set():
+            raise ChaosError("stall callback never ran (consumer dead?)")
+
+    def inject_corrupt_swap(self) -> None:
+        """Publish a candidate (bit-flipped 3 times out of 4), poll the
+        watcher once, and check the outcome against an exact mirror of
+        the breaker state machine."""
+        kind = "good" if self.rng.random() < 0.25 else "corrupt"
+        self.current_candidate_step = step = self.current_step + 1 \
+            if kind == "good" else self.current_step + 101
+        params = self.model.init(jax.random.PRNGKey(1000 + step))
+        self.manager.save_tagged("best", self._train_state(params, step),
+                                 metadata={"updates": step})
+        if kind == "corrupt":
+            _flip_byte(os.path.join(self.manager.directory, "tag_best",
+                                    "state.msgpack"))
+        mirror = self.swap_mirror
+        mirror["pending"] = kind
+        # Don't pre-read `watcher.breaker_open`: the cooldown can expire
+        # between that read and poll_once()'s own monotonic check, making
+        # the harness expect a held-off poll while the watcher actually
+        # runs its half-open probe. A held-off poll is the ONLY path that
+        # returns with `_open_until` untouched and nonzero (a probe zeroes
+        # it first and a probe-rejection re-arms it to a LATER deadline),
+        # so the before/after comparison is race-free.
+        open_until_before = self.watcher._open_until
+        swapped = self.watcher.poll_once()
+        was_open = (open_until_before > 0.0
+                    and self.watcher._open_until == open_until_before)
+        if was_open:
+            if swapped:
+                raise ChaosError("breaker was OPEN but the watcher "
+                                 "polled and swapped anyway")
+            # candidate stays pending for a later half-open probe
+        elif kind == "good":
+            if not swapped:
+                raise ChaosError("genuine candidate refused with the "
+                                 "breaker closed")
+            self.versions[step] = params
+            self.current_step = step
+            mirror.update(pending=None, streak=0)
+            mirror["swaps"] += 1
+        else:
+            if swapped:
+                raise ChaosError("bit-flipped candidate was APPLIED")
+            mirror["pending"] = None
+            mirror["rejected"] += 1
+            mirror["streak"] += 1
+            if mirror["streak"] >= BREAKER_FAILURES:
+                mirror["opens"] += 1
+                if not self.watcher.breaker_open:
+                    raise ChaosError(
+                        f"{mirror['streak']} consecutive refusals did "
+                        "not open the breaker")
+                if self.registry.latest("serve_swap_breaker_open") != 1.0:
+                    raise ChaosError("serve_swap_breaker_open gauge not "
+                                     "raised with the breaker open")
+        self._reconcile_swap()
+
+    def _reconcile_swap(self) -> None:
+        mirror = self.swap_mirror
+        counters = self.registry.counters()
+        checks = [
+            (self.watcher.rejected, mirror["rejected"], "watcher.rejected"),
+            (self.watcher.breaker_opens, mirror["opens"],
+             "watcher.breaker_opens"),
+            (self.watcher.swaps, mirror["swaps"], "watcher.swaps"),
+            (counters.get("serve_swap_rejected_total", 0),
+             mirror["rejected"], "serve_swap_rejected_total"),
+            (counters.get("serve_swap_breaker_opens_total", 0),
+             mirror["opens"], "serve_swap_breaker_opens_total"),
+        ]
+        for got, want, name in checks:
+            if int(got) != int(want):
+                raise ChaosError(
+                    f"swap counter {name} = {got} diverged from the "
+                    f"injection mirror {want}")
+
+    def _stall_and_burst(self, n: int, *, deadline_ms: float | None,
+                         tag: str) -> list:
+        """Stall the consumer with one sleeping callback, then burst
+        ``n`` submits INSIDE the stall window; returns the burst
+        handles. Waits for the stall to actually engage first — a burst
+        racing ahead of the stall request would (under "oldest") shed
+        the stall itself and measure an unstalled engine."""
+        stall_s = self.rng.uniform(0.25, 0.4)
+        engaged = threading.Event()
+
+        def stall_cb(_result):
+            engaged.set()
+            time.sleep(stall_s)
+
+        sid = self.fresh_sid()
+        stall = self.engine.submit(sid, self.obs_for(sid),
+                                   callback=stall_cb)
+        self.handles.append((stall, tag))
+        if not engaged.wait(20.0):
+            raise ChaosError("consumer stall request never dispatched")
+        burst = []
+        for _ in range(n):
+            sid = self.fresh_sid()
+            handle = self.engine.submit(sid, self.obs_for(sid),
+                                        deadline_ms=deadline_ms)
+            self.handles.append((handle, tag))
+            burst.append(handle)
+        return burst
+
+    def inject_queue_flood(self) -> None:
+        """Flood far past max_queue behind a stalled consumer: admission
+        control must shed or reject the excess (terminal ServeRejected
+        outcomes), the queue must stay bounded (the monitor asserts
+        globally), and shed/reject counters must equal the observed
+        rejected handles EXACTLY."""
+        from sharetrade_tpu.serve import ServeRejected
+
+        counters0 = self.registry.counters()
+        burst = self._stall_and_burst(8 * self.cfg.max_queue,
+                                      deadline_ms=None, tag="queue_flood")
+        outcomes = {"result": 0, "rejected": 0, "other": 0}
+        for handle in burst:
+            result = handle.wait(30.0)
+            if result is not None:
+                outcomes["result"] += 1
+            elif isinstance(handle.error, ServeRejected):
+                outcomes["rejected"] += 1
+            elif handle.error is not None:
+                outcomes["other"] += 1
+            else:
+                raise ChaosError("flood request left with NO terminal "
+                                 "outcome (wedged handle)")
+        if outcomes["rejected"] == 0:
+            raise ChaosError(
+                f"a {8 * self.cfg.max_queue}-request flood past "
+                f"max_queue={self.cfg.max_queue} shed nothing "
+                f"(outcomes: {outcomes})")
+        counters = self.registry.counters()
+        shed_delta = (counters.get("serve_shed_total", 0)
+                      - counters0.get("serve_shed_total", 0))
+        rej_delta = (counters.get("serve_queue_rejected_total", 0)
+                     - counters0.get("serve_queue_rejected_total", 0))
+        if int(shed_delta + rej_delta) != outcomes["rejected"]:
+            raise ChaosError(
+                f"shed ({shed_delta}) + rejected ({rej_delta}) counters "
+                f"!= observed ServeRejected handles "
+                f"({outcomes['rejected']})")
+        if self.registry.latest("serve_overload") is None:
+            raise ChaosError("serve_overload gauge never published "
+                             "during a flood")
+        self._last_flood_outcomes = outcomes
+
+    def inject_deadline_burst(self) -> None:
+        """Tightly-deadlined burst behind a stalled consumer: whatever
+        the dispatcher can't reach in time must expire with
+        ServeDeadlineExceeded (exactly matching the counter), and
+        expired + served + shed must cover the burst."""
+        from sharetrade_tpu.serve import ServeDeadlineExceeded, ServeRejected
+
+        counters0 = self.registry.counters()
+        n = 3 * self.cfg.max_queue
+        burst = self._stall_and_burst(n, deadline_ms=20.0,
+                                      tag="deadline_burst")
+        outcomes = {"result": 0, "expired": 0, "rejected": 0, "other": 0}
+        for handle in burst:
+            result = handle.wait(30.0)
+            if result is not None:
+                outcomes["result"] += 1
+            elif isinstance(handle.error, ServeDeadlineExceeded):
+                outcomes["expired"] += 1
+            elif isinstance(handle.error, ServeRejected):
+                outcomes["rejected"] += 1
+            elif handle.error is not None:
+                outcomes["other"] += 1
+            else:
+                raise ChaosError("deadline-burst request left with NO "
+                                 "terminal outcome (wedged handle)")
+        if outcomes["expired"] == 0:
+            raise ChaosError(
+                f"no deadline expiries in a {n}-request 20 ms-deadline "
+                f"burst behind a stalled consumer (outcomes: {outcomes})")
+        expired_delta = (
+            self.registry.counters().get("serve_deadline_expired_total", 0)
+            - counters0.get("serve_deadline_expired_total", 0))
+        if int(expired_delta) != outcomes["expired"]:
+            raise ChaosError(
+                f"serve_deadline_expired_total delta {expired_delta} != "
+                f"observed deadline errors {outcomes['expired']}")
+        if sum(outcomes.values()) != n:
+            raise ChaosError(f"deadline-burst outcomes {outcomes} do not "
+                             f"cover the {n}-request burst")
+
+    # -- invariants -------------------------------------------------------
+
+    def assert_all_terminal(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        for handle, tag in self.handles:
+            handle.wait(max(deadline - time.monotonic(), 0.1))
+            if handle.result is None and handle.error is None:
+                raise ChaosError(
+                    f"request from {tag!r} never reached a terminal "
+                    "outcome: the engine wedged")
+
+    def assert_restarts_reconcile(self) -> None:
+        restarts = self.registry.counters().get("serve_restarts_total", 0)
+        if int(restarts) != self.restarts_expected:
+            raise ChaosError(
+                f"serve_restarts_total {restarts} != injected dispatch "
+                f"faults {self.restarts_expected}")
+
+    def close(self) -> dict:
+        max_depth = self.monitor.stop()
+        stopped = self.engine.stop(drain=False, timeout_s=30.0)
+        if not stopped:
+            raise ChaosError("engine.stop() reported hung threads at "
+                             "soak end")
+        return {"max_queue_depth_seen": max_depth}
+
+
+def run_chaos(*, injections: int = 20, seed: int = 0,
+              shed_policy: str = "oldest", workdir: str | None = None,
+              verbose: bool = True) -> dict:
+    """The soak driver; returns a summary dict, raises ChaosError on any
+    invariant violation."""
+    own_dir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="serve_chaos_")
+    os.makedirs(workdir, exist_ok=True)
+    t0 = time.perf_counter()
+    try:
+        h = ChaosHarness(seed=seed, shed_policy=shed_policy,
+                         workdir=workdir, verbose=verbose)
+        # Schedule: shuffled class round-robin so EVERY class appears in
+        # a full soak (and any >= 5-injection run); seeded for replay.
+        schedule: list[str] = []
+        while len(schedule) < injections:
+            block = list(FAULT_CLASSES)
+            h.rng.shuffle(block)
+            schedule.extend(block)
+        schedule = schedule[:injections]
+
+        steady = [h.fresh_sid() for _ in range(3)]
+        h.traffic(steady, ticks=2)          # pre-soak healthy baseline
+        for i, fault in enumerate(schedule):
+            h.say(f"injection {i + 1}/{injections}: {fault}")
+            h.injected[fault] += 1
+            getattr(h, f"inject_{fault}")()
+            # Settle traffic: the engine must serve normally after every
+            # injection (and this resets the supervisor's fault streak).
+            h.traffic(steady, ticks=1)
+            h.assert_all_terminal()
+            if h.monitor.max_depth > h.cfg.max_queue:
+                raise ChaosError(
+                    f"ingress queue depth {h.monitor.max_depth} exceeded "
+                    f"serve.max_queue={h.cfg.max_queue}")
+        h.assert_restarts_reconcile()
+        h._reconcile_swap()
+        summary_extra = h.close()
+        counters = h.registry.counters()
+        summary = {
+            "injections": injections,
+            "seed": seed,
+            "shed_policy": shed_policy,
+            "by_class": h.injected,
+            "requests_total": int(counters.get("serve_requests_total", 0)),
+            "shed_total": int(counters.get("serve_shed_total", 0)),
+            "queue_rejected_total": int(
+                counters.get("serve_queue_rejected_total", 0)),
+            "deadline_expired_total": int(
+                counters.get("serve_deadline_expired_total", 0)),
+            "restarts_total": int(
+                counters.get("serve_restarts_total", 0)),
+            "swap_rejected_total": int(
+                counters.get("serve_swap_rejected_total", 0)),
+            "swap_breaker_opens_total": int(
+                counters.get("serve_swap_breaker_opens_total", 0)),
+            "elapsed_s": round(time.perf_counter() - t0, 2),
+            **summary_extra,
+        }
+        h.say(f"soak PASSED: {json.dumps(summary)}")
+        return summary
+    finally:
+        if own_dir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--injections", type=int, default=20,
+                        help=">= 20 covers every fault class several "
+                             "times; 2 is the tier-1/make-check quick "
+                             "profile")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--shed-policy", default="oldest",
+                        choices=["reject", "oldest"])
+    parser.add_argument("--workdir", default=None,
+                        help="keep checkpoint artifacts here instead of "
+                             "a temp dir")
+    args = parser.parse_args()
+    try:
+        summary = run_chaos(injections=args.injections, seed=args.seed,
+                            shed_policy=args.shed_policy,
+                            workdir=args.workdir)
+    except ChaosError as exc:
+        print(f"[serve-chaos] FAILED: {exc}", file=sys.stderr)
+        return 1
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
