@@ -292,7 +292,8 @@ def cmd_serve(args) -> int:
         engine = ServeEngine(agent.model, cfg.serve, params,
                              params_step=step,
                              precision=policy_from_config(cfg.precision),
-                             registry=registry, obs=obs_bundle)
+                             registry=registry, obs=obs_bundle,
+                             obs_cfg=cfg.obs)
         engine.warmup()
         if cfg.serve.swap_poll_s > 0:
             watcher = WeightSwapWatcher(
@@ -361,6 +362,22 @@ def cmd_serve(args) -> int:
             "stopped_clean": stopped_clean,
             "engine_failed": engine_failed,
         }
+        # Stage-decomposition tail (the ISSUE-11 observability surface):
+        # histogram-derived per-stage p99s plus the slowest exemplars —
+        # the "which stage owns the tail" answer in the run summary.
+        from sharetrade_tpu.obs import serve_stage_p99s
+        stage_p99 = serve_stage_p99s(registry)
+        if stage_p99:
+            summary["stage_p99_ms"] = stage_p99
+        slowest = engine.exemplars()[:3]
+        if slowest:
+            summary["slowest"] = slowest
+        for key, gauge in (("slo_availability_burn",
+                            "serve_slo_availability_burn"),
+                           ("slo_latency_burn", "serve_slo_latency_burn")):
+            value = registry.latest(gauge)
+            if value is not None:
+                summary[key] = round(value, 4)
         if preempt_at:
             summary["preempted"] = True
             log.warning("serve run preempted; in-flight requests %s",

@@ -485,8 +485,6 @@ class ServeConfig:
     # serve_p99_ms / serve_batch_occupancy / serve_queue_depth through
     # MetricsRegistry -> metrics.prom).
     stats_interval_s: float = 1.0
-    # Per-request latency ring the percentile gauges are computed over.
-    latency_window: int = 8192
     # --- Overload & failure semantics (README "Serving tier") ---------
     # Admission control: the ingress queue holds at most this many
     # requests. A submit past the bound is never silently absorbed into
@@ -573,6 +571,40 @@ class ObsConfig:
     # (summarized by ``cli obs``). Off by default like the rest of obs/:
     # disabled means no artifact, no gauges, no capture compile.
     roofline: bool = False
+    # Per-REQUEST serve tracing (serve/engine.py): with obs enabled and
+    # the span trace on, every submitted request's lifecycle — submitted
+    # -> collected -> dispatched -> device-complete -> callback-complete,
+    # plus the shed / expired / failed terminal edges — is emitted as
+    # nested async spans keyed by request/batch/session ids, so Perfetto
+    # renders request flows THROUGH batches. Sub-knob of obs.enabled +
+    # obs.trace (volume control: a busy engine emits several events per
+    # request); off everywhere by the obs.enabled=false default.
+    request_trace: bool = True
+    # Slowest-request exemplars: the serve engine keeps the K slowest
+    # completed requests of each stats window — with their full stage
+    # breakdown — in a bounded ring, written to serve_exemplars.json in
+    # the run dir (obs enabled), surfaced by ``cli obs`` / ``cli serve``,
+    # and recorded into the flight ring on overload/SLO-burn/failure
+    # events. Bounds the ring; 0 disables exemplar tracking.
+    exemplar_k: int = 8
+    # --- SLO burn-rate monitoring (serve/engine.py _publish_stats) ----
+    # Availability objective: the fraction of terminal requests that must
+    # SUCCEED (sheds, rejections, deadline expiries, batch/engine
+    # failures all count against it). The engine publishes
+    # serve_slo_availability_burn = (observed bad fraction over the
+    # rolling window) / (1 - objective): burn 1.0 = exactly spending the
+    # error budget, >1 = burning it faster. 0 (default) disables.
+    slo_availability: float = 0.0
+    # Latency objective: target p99 in ms — at most 1% of completed
+    # requests per window may exceed it. serve_slo_latency_burn =
+    # (observed slow fraction) / 0.01. 0 (default) disables.
+    slo_target_p99_ms: float = 0.0
+    # Rolling window the burn rates are computed over (seconds).
+    slo_window_s: float = 60.0
+    # Burn level that records a flight-recorder event (with the current
+    # exemplars) and a trace instant when first crossed; re-arms after
+    # burn falls below half the threshold (hysteresis, not spam).
+    slo_burn_threshold: float = 2.0
     # Soak-run growth caps (active regardless of ``enabled`` — they bound
     # the IN-MEMORY primitives, not the exported files). Short runs never
     # reach them, so default behavior is unchanged; 0 = unbounded (the

@@ -34,7 +34,15 @@ import logging
 import os
 from typing import Any
 
-from sharetrade_tpu.obs.exporter import MetricsExporter  # noqa: F401
+from sharetrade_tpu.obs.exporter import (  # noqa: F401
+    MetricsExporter,
+    PromParseError,
+    parse_prom_text,
+)
+from sharetrade_tpu.obs.hist import (  # noqa: F401
+    Histogram,
+    quantile_from_snapshot,
+)
 from sharetrade_tpu.obs.flight import (  # noqa: F401
     FlightRecorder,
     RingLogHandler,
@@ -48,6 +56,24 @@ from sharetrade_tpu.obs.roofline import (  # noqa: F401
 from sharetrade_tpu.obs.trace import SpanTracer, read_trace  # noqa: F401
 
 FLIGHT_BUNDLE = "flight_recorder.json"
+
+#: Stage names of the serve request-latency decomposition, in lifecycle
+#: order — the single source for the ``serve_<stage>_ms`` histogram
+#: families shared by the engine, the CLI/run-dir summaries, the soak's
+#: perf-gate rows, and the obs demo.
+SERVE_STAGES = ("queue_wait", "batch_wait", "device", "readback")
+
+
+def serve_stage_p99s(registry: Any) -> dict[str, float]:
+    """Histogram-derived per-stage p99s off a live ``MetricsRegistry`` —
+    the "which stage owns the tail" row every serve summary prints.
+    Stages with no observations are omitted."""
+    out: dict[str, float] = {}
+    for stage in SERVE_STAGES:
+        hist = registry.histogram(f"serve_{stage}_ms")
+        if hist is not None and hist.count:
+            out[stage] = round(hist.quantile(0.99), 3)
+    return out
 
 
 class Obs:
@@ -205,6 +231,16 @@ def summarize_run_dir(run_dir: str) -> dict:
                 os.path.join(run_dir, "metrics.prom")),
         }
         gauges = (last_rec or {}).get("gauges") or {}
+        hists = (last_rec or {}).get("histograms") or {}
+        if hists:
+            # Histogram tails in one glanceable block: per-metric count +
+            # p50/p99 derived from the exported buckets (the same bucket
+            # math a fleet aggregator runs after merging engines).
+            out["histograms"] = {
+                name: {"count": snap.get("count", 0),
+                       "p50": round(quantile_from_snapshot(snap, 0.50), 3),
+                       "p99": round(quantile_from_snapshot(snap, 0.99), 3)}
+                for name, snap in sorted(hists.items())}
         if ("replay_size" in gauges
                 or any(k.startswith(("per_", "journal_"))
                        for k in list(gauges) + list(counters))):
@@ -254,7 +290,36 @@ def summarize_run_dir(run_dir: str) -> dict:
                 "swap_breaker_open": gauges.get("serve_swap_breaker_open"),
                 "swap_breaker_opens_total": counters.get(
                     "serve_swap_breaker_opens_total", 0.0),
+                # Request-level observability (ISSUE 11): per-stage tail
+                # decomposition, SLO burn rates, trace-health counters.
+                "slo_availability_burn": gauges.get(
+                    "serve_slo_availability_burn"),
+                "slo_latency_burn": gauges.get("serve_slo_latency_burn"),
+                "slo_burn_alerts_total": counters.get(
+                    "serve_slo_burn_alerts_total", 0.0),
+                "trace_decomposition_errors_total": counters.get(
+                    "serve_trace_decomposition_error_total", 0.0),
             }
+            stages = {}
+            for stage in SERVE_STAGES:
+                snap = hists.get(f"serve_{stage}_ms")
+                if snap and snap.get("count"):
+                    stages[stage] = {
+                        "count": snap["count"],
+                        "p50_ms": round(
+                            quantile_from_snapshot(snap, 0.50), 3),
+                        "p99_ms": round(
+                            quantile_from_snapshot(snap, 0.99), 3)}
+            if stages:
+                out["serve"]["stages"] = stages
+    exemplars_path = os.path.join(run_dir, "serve_exemplars.json")
+    if os.path.isfile(exemplars_path):
+        with open(exemplars_path, encoding="utf-8") as f:
+            ex = (json.load(f).get("exemplars") or [])[:5]
+        if ex:
+            # The K slowest requests with their stage breakdown — the
+            # "why was the tail slow" answer without opening the trace.
+            out.setdefault("serve", {})["slowest_exemplars"] = ex
     roofline = read_roofline(run_dir)
     if roofline is not None:
         out["roofline"] = summarize_roofline(roofline)

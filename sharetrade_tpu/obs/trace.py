@@ -95,6 +95,31 @@ class SpanTracer:
             **({"args": args} if args else {}),
         })
 
+    def to_us(self, t_perf: float) -> float:
+        """A raw ``time.perf_counter()`` stamp on this tracer's timeline —
+        for RETROSPECTIVE emission (the serve engine stamps request edges
+        as floats and emits the whole lifecycle at completion)."""
+        return (t_perf - self._t0) * 1e6
+
+    @property
+    def pid(self) -> int:
+        return self._pid
+
+    def emit_lines(self, lines: list[str]) -> None:
+        """Bulk-append PRE-SERIALIZED event lines (no trailing comma/
+        newline) under one lock acquisition — the per-request hot path.
+        The serve engine formats its request-lifecycle events with
+        f-strings instead of per-event ``json.dumps`` (measured ~10x
+        cheaper at 5 events/request on the completion thread); callers
+        own the validity of what they hand in (tests round-trip it
+        through :func:`read_trace`)."""
+        with self._lock:
+            if self._fh is None:
+                return
+            self._buf.extend(lines)
+            if len(self._buf) >= self._flush_every:
+                self._flush_locked()
+
     def _emit(self, event: dict) -> None:
         with self._lock:
             if self._fh is None:

@@ -166,6 +166,20 @@ class Orchestrator:
         # sampling cadence and reads only host values that the batched
         # megachunk readback already materialized (no new device syncs).
         self.obs = build_obs(cfg, self.metrics, mesh=mesh)
+        # Training-side mergeable histograms (obs/hist.py; ISSUE 11): the
+        # per-boundary chunk wall time and the inter-dispatch gap as
+        # fixed-bucket distributions, exported through metrics.prom next
+        # to the serve tier's stage histograms — the fleet-mergeable form
+        # of what bench_async_pipeline measures from trace spans. Obs-
+        # gated: the default obs-off hot loop stays structurally
+        # instrumentation-free (one None check per dispatch).
+        self._h_chunk_seconds = self._h_dispatch_gap = None
+        if cfg.obs.enabled:
+            from sharetrade_tpu.obs.hist import SECONDS_BOUNDS, Histogram
+            self._h_chunk_seconds = self.metrics.attach_histogram(
+                "train_chunk_seconds", Histogram(bounds=SECONDS_BOUNDS))
+            self._h_dispatch_gap = self.metrics.attach_histogram(
+                "train_dispatch_gap_ms", Histogram())
         self.checkpoints = checkpoints or CheckpointManager(
             cfg.runtime.checkpoint_dir, keep=cfg.runtime.keep_checkpoints,
             fsync=cfg.checkpoint.fsync,
@@ -642,6 +656,25 @@ class Orchestrator:
         last_env_steps: int | None = env_steps0
         chunks_since = 0   # chunks since the last materialization decision
         chunks_ahead = 0   # chunks dispatched past the last boundary row SEEN
+        # Inter-dispatch gap histogram (obs-gated): end of one dispatch
+        # call to the start of the next — the dispatch-floor signal
+        # bench_async_pipeline derives from trace spans, kept here as a
+        # mergeable distribution. Reset to None across recoveries so a
+        # backoff sleep never counts as a "gap". ONE helper pair shared
+        # by the sync and prefetch dispatch sites: both paths must stamp
+        # identically for train_dispatch_gap_ms to mean one distribution.
+        last_dispatch_end: float | None = None
+
+        def _note_dispatch_gap() -> None:
+            if (self._h_dispatch_gap is not None
+                    and last_dispatch_end is not None):
+                self._h_dispatch_gap.observe(
+                    (time.perf_counter() - last_dispatch_end) * 1e3)
+
+        def _stamp_dispatch_end() -> None:
+            nonlocal last_dispatch_end
+            if self._h_dispatch_gap is not None:
+                last_dispatch_end = time.perf_counter()
         self._committed_idx = 0
         # Double-buffered dispatch (runtime.double_buffer_dispatch; sync
         # path only — the async pipeline subsumes it): the (metrics, K,
@@ -763,6 +796,7 @@ class Orchestrator:
                         or self._transitions_journal is not None
                         or (last_env_steps + (chunks_ahead + k)
                             * rt.chunk_steps) >= threshold)
+                    _note_dispatch_gap()
                     with (obs.span("dispatch", chunk=chunk_idx, k=k)
                           if sampling else _NULL_CTX), self.tracer.span(
                             f"train_chunk_{chunk_idx}"
@@ -783,6 +817,7 @@ class Orchestrator:
                             # the CPU fused-scan carve-outs (_build_step,
                             # sharding.py) exist to avoid a use-after-free.
                             self._ts = ts
+                    _stamp_dispatch_end()
                 transitions = metrics.pop("transitions", None)
                 chunks_since += k
                 chunks_ahead += k
@@ -852,6 +887,7 @@ class Orchestrator:
                     # The obs dispatch span mirrors that (this block only
                     # runs at materialization boundaries, so it is already
                     # on the sampled path).
+                    _note_dispatch_gap()
                     with (obs.span("dispatch", chunk=chunk_idx + k, k=k,
                                    prefetch=True)
                           if obs.enabled else _NULL_CTX), self.tracer.span(
@@ -859,6 +895,7 @@ class Orchestrator:
                         with self._step_lock:
                             ts, ahead = self._mega_fn(self._ts)
                             self._ts = ts
+                    _stamp_dispatch_end()
                     pending = (ahead, k, self.agent_heals)
                 # Synchronous path: readback + host processing inline (the
                 # pre-pipeline behavior, byte-identical).
@@ -875,6 +912,7 @@ class Orchestrator:
             except Exception as exc:  # supervision decider
                 last_env_steps = None   # resync after any recovery path
                 pending = None          # in-flight megachunk is now stale
+                last_dispatch_end = None  # recovery/backoff is not a "gap"
                 pipeline_fault = pl is not None and exc is pl.error
                 if pl is not None:
                     # Quiesce and replace the pipeline: boundaries still
@@ -1108,6 +1146,12 @@ class Orchestrator:
                     self.metrics.record_many(row)
             metrics = rows[-1]
             metrics.update(self._timer.tick(b.chunks_covered))
+            if (self._h_chunk_seconds is not None
+                    and metrics.get("chunk_seconds")):
+                # Consumer-thread histogram of the sampled per-chunk wall
+                # time (obs/hist.py): the mergeable distribution behind
+                # the chunk_seconds gauge — host floats only, no sync.
+                self._h_chunk_seconds.observe(metrics["chunk_seconds"])
             if obs.roofline is not None:
                 # Live roofline gauges (mfu / achieved_tflops / hbm_gbps):
                 # static compiled costs divided by the sampled per-chunk

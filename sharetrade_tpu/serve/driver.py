@@ -127,7 +127,8 @@ class BatchOneServer:
         self._carry0 = precision.cast_carry(model.init_carry(), model)
         self._apply = jax.jit(model.apply)
         self._carries: "OrderedDict[Any, Any]" = OrderedDict()
-        self._q: "deque[tuple]" = deque()
+        self._q: "deque[tuple]" = deque()  # trace-buffer-ok: closed-loop
+        # harness bounds in-flight requests at its concurrency
         self._cv = threading.Condition()
         self._stopped = False
         self._thread = threading.Thread(target=self._loop,
@@ -223,7 +224,8 @@ def run_closed_loop(server: Any, sessions: list[SessionSim], *,
     #: synchronously on the submitting thread — resubmitting from inside
     #: the callback would recurse submit→reject→callback→submit without
     #: bound under sustained overload, so the failure path always defers.
-    retry: deque[SessionSim] = deque()
+    retry: deque[SessionSim] = deque()  # trace-buffer-ok: at most one
+    # parked entry per session (submit-on-completion harness)
     t_end = time.perf_counter() + duration_s
 
     def cb_for(sess: SessionSim):
@@ -307,7 +309,8 @@ def run_open_loop(server: Any, sessions: list[SessionSim], *,
     QPS + latency percentiles."""
     lock = threading.Lock()
     lat: list[float] = []
-    ready: deque[SessionSim] = deque(sessions)
+    ready: deque[SessionSim] = deque(sessions)  # trace-buffer-ok: holds at
+    # most the fixed session population
     offered = dropped = 0
     inflight = {"n": 0, "failed": 0, "last_done": time.perf_counter()}
     idle_evt = threading.Event()

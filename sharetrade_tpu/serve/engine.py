@@ -77,12 +77,53 @@ Overload & failure semantics (ISSUE 10; tools/serve_chaos.py pins them):
 Every submitted request reaches exactly one terminal outcome — result,
 rejection, deadline error, batch failure, or engine failure — the chaos
 soak's core invariant.
+
+Observability (ISSUE 11). Every request carries a :class:`RequestTrace`
+stamped at each lifecycle edge (submitted → collected → dispatched →
+device-complete → callback-complete, plus the shed/expired/failed
+terminal edges and deferral counts). From the stamps the engine derives,
+ALWAYS (they are the SLO gauges' source):
+
+- **per-stage histograms** (obs/hist.py; fixed log buckets, exact
+  merge): ``serve_queue_wait_ms`` / ``serve_batch_wait_ms`` /
+  ``serve_device_ms`` / ``serve_readback_ms`` / ``serve_request_ms`` —
+  and the ``serve_p50_ms``/``serve_p99_ms`` gauges are now quantiles of
+  the end-to-end histogram's per-window bucket DELTA (cumulative counts
+  subtract exactly), replacing the old sample-ring percentiles;
+- **stage decomposition invariant**: for every completed request
+  queue_wait + batch_wait + device == latency_ms by construction
+  (telescoping perf_counter stamps); a violation increments
+  ``serve_trace_decomposition_error_total``, which the soaks assert
+  stays 0 (``ServeResult.stages`` carries the breakdown per response);
+- **exemplars**: a bounded ring of the K slowest requests per stats
+  window with their full stage breakdown (``obs.exemplar_k``), written
+  to ``serve_exemplars.json`` when obs is on and recorded into the
+  flight ring on overload onset / SLO burn / supervised restart /
+  terminal failure;
+- **SLO burn rates** (``obs.slo_*``): rolling error-budget burn gauges
+  ``serve_slo_availability_burn`` (sheds/expiries/failures against the
+  availability objective) and ``serve_slo_latency_burn`` (fraction over
+  the target p99 against the 1% allowance), with a flight-recorder
+  event on threshold crossing — the per-engine signal a fleet router
+  aggregates.
+
+With obs enabled (``obs.request_trace``), the lifecycle additionally
+emits through obs/trace.py as nested ASYNC spans keyed by
+request/batch/session ids, so Perfetto renders request flows through the
+batches the dispatcher coalesced them into; off by default, zero
+artifacts, and the stamps themselves are a few ``perf_counter`` calls
+per request (<2% measured — ``bench_obs_overhead`` serve arm).
 """
 
 from __future__ import annotations
 
+import itertools
+import json
+import math
+import os
 import queue
 import random
+import re
 import threading
 import time
 from collections import OrderedDict, deque
@@ -94,6 +135,8 @@ import numpy as np
 
 from sharetrade_tpu.config import ConfigError, ServeConfig
 from sharetrade_tpu.models.core import apply_batched
+from sharetrade_tpu.obs import SERVE_STAGES
+from sharetrade_tpu.obs.hist import Histogram
 from sharetrade_tpu.precision import FP32, PrecisionPolicy
 from sharetrade_tpu.utils.logging import get_logger
 from sharetrade_tpu.utils.metrics import MetricsRegistry
@@ -101,6 +144,11 @@ from sharetrade_tpu.utils.metrics import MetricsRegistry
 log = get_logger("serve")
 
 _SHUTDOWN = object()
+
+#: Session ids made only of these characters embed into trace JSON
+#: without escaping (the fast path — harness/CLI ids are all of this
+#: shape); anything else routes through json.dumps.
+_SID_SAFE = re.compile(r"[A-Za-z0-9_\-#.:]*\Z").match
 
 
 class ServeRejected(RuntimeError):
@@ -133,23 +181,71 @@ class ServeEngineFailed(RuntimeError):
 
 def latency_percentiles(values) -> dict[str, float]:
     """p50/p99/mean over a latency sample, ONE quantile convention for the
-    whole serving tier (the SLO gauges here and the load harnesses in
-    serve/driver.py — BASELINE.md compares the two directly, so their
-    percentile math must never diverge)."""
+    whole serving tier (the SLO gauges here, the load harnesses in
+    serve/driver.py, and the histogram quantiles in obs/hist.py —
+    BASELINE.md compares them directly, so the percentile math must never
+    diverge).
+
+    Convention: NEAREST-RANK, rank = ceil(q·n), 1-indexed. The old
+    ``int(q * (n - 1))`` floored the rank and systematically UNDERSTATED
+    the tail at small n (with n=10 its "p99" was the 9th value — really
+    p90); ceil(q·n) is the standard nearest-rank estimator whose reported
+    p99 is a value at least 99% of the sample does not exceed."""
     if not len(values):
         return {"p50_ms": 0.0, "p99_ms": 0.0, "mean_ms": 0.0}
     arr = np.sort(np.asarray(values, np.float64))
+    n = len(arr)
+
+    def nearest_rank(q: float) -> float:
+        return float(arr[min(max(math.ceil(q * n), 1), n) - 1])
+
     return {
-        "p50_ms": float(arr[int(0.50 * (len(arr) - 1))]),
-        "p99_ms": float(arr[int(0.99 * (len(arr) - 1))]),
+        "p50_ms": nearest_rank(0.50),
+        "p99_ms": nearest_rank(0.99),
         "mean_ms": float(arr.mean()),
     }
+
+
+class RequestTrace:
+    """Lifecycle stamps of one request, on the ``perf_counter`` clock.
+
+    Stamps telescope, so the stage decomposition of a completed request
+    sums EXACTLY to its end-to-end latency:
+
+    ``queue_wait`` (t_enq→t_collected) + ``batch_wait``
+    (t_collected→t_dispatched) + ``device`` (t_dispatched→t_device,
+    device compute + readback of its group) == ``latency_ms``
+    (t_device - t_enq); ``readback`` (t_device→t_done) is the
+    completion/callback wait on top — the trace span shows that
+    client-observable wall wait, while the ``serve_readback_ms``
+    histogram charges each request only its OWN completion slice (the
+    consumer serializes a batch's callbacks). Unstamped edges stay
+    None (a shed request never collected; an expired one never
+    dispatched)."""
+
+    __slots__ = ("rid", "t_enq", "t_collected", "t_dispatched", "t_device",
+                 "t_done", "deferrals", "cold", "batch", "outcome")
+
+    def __init__(self, rid: int, t_enq: float):
+        self.rid = rid
+        self.t_enq = t_enq
+        self.t_collected: float | None = None
+        self.t_dispatched: float | None = None
+        self.t_device: float | None = None
+        self.t_done: float | None = None
+        self.deferrals = 0          # same-session ticks waited out
+        self.cold = False           # served through the batched prefill
+        self.batch: int | None = None   # dispatch tick serial
+        self.outcome: str | None = None
 
 
 class ServeResult(NamedTuple):
     """One completed inference: the action plus enough provenance to audit
     it (``params_step`` names the exact checkpoint that produced it — the
-    hot-swap atomicity observable)."""
+    hot-swap atomicity observable). ``stages`` is the request's latency
+    decomposition (``queue_wait_ms``/``batch_wait_ms``/``device_ms``,
+    summing exactly to ``latency_ms`` — the invariant the soaks assert);
+    None only from servers that don't stage-stamp (BatchOneServer)."""
 
     session_id: Any
     action: int
@@ -157,6 +253,7 @@ class ServeResult(NamedTuple):
     value: float
     params_step: int
     latency_ms: float
+    stages: dict | None = None
 
 
 class _Live(NamedTuple):
@@ -173,14 +270,18 @@ class _Request:
     outcome)."""
 
     __slots__ = ("session_id", "obs", "t_enq", "t_deadline", "callback",
-                 "_event", "result", "error")
+                 "_event", "result", "error", "trace")
 
     def __init__(self, session_id: Any, obs: np.ndarray,
                  callback: Callable[[ServeResult | None], None] | None,
-                 deadline_ms: float = 0.0):
+                 deadline_ms: float = 0.0, rid: int = 0):
         self.session_id = session_id
         self.obs = obs
         self.t_enq = time.perf_counter()
+        #: Lifecycle stamps (always kept — the per-stage histograms' and
+        #: SLO gauges' source; the async trace spans ride them when obs
+        #: request tracing is on).
+        self.trace = RequestTrace(rid, self.t_enq)
         #: Absolute expiry on the perf_counter clock; None = no deadline.
         #: A NEGATIVE deadline_ms (a client whose latency budget already
         #: ran out before submit) means already-expired — clamped to the
@@ -281,6 +382,7 @@ class ServeEngine:
                  precision: PrecisionPolicy = FP32,
                  registry: MetricsRegistry | None = None,
                  obs: Any = None,
+                 obs_cfg: Any = None,
                  done_depth: int = 4,
                  restart_seed: int | None = None):
         if cfg.max_batch < 1:
@@ -332,6 +434,8 @@ class ServeEngine:
         # Bounded ingress: depth caps at serve.max_queue, the overload
         # surface (submit sheds/rejects instead of growing host memory).
         self._q: queue.Queue = queue.Queue(maxsize=cfg.max_queue)
+        # trace-buffer-ok: bounded by logic, not maxlen — _collect_batch
+        # sheds/rejects past cfg.max_queue (the deferred-overflow branch)
         self._deferred: deque[_Request] = deque()
         self._done_q: queue.Queue = queue.Queue(maxsize=done_depth)
         #: Sessions whose slot carry is suspect after a CONSUMER fault
@@ -339,7 +443,9 @@ class ServeEngine:
         #: failed): appended by the consumer, drained — and dropped from
         #: the pool — by the DISPATCHER, which owns the SlotPool (a
         #: cross-thread drop would race admit()'s LRU iteration).
-        self._poisoned: deque = deque()
+        self._poisoned: deque = deque()  # trace-buffer-ok: drained to empty
+        # by the dispatcher every tick; growth is bounded by in-flight
+        # batches (done_depth * max_batch)
         self._stop_event = threading.Event()
         self._pending = 0
         self._pending_lock = threading.Lock()
@@ -368,12 +474,101 @@ class ServeEngine:
         # _pending_lock; feeds the serve_overload gauge).
         self._overload_events = 0
 
-        # SLO accounting (consumer-thread-owned except the latency ring's
-        # bounded deque, which is append-only from one thread anyway).
-        self._lat: deque[float] = deque(maxlen=cfg.latency_window)
+        # SLO accounting (consumer-thread-owned).
         self._stats_t = time.perf_counter()
         self._stats_completed = 0
         self._stats_occupancy: list[float] = []
+        #: Serializes _publish_stats: the consumer thread publishes after
+        #: every batch, but terminal FAILURES (shed/reject/expiry/engine-
+        #: failed) also publish from their own threads — during a total
+        #: outage nothing completes, and the availability burn gauge must
+        #: climb DURING the incident, not after the first post-recovery
+        #: batch. Non-force callers skip instead of blocking.
+        self._stats_lock = threading.Lock()
+
+        # ---- request-level observability (ISSUE 11) ------------------
+        # obs_cfg carries the obs.request_trace / exemplar_k / slo_*
+        # knobs; None (library users without an ObsConfig) = tracing off,
+        # default exemplars, SLO disabled. The stage stamps + histograms
+        # below are ALWAYS on: they are the serve_p50/p99 gauges' source.
+        self._obs_cfg = obs_cfg
+        slo_avail = float(getattr(obs_cfg, "slo_availability", 0.0) or 0.0)
+        slo_p99 = float(getattr(obs_cfg, "slo_target_p99_ms", 0.0) or 0.0)
+        slo_window = float(getattr(obs_cfg, "slo_window_s", 60.0))
+        slo_burn_thr = float(getattr(obs_cfg, "slo_burn_threshold", 2.0))
+        if not 0.0 <= slo_avail < 1.0:
+            raise ConfigError(
+                f"obs.slo_availability must be in [0, 1) (0 disables), "
+                f"got {slo_avail}")
+        if slo_p99 < 0 or slo_window <= 0 or slo_burn_thr <= 0:
+            raise ConfigError(
+                "obs.slo_target_p99_ms must be >= 0 and slo_window_s / "
+                f"slo_burn_threshold > 0, got {slo_p99}/{slo_window}/"
+                f"{slo_burn_thr}")
+        self._slo = (slo_avail, slo_p99, slo_window, slo_burn_thr)
+        self._slo_on = slo_avail > 0 or slo_p99 > 0
+        #: Terminal-outcome totals (cumulative; guarded by _pending_lock,
+        #: which both terminal paths already hold): the burn-rate window
+        #: diffs these.
+        self._term_total = 0
+        self._term_bad = 0
+        self._term_completed = 0
+        self._term_slow = 0
+        #: Rolling window of cumulative snapshots, one per stats publish,
+        #: SEEDED with an all-zero snapshot at construction: without it
+        #: the first publish's own append is the delta base (d == 0), so
+        #: a run — or an incident — that terminates entirely within the
+        #: first stats interval would never publish a burn rate at all.
+        # trace-buffer-ok: bounded ring (maxlen) of per-window snapshots
+        self._slo_win: deque[tuple] = deque(maxlen=4096)
+        self._slo_win.append((self._stats_t, 0, 0, 0, 0))
+        self._burn_alarm = False
+        # Request/batch serials: itertools.count.__next__ is atomic under
+        # CPython, so submit stays lock-free for the id.
+        self._rid = itertools.count(1)
+        self._batch_serial = 0          # dispatcher-thread-owned
+        # Per-stage histograms (obs/hist.py; the default fixed ms-bucket
+        # layout, so every engine's export merges exactly): attached to
+        # the registry for metrics.prom export, observed via these direct
+        # references off the registry lock.
+        self._hists = {
+            name: self._registry.attach_histogram(name, Histogram())
+            for name in ("serve_request_ms",
+                         *(f"serve_{s}_ms" for s in SERVE_STAGES))}
+        self._h_e2e = self._hists["serve_request_ms"]
+        #: End-to-end bucket counts at the last stats publish — the
+        #: per-window delta the p50/p99 gauges are quantiled over.
+        self._p50_prev_counts = self._h_e2e.snapshot()["counts"]
+        # Exemplars: top-K slowest of the current window (consumer-thread
+        # list, trimmed to K), folded per publish into a bounded ring.
+        self._exemplar_k = max(0, int(getattr(obs_cfg, "exemplar_k", 8)
+                                      if obs_cfg is not None else 8))
+        self._window_slowest: list[dict] = []
+        # trace-buffer-ok: bounded exemplar ring (maxlen = 4 windows of K)
+        self._exemplars: deque[dict] = deque(
+            maxlen=max(1, 4 * self._exemplar_k))
+        #: Guards _window_slowest/_exemplars: the consumer appends while
+        #: failure-path publishes fold the window from their own threads
+        #: and _supervise/cli snapshot the ring — an unlocked deque
+        #: iteration concurrent with extend() raises and would kill the
+        #: reading thread. Ordering: _stats_lock may take _ex_lock,
+        #: never the reverse.
+        self._ex_lock = threading.Lock()
+        #: Ring changed since the last serve_exemplars.json write (folds
+        #: with io_ok=False — failure-path publishes — defer the file IO
+        #: to the next consumer/stop publish).
+        self._ex_dirty = False
+        self._overload_flagged = False
+        # Per-request trace emission: cached tracer reference, None unless
+        # obs is enabled with the span trace + request_trace knob on — the
+        # zero-artifact default costs one attribute check per request.
+        tracer = getattr(obs, "tracer", None)
+        self._req_tracer = (
+            tracer if (obs is not None and getattr(obs, "enabled", False)
+                       and tracer is not None and tracer.enabled
+                       and (obs_cfg is None
+                            or getattr(obs_cfg, "request_trace", True)))
+            else None)
 
         self._dispatcher = threading.Thread(
             target=self._serve_loop, name="serve-dispatcher", daemon=True)
@@ -487,7 +682,7 @@ class ServeEngine:
         if deadline_ms is None:
             deadline_ms = self.cfg.default_deadline_ms
         req = _Request(session_id, np.asarray(obs, np.float32), callback,
-                       deadline_ms=deadline_ms)
+                       deadline_ms=deadline_ms, rid=next(self._rid))
         with self._pending_lock:
             self._pending += 1
         self._registry.inc("serve_requests_total")
@@ -535,6 +730,8 @@ class ServeEngine:
         total — a failed request must never strand :meth:`drain`."""
         with self._pending_lock:
             self._pending -= 1
+            self._term_total += 1
+            self._term_bad += 1
         req.error = exc
         req._event.set()
         if req.callback is not None:
@@ -542,6 +739,79 @@ class ServeEngine:
                 req.callback(None)
             except Exception:   # noqa: BLE001
                 log.exception("serve failure callback failed")
+        if isinstance(exc, ServeRejected):
+            outcome = exc.reason            # queue_full / shed_oldest / ...
+        elif isinstance(exc, ServeDeadlineExceeded):
+            outcome = "expired"
+        elif isinstance(exc, ServeEngineFailed):
+            outcome = "engine_failed"
+        else:
+            outcome = "failed"
+        self._trace_request(req, outcome, time.perf_counter())
+        # Terminal failures drive the stats cadence too: under a total
+        # outage (restart storm, flood of sheds) no batch ever completes,
+        # and the availability-burn gauge/alert must fire mid-incident.
+        # io_ok=False: this runs on the submit caller's or dispatcher's
+        # thread — the exemplar file write must not ride either.
+        self._publish_stats(io_ok=False)
+
+    #: Request-flow lanes: request spans render on synthetic tids (one of
+    #: 64 lanes by request id) so overlapping lifecycles draw as parallel
+    #: tracks in Perfetto, with the envelope span time-containing its
+    #: stage children (track-local nesting). Base offset keeps lanes away
+    #: from real thread ids.
+    _TRACE_LANE_BASE = 1_000_000
+    _TRACE_LANES = 64
+
+    def _trace_request(self, req: _Request, outcome: str,
+                       t_end: float, lines: list[str] | None = None
+                       ) -> None:
+        """Emit the request's whole lifecycle — one ``serve_request``
+        envelope plus one child span per stamped stage, keyed by
+        request/batch/session ids in the args — called exactly once per
+        terminal outcome, from whichever thread discovered it. The events
+        are PRE-SERIALIZED f-string lines (per-event ``json.dumps`` on
+        the completion thread measured ~40 µs/request — a 3x throughput
+        tax at CPU-MLP request costs); ``lines`` (the batch-completion
+        path) accumulates them for ONE bulk tracer append per batch.
+        No-op (one attribute check) when request tracing is off."""
+        tracer = self._req_tracer
+        if tracer is None:
+            return
+        tr = req.trace
+        tr.outcome = outcome
+        to_us = tracer.to_us
+        pid = tracer.pid
+        lane = self._TRACE_LANE_BASE + tr.rid % self._TRACE_LANES
+        ts0 = to_us(tr.t_enq)
+        sid = req.session_id
+        session = (f'"{sid}"' if type(sid) is str and _SID_SAFE(sid)
+                   else json.dumps(str(sid)))
+        own = lines is None
+        if own:
+            lines = []
+        lines.append(
+            f'{{"name":"serve_request","cat":"serve","ph":"X",'
+            f'"ts":{ts0:.3f},"dur":{to_us(t_end) - ts0:.3f},'
+            f'"pid":{pid},"tid":{lane},"args":{{"request":{tr.rid},'
+            f'"session":{session},"outcome":"{outcome}",'
+            f'"batch":{tr.batch if tr.batch is not None else 0},'
+            f'"cold":{"true" if tr.cold else "false"},'
+            f'"deferrals":{tr.deferrals}}}}}')
+        for name, t0, t1 in (("queue_wait", tr.t_enq, tr.t_collected),
+                             ("batch_wait", tr.t_collected,
+                              tr.t_dispatched),
+                             ("device", tr.t_dispatched, tr.t_device),
+                             ("readback", tr.t_device, tr.t_done)):
+            if t0 is not None and t1 is not None:
+                za = to_us(t0)
+                lines.append(
+                    f'{{"name":"{name}","cat":"serve","ph":"X",'
+                    f'"ts":{za:.3f},"dur":{to_us(t1) - za:.3f},'
+                    f'"pid":{pid},"tid":{lane},'
+                    f'"args":{{"request":{tr.rid}}}}}')
+        if own:
+            tracer.emit_lines(lines)
 
     @property
     def params_step(self) -> int:
@@ -642,10 +912,6 @@ class ServeEngine:
                 ok = False
         self._publish_stats(force=True)
         return ok
-
-    def latencies_ms(self) -> list[float]:
-        """Snapshot of the per-request latency ring (percentile source)."""
-        return list(self._lat)
 
     # -- dispatcher thread ------------------------------------------------
 
@@ -759,6 +1025,13 @@ class ServeEngine:
                 self._enter_failed(exc)
                 return
             self._registry.inc("serve_restarts_total")
+            if self._obs is not None:
+                # Forensics for the eventual bundle: which restart, why,
+                # and what the tail looked like going in (flight-ring
+                # append — gated off internally when the recorder is off).
+                self._obs.record("serve_restart", streak=streak,
+                                 error=repr(exc),
+                                 exemplars=self.exemplars()[:4])
             self._backoff_sleep(streak)
             try:
                 self._build_arena_and_programs()
@@ -802,6 +1075,14 @@ class ServeEngine:
             "exceeded serve.max_restarts=%d (last: %r); failing all "
             "queued work", self._restart_streak, self.cfg.max_restarts,
             exc)
+        if self._obs is not None and getattr(self._obs, "enabled", False):
+            # The serve-side black box: the terminal corpse dumps the
+            # flight ring (restart trail, overload exemplars, WARNING+
+            # logs) plus the current slowest-request exemplars.
+            self._obs.record("serve_exemplars",
+                             exemplars=self.exemplars()[:8])
+            self._obs.dump_flight(reason="serve_failed", error=repr(exc),
+                                  restart_streak=self._restart_streak)
         self._drain_failed()
 
     def _drain_failed(self) -> None:
@@ -846,15 +1127,18 @@ class ServeEngine:
         cfg = self.cfg
         batch: list[_Request] = []
         seen: set = set()
-        kept: deque[_Request] = deque()
+        kept: deque[_Request] = deque()  # trace-buffer-ok: re-queued subset
+        # of _deferred, which _collect_batch bounds at cfg.max_queue
         now = time.perf_counter()
         while self._deferred:
             req = self._deferred.popleft()
             if self._expire_if_dead(req, now):
                 continue
             if req.session_id in seen or len(batch) >= cfg.max_batch:
+                req.trace.deferrals += 1
                 kept.append(req)
             else:
+                req.trace.t_collected = now
                 batch.append(req)
                 seen.add(req.session_id)
         self._deferred = kept
@@ -865,6 +1149,7 @@ class ServeEngine:
                 return []
             if self._expire_if_dead(req, time.perf_counter()):
                 return []
+            req.trace.t_collected = time.perf_counter()
             batch.append(req)
             seen.add(req.session_id)
         deadline = time.perf_counter() + cfg.batch_timeout_ms / 1e3
@@ -898,6 +1183,7 @@ class ServeEngine:
                             "shed from the same-session backlog under "
                             "overload (shed_policy='oldest')",
                             reason="shed_oldest"))
+                        req.trace.deferrals += 1
                         self._deferred.append(req)
                     else:
                         self._registry.inc("serve_queue_rejected_total")
@@ -905,8 +1191,10 @@ class ServeEngine:
                             "same-session backlog exceeded "
                             "serve.max_queue", reason="deferred_overflow"))
                     continue
+                req.trace.deferrals += 1
                 self._deferred.append(req)
             else:
+                req.trace.t_collected = time.perf_counter()
                 batch.append(req)
                 seen.add(req.session_id)
                 if (req.t_deadline is not None
@@ -942,15 +1230,30 @@ class ServeEngine:
         # a later failure (the warm group's _pad raising after the cold
         # program already consumed the buffer) would leave the field
         # pointing at a deleted array and wedge every future tick.
+        self._batch_serial += 1         # dispatcher-thread-owned serial
+        bid = self._batch_serial
+
+        def _stamp(reqs: list[_Request], cold: bool) -> None:
+            # Dispatch edge: the jit call below returns asynchronously, so
+            # this stamp marks "handed to the device", and the device
+            # stage absorbs compute + queueing behind earlier programs.
+            t = time.perf_counter()
+            for req in reqs:
+                req.trace.t_dispatched = t
+                req.trace.batch = bid
+                req.trace.cold = cold
+
         groups: list[tuple[list[_Request], Any, Any, Any]] = []
         if self._episode:
             if cold_reqs:
                 obs, idx = self._pad(cold_reqs, cold_idx)
+                _stamp(cold_reqs, True)
                 act, logit, val, self._pool = self._cold_fn(
                     live.params, self._pool, obs, idx)
                 groups.append((cold_reqs, act, logit, val))
             if warm_reqs:
                 obs, idx = self._pad(warm_reqs, warm_idx)
+                _stamp(warm_reqs, False)
                 act, logit, val, self._pool = self._warm_fn(
                     live.params, self._pool, obs, idx)
                 groups.append((warm_reqs, act, logit, val))
@@ -959,6 +1262,9 @@ class ServeEngine:
             cold_mask = np.zeros((self.cfg.max_batch,), bool)
             cold_mask[:len(cold_reqs)] = True
             obs, idx = self._pad(reqs, cold_idx + warm_idx)
+            _stamp(reqs, False)
+            for req in cold_reqs:
+                req.trace.cold = True
             act, logit, val, self._pool = self._step_fn(
                 live.params, self._pool, obs, idx, cold_mask)
             groups.append((reqs, act, logit, val))
@@ -1033,11 +1339,19 @@ class ServeEngine:
                         continue
                     req.error = exc
                     req._event.set()
+                    with self._pending_lock:
+                        # Pending was already decremented by the batch-
+                        # level finally; only the SLO outcome accounting
+                        # is per-request here.
+                        self._term_total += 1
+                        self._term_bad += 1
                     if req.callback is not None:
                         try:
                             req.callback(None)
                         except Exception:   # noqa: BLE001
                             log.exception("serve failure callback failed")
+                    self._trace_request(req, "failed",
+                                        time.perf_counter())
             # A consumer fault is an ENGINE fault for the supervisor:
             # the readback path may hold poisoned device buffers, so ask
             # the dispatcher to run the restart/backoff contract (no-op
@@ -1055,6 +1369,13 @@ class ServeEngine:
         pending count decrements in a finally so a mid-completion fault
         (handled by :meth:`_complete_loop`) can never strand
         :meth:`drain`."""
+        n_done = slow = 0
+        slo_target = self._slo[1]
+        hists = self._hists
+        # Batch-level trace buffer: one bulk tracer append per completed
+        # batch instead of one lock round-trip per request.
+        trace_lines: list[str] | None = (
+            [] if self._req_tracer is not None else None)
         try:
             for reqs, act_dev, logit_dev, val_dev in done.groups:
                 # serve-host-ok: consumer-side readback — the dispatcher
@@ -1062,25 +1383,81 @@ class ServeEngine:
                 actions, logits, values = jax.device_get(
                     (act_dev, logit_dev, val_dev))
                 now = time.perf_counter()
+                # The consumer serializes a batch's completions, so the
+                # readback HISTOGRAM charges each request only its own
+                # completion slice (t_prev→t_done): billing t_done minus
+                # the group readback stamp would blame every request for
+                # its earlier batch-mates' callbacks and regress the
+                # serve_readback_p99_ms gate row as occupancy rises. The
+                # trace's readback child span keeps the client-observable
+                # t_device→t_done wait.
+                t_prev = now
                 for i, req in enumerate(reqs):
+                    tr = req.trace
+                    tr.t_device = now
+                    # Telescoping stage decomposition: the three stages
+                    # share their interior stamps, so their sum IS the
+                    # end-to-end latency (the soak-asserted invariant).
+                    # The None-guards are defensive only — every request
+                    # that reaches here was collected and dispatched — a
+                    # missing stamp must degrade one request's breakdown,
+                    # never fail the whole batch on this thread.
+                    t_coll = tr.t_collected or tr.t_enq
+                    t_disp = tr.t_dispatched or t_coll
+                    latency_ms = (now - req.t_enq) * 1e3
+                    stages = {
+                        "queue_wait_ms": (t_coll - tr.t_enq) * 1e3,
+                        "batch_wait_ms": (t_disp - t_coll) * 1e3,
+                        "device_ms": (now - t_disp) * 1e3,
+                    }
                     result = ServeResult(
                         session_id=req.session_id,
                         action=int(actions[i]),
                         logits=logits[i],
                         value=float(values[i]),
                         params_step=done.step,
-                        latency_ms=(now - req.t_enq) * 1e3)
+                        latency_ms=latency_ms,
+                        stages=stages)
                     req.result = result
                     req._event.set()
-                    self._lat.append(result.latency_ms)
                     if req.callback is not None:
                         try:
                             req.callback(result)
                         except Exception:   # noqa: BLE001
                             log.exception("serve result callback failed")
+                    tr.t_done = time.perf_counter()
+                    hists["serve_queue_wait_ms"].observe(
+                        stages["queue_wait_ms"])
+                    hists["serve_batch_wait_ms"].observe(
+                        stages["batch_wait_ms"])
+                    hists["serve_device_ms"].observe(stages["device_ms"])
+                    hists["serve_readback_ms"].observe(
+                        (tr.t_done - t_prev) * 1e3)
+                    t_prev = tr.t_done
+                    self._h_e2e.observe(latency_ms)
+                    if abs(sum(stages.values()) - latency_ms) > 1e-6:
+                        # Structural self-check: the decomposition is
+                        # exact by construction, so any drift means a
+                        # refactor broke a stamp — the soaks assert this
+                        # counter stays 0.
+                        self._registry.inc(
+                            "serve_trace_decomposition_error_total")
+                    if slo_target and latency_ms > slo_target:
+                        slow += 1
+                    n_done += 1
+                    if self._exemplar_k:
+                        self._note_exemplar(req, latency_ms, stages,
+                                            done.step)
+                    self._trace_request(req, "completed", tr.t_done,
+                                        lines=trace_lines)
         finally:
+            if trace_lines:
+                self._req_tracer.emit_lines(trace_lines)
             with self._pending_lock:
                 self._pending -= done.n
+                self._term_total += n_done
+                self._term_completed += n_done
+                self._term_slow += slow
         # A completed batch heals the supervisor's consecutive-fault
         # streak (mirrors the training loop's restart accounting) — but
         # ONLY a batch dispatched after the latest fault: pre-fault
@@ -1089,8 +1466,11 @@ class ServeEngine:
         with self._sup_lock:
             if done.epoch == self._fault_epoch:
                 self._restart_streak = 0
-        self._stats_completed += done.n
-        self._stats_occupancy.append(done.n / self.cfg.max_batch)
+        with self._pending_lock:
+            # Locked: failure-path publishes snapshot-and-reset these
+            # from other threads (the qps/occupancy window).
+            self._stats_completed += done.n
+            self._stats_occupancy.append(done.n / self.cfg.max_batch)
         reg = self._registry
         reg.inc("serve_responses_total", done.n)
         reg.inc("serve_batches_total")
@@ -1100,9 +1480,64 @@ class ServeEngine:
             reg.inc("serve_evictions_total", done.evicted)
         self._publish_stats()
 
-    def _publish_stats(self, *, force: bool = False) -> None:
-        """SLO gauges at ``stats_interval_s`` cadence (consumer thread)."""
+    def _note_exemplar(self, req: _Request, latency_ms: float,
+                       stages: dict, step: int) -> None:
+        """Track the window's K slowest completed requests with their full
+        stage breakdown (consumer thread; K is small, so the min-replace
+        scan is a handful of comparisons)."""
+        tr = req.trace
+        with self._ex_lock:
+            w = self._window_slowest
+            if len(w) >= self._exemplar_k:
+                m = min(range(len(w)), key=lambda j: w[j]["latency_ms"])
+                if latency_ms <= w[m]["latency_ms"]:
+                    return
+                del w[m]
+            w.append({
+                "session": str(req.session_id),
+                "latency_ms": round(latency_ms, 3),
+                "stages": {k: round(v, 3) for k, v in stages.items()},
+                "batch": tr.batch,
+                "cold": tr.cold,
+                "deferrals": tr.deferrals,
+                "params_step": step,
+            })
+
+    def exemplars(self) -> list[dict]:
+        """The slowest-request exemplar ring (recent windows' top-K plus
+        the in-progress window), slowest first — the ``cli serve`` summary
+        and flight-recorder payload. Safe from any thread."""
+        with self._ex_lock:
+            merged = list(self._exemplars) + list(self._window_slowest)
+        return sorted(merged, key=lambda e: -e["latency_ms"])
+
+    def _publish_stats(self, *, force: bool = False,
+                       io_ok: bool = True) -> None:
+        """SLO gauges at ``stats_interval_s`` cadence. Callers: the
+        consumer thread (every completed batch), terminal-failure paths
+        (any thread — see ``_stats_lock``; they pass ``io_ok=False`` so
+        the never-blocks submit/dispatcher contract survives the exemplar
+        file write), and ``stop`` (force). A non-force caller that loses
+        the lock race simply skips: someone else is publishing this
+        window."""
         now = time.perf_counter()
+        if not force and now - self._stats_t < self.cfg.stats_interval_s:
+            return
+        if not self._stats_lock.acquire(blocking=force):
+            return
+        try:
+            if force:
+                # Re-anchor past any publish that won the lock while we
+                # blocked: a stale `now` would read as interval <= 0 and
+                # silently skip the FINAL gauges (and any deferred
+                # exemplar-file write) stop() exists to flush.
+                now = time.perf_counter()
+            self._publish_stats_locked(now, force, io_ok)
+        finally:
+            self._stats_lock.release()
+
+    def _publish_stats_locked(self, now: float, force: bool,
+                              io_ok: bool) -> None:
         interval = now - self._stats_t
         if not force and interval < self.cfg.stats_interval_s:
             return
@@ -1111,23 +1546,132 @@ class ServeEngine:
         with self._pending_lock:
             overload_events = self._overload_events
             self._overload_events = 0
+            term = (self._term_total, self._term_bad,
+                    self._term_completed, self._term_slow)
+            completed = self._stats_completed
+            occupancy = self._stats_occupancy
+            self._stats_completed = 0
+            self._stats_occupancy = []
         depth = self._q.qsize()
+        overloaded = (overload_events > 0
+                      or depth >= self.cfg.max_queue)
         row: dict[str, float] = {
-            "serve_qps": self._stats_completed / interval,
+            "serve_qps": completed / interval,
             "serve_queue_depth": float(depth),
             # Overload gauge: 1 while the engine is shedding/rejecting or
             # the ingress queue is pinned at its bound, else 0.
-            "serve_overload": float(overload_events > 0
-                                    or depth >= self.cfg.max_queue),
+            "serve_overload": float(overloaded),
         }
-        if self._lat:
-            pct = latency_percentiles(list(self._lat))
-            row["serve_p50_ms"] = pct["p50_ms"]
-            row["serve_p99_ms"] = pct["p99_ms"]
-        if self._stats_occupancy:
+        # p50/p99 from the end-to-end histogram's per-window bucket DELTA
+        # (cumulative counts subtract exactly — the same bucket math a
+        # fleet router uses to merge engines): every completed request in
+        # the window counts, where the old bounded sample ring silently
+        # forgot overflow under load.
+        snap = self._h_e2e.snapshot()
+        delta = [a - b for a, b in zip(snap["counts"],
+                                       self._p50_prev_counts)]
+        self._p50_prev_counts = snap["counts"]
+        if sum(delta) > 0:
+            row["serve_p50_ms"] = self._h_e2e.quantile(0.50, counts=delta)
+            row["serve_p99_ms"] = self._h_e2e.quantile(0.99, counts=delta)
+        if occupancy:
             row["serve_batch_occupancy"] = (
-                sum(self._stats_occupancy) / len(self._stats_occupancy))
+                sum(occupancy) / len(occupancy))
+        row.update(self._slo_burn(now, term))
         self._registry.record_many(row)
+        self._fold_exemplars(overloaded, io_ok)
         self._stats_t = now
-        self._stats_completed = 0
-        self._stats_occupancy = []
+
+    def _slo_burn(self, now: float, term: tuple) -> dict[str, float]:
+        """Rolling error-budget burn rates over ``obs.slo_window_s``: the
+        window is the difference of cumulative terminal-outcome counts
+        between now and the oldest in-window publish snapshot. Burn 1.0 =
+        spending exactly the SLO's error budget; crossing
+        ``obs.slo_burn_threshold`` records a flight event (with the
+        current exemplars) and a trace instant, re-arming only after the
+        burn halves (hysteresis)."""
+        if not self._slo_on:
+            return {}
+        avail, target_p99, window_s, threshold = self._slo
+        win = self._slo_win
+        win.append((now, *term))
+        # Prune to the NEWEST snapshot at-or-before the window edge: that
+        # snapshot is the delta base, so popping it whenever it merely
+        # predates the edge would (a) silently exclude every event between
+        # the edge and the next snapshot and (b) collapse the delta to
+        # zero outright whenever the publish interval reaches window_s
+        # (base == the just-appended snapshot). When publishes are sparser
+        # than the window, the window degrades to one publish interval —
+        # the honest reading, never a frozen gauge.
+        while len(win) > 1 and win[1][0] <= now - window_s:
+            win.popleft()
+        base = win[0]
+        d_total = term[0] - base[1]
+        d_bad = term[1] - base[2]
+        d_completed = term[2] - base[3]
+        d_slow = term[3] - base[4]
+        out: dict[str, float] = {}
+        burns: dict[str, float] = {}
+        if avail > 0 and d_total > 0:
+            burns["availability"] = (d_bad / d_total) / (1.0 - avail)
+            out["serve_slo_availability_burn"] = burns["availability"]
+        if target_p99 > 0 and d_completed > 0:
+            burns["latency"] = (d_slow / d_completed) / 0.01
+            out["serve_slo_latency_burn"] = burns["latency"]
+        worst = max(burns.values(), default=0.0)
+        if worst >= threshold and not self._burn_alarm:
+            self._burn_alarm = True
+            self._registry.inc("serve_slo_burn_alerts_total")
+            log.warning(
+                "SLO burn rate %.2f crossed threshold %.2f "
+                "(window %ds: %d/%d bad, %d/%d slow)", worst, threshold,
+                int(window_s), d_bad, d_total, d_slow, d_completed)
+            if self._obs is not None:
+                self._obs.record(
+                    "slo_burn", burns=burns, threshold=threshold,
+                    window_s=window_s, bad=d_bad, total=d_total,
+                    slow=d_slow, completed=d_completed,
+                    exemplars=self.exemplars()[:4])
+                self._obs.tracer.instant("serve_slo_burn", **burns)
+        elif self._burn_alarm and worst < 0.5 * threshold:
+            self._burn_alarm = False
+        return out
+
+    def _fold_exemplars(self, overloaded: bool, io_ok: bool) -> None:
+        """End of a stats window: fold the window's top-K slowest into the
+        bounded exemplar ring; on overload ONSET record them into the
+        flight ring (the forensic payload for "why was the tail slow when
+        shedding started"); write the ring to ``serve_exemplars.json`` in
+        the obs run dir when obs is on. ``io_ok=False`` (failure-path
+        publishes on submit/dispatcher threads) defers the file write —
+        the fold still happens and ``_ex_dirty`` carries the debt to the
+        next consumer/stop publish."""
+        with self._ex_lock:
+            if self._window_slowest:
+                self._exemplars.extend(
+                    sorted(self._window_slowest,
+                           key=lambda e: -e["latency_ms"]))
+                self._window_slowest = []
+                self._ex_dirty = True
+        obs = self._obs
+        if obs is None or not getattr(obs, "enabled", False):
+            self._overload_flagged = overloaded
+            return
+        if overloaded and not self._overload_flagged:
+            obs.record("serve_overload_exemplars",
+                       exemplars=self.exemplars()[:8])
+        self._overload_flagged = overloaded
+        run_dir = getattr(obs, "run_dir", None)
+        # Rewrite the file only when the ring actually changed: a publish
+        # with no new window exemplars (idle engine, outage-driven stats
+        # ticks) must not pay write+rename on a request-path thread.
+        if run_dir and io_ok and self._ex_dirty:
+            try:
+                path = os.path.join(run_dir, "serve_exemplars.json")
+                tmp = f"{path}.tmp-{os.getpid()}"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump({"exemplars": self.exemplars()}, f)
+                os.replace(tmp, path)
+                self._ex_dirty = False
+            except OSError:
+                log.exception("serve exemplar export failed")

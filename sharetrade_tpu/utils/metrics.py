@@ -13,7 +13,13 @@ Two kinds of values:
   the host heap without limit, short runs never reach the cap);
 - **counters** (``inc``/``counters``) — monotonic totals (``restarts_total``,
   ``heals_total``, ...), the Prometheus-counter half of the obs exporter's
-  output.
+  output;
+- **histograms** (``attach_histogram``/``histograms``) — fixed-bucket
+  mergeable distributions (obs/hist.py) owned and observed by their
+  producers (the serve engine's per-stage latencies, the orchestrator's
+  chunk timings); the registry only registers them for export, so the
+  per-sample hot path never takes the registry lock. Duck-typed (anything
+  with ``snapshot()``) so this module needs no obs import.
 """
 
 from __future__ import annotations
@@ -39,6 +45,7 @@ class MetricsRegistry:
             self._new_series)
         self._latest: dict[str, float] = {}
         self._counters: dict[str, float] = {}
+        self._histograms: dict[str, Any] = {}
 
     def _new_series(self) -> deque:
         return deque(maxlen=self._maxlen)
@@ -73,6 +80,29 @@ class MetricsRegistry:
     def counters(self) -> dict[str, float]:
         with self._lock:
             return dict(self._counters)
+
+    # ---- histograms (obs/hist.py, duck-typed) ----
+
+    def attach_histogram(self, name: str, hist: Any) -> Any:
+        """Register a histogram for export under ``name`` (idempotent for
+        the same object; re-attaching a DIFFERENT object replaces it — the
+        supervised-rebuild path). The producer keeps the reference and
+        observes into it directly, off the registry lock."""
+        with self._lock:
+            self._histograms[name] = hist
+        return hist
+
+    def histogram(self, name: str) -> Any | None:
+        """The live attached histogram object (None when absent)."""
+        with self._lock:
+            return self._histograms.get(name)
+
+    def histograms(self) -> dict[str, dict]:
+        """{name: snapshot} over every attached histogram — the exporter's
+        drain unit (snapshots are consistent copies; see obs/hist.py)."""
+        with self._lock:
+            items = list(self._histograms.items())
+        return {name: h.snapshot() for name, h in items}
 
     # ---- reads ----
 

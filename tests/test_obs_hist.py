@@ -1,0 +1,206 @@
+"""Mergeable histograms (obs/hist.py) + exposition-format export.
+
+The ISSUE-11 contracts pinned here:
+
+- **exact merge**: bucket-wise addition of shard histograms equals the
+  histogram of the concatenated sample (the fleet aggregation contract —
+  no approximation introduced by the merge itself);
+- **bounded-error quantiles**: a histogram quantile is within ONE bucket
+  width of the exact nearest-rank sample quantile, under the repo's
+  single quantile convention (``serve/engine.py latency_percentiles``,
+  whose floored-rank p99 bias this PR fixed);
+- **valid exposition output**: ``metrics.prom`` parses under a STRICT
+  reader — gauges, counters, and histogram ``_bucket``(cumulative,
+  ``le``-labeled, ``+Inf``-terminated)/``_sum``/``_count`` triples.
+"""
+
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from sharetrade_tpu.obs.exporter import (
+    MetricsExporter,
+    PromParseError,
+    parse_prom_text,
+)
+from sharetrade_tpu.obs.hist import (
+    DEFAULT_MS_BOUNDS,
+    Histogram,
+    log_bounds,
+    merge,
+    quantile_from_snapshot,
+)
+from sharetrade_tpu.serve.engine import latency_percentiles
+from sharetrade_tpu.utils.metrics import MetricsRegistry
+
+
+class TestBounds:
+    def test_log_bounds_deterministic_and_ascending(self):
+        a = log_bounds(0.01, 1e5, per_decade=5)
+        b = log_bounds(0.01, 1e5, per_decade=5)
+        assert a == b                       # bit-identical across calls
+        assert all(y > x for x, y in zip(a, a[1:]))
+        assert a == DEFAULT_MS_BOUNDS
+        assert a[0] <= 0.0100000001 and a[-1] >= 1e5
+
+    def test_bad_specs_raise(self):
+        with pytest.raises(ValueError):
+            log_bounds(0.0, 10.0)
+        with pytest.raises(ValueError):
+            log_bounds(10.0, 1.0)
+        with pytest.raises(ValueError):
+            Histogram(bounds=(1.0, 1.0, 2.0))
+        with pytest.raises(ValueError):
+            Histogram(bounds=())
+
+
+class TestHistogram:
+    def test_le_bucket_semantics(self):
+        h = Histogram(bounds=(1.0, 10.0, 100.0))
+        for v in (0.5, 1.0, 1.0001, 100.0, 1000.0):
+            h.observe(v)
+        # value <= bound (Prometheus le): 1.0 lands in the first bucket,
+        # 1.0001 in the second, 1000 in the overflow slot.
+        assert h.counts == [2, 1, 1, 1]
+        assert h.count == 5
+        assert h.sum == pytest.approx(0.5 + 1.0 + 1.0001 + 100.0 + 1000.0)
+
+    def test_merge_property_exact(self):
+        """Merge of shards == histogram of the concatenation, EXACTLY —
+        counts, count, and (integer-valued samples, so float addition is
+        exact) sum."""
+        rng = random.Random(7)
+        shards = []
+        everything = []
+        for _ in range(5):
+            h = Histogram()
+            vals = [float(rng.randrange(0, 200_000))
+                    for _ in range(rng.randrange(0, 400))]
+            for v in vals:
+                h.observe(v)
+            shards.append(h)
+            everything.extend(vals)
+        merged = merge(shards)
+        reference = Histogram()
+        for v in everything:
+            reference.observe(v)
+        assert merged.snapshot()["counts"] == reference.snapshot()["counts"]
+        assert merged.count == reference.count == len(everything)
+        assert merged.sum == reference.sum
+
+    def test_merge_refuses_mismatched_layouts(self):
+        with pytest.raises(ValueError, match="different bucket bounds"):
+            Histogram(bounds=(1.0, 2.0)).merge(Histogram(bounds=(1.0, 3.0)))
+
+    def test_quantile_within_one_bucket_width_of_exact(self):
+        """The histogram estimate vs the exact nearest-rank quantile
+        (latency_percentiles — ONE convention serve-wide), within the
+        width of the bucket holding the exact value."""
+        rng = np.random.default_rng(3)
+        values = np.exp(rng.normal(1.5, 1.2, size=2000)).astype(np.float64)
+        h = Histogram()
+        for v in values:
+            h.observe(float(v))
+        exact = latency_percentiles(values)
+        for q, key in ((0.50, "p50_ms"), (0.99, "p99_ms")):
+            est = h.quantile(q)
+            bounds = h.bounds
+            idx = next(i for i, b in enumerate(bounds) if exact[key] <= b)
+            lo = bounds[idx - 1] if idx else 0.0
+            width = bounds[idx] - lo
+            assert abs(est - exact[key]) <= width, (
+                f"q={q}: estimate {est} vs exact {exact[key]} "
+                f"(bucket width {width})")
+
+    def test_window_delta_equals_interval_histogram(self):
+        """Cumulative snapshots subtract into the exact histogram of the
+        interval — the serve engine's rolling-gauge mechanism."""
+        h = Histogram()
+        first = [1.0, 5.0, 40.0]
+        second = [2.0, 300.0, 7.0, 0.02]
+        for v in first:
+            h.observe(v)
+        snap0 = h.snapshot()["counts"]
+        for v in second:
+            h.observe(v)
+        delta = [a - b for a, b in zip(h.snapshot()["counts"], snap0)]
+        ref = Histogram()
+        for v in second:
+            ref.observe(v)
+        assert delta == ref.snapshot()["counts"]
+
+    def test_nearest_rank_percentile_fix(self):
+        """The satellite bugfix: ceil-rank nearest-rank, not the floored
+        ``int(q*(n-1))`` that reported p90 as "p99" at n=10."""
+        vals = [float(v) for v in range(1, 11)]
+        pct = latency_percentiles(vals)
+        assert pct["p50_ms"] == 5.0         # ceil(0.5*10) = rank 5
+        assert pct["p99_ms"] == 10.0        # ceil(0.99*10) = rank 10 (max)
+        assert latency_percentiles([3.25])["p99_ms"] == 3.25
+        assert latency_percentiles([])["p99_ms"] == 0.0
+
+
+class TestRegistryAndExporter:
+    def test_attached_histograms_export_and_parse_strictly(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.record("portfolio_mean", 2400.5)
+        reg.inc("restarts_total", 2)
+        h = reg.attach_histogram("serve_queue_wait_ms", Histogram())
+        for v in (0.5, 3.0, 3.0, 77.0, 1e9):    # 1e9 = overflow bucket
+            h.observe(v)
+        exporter = MetricsExporter(reg, str(tmp_path), interval_s=60)
+        assert exporter.drain()
+        prom_text = (tmp_path / "metrics.prom").read_text()
+        parsed = parse_prom_text(prom_text)     # STRICT — raises on bad
+        assert parsed["gauges"]["sharetrade_portfolio_mean"] == 2400.5
+        assert parsed["counters"]["sharetrade_restarts_total"] == 2.0
+        hist = parsed["histograms"]["sharetrade_serve_queue_wait_ms"]
+        assert hist["count"] == 5.0
+        assert hist["buckets"][-1] == ("+Inf", 5)
+        cums = [c for _, c in hist["buckets"]]
+        assert cums == sorted(cums)             # cumulative, nondecreasing
+        assert hist["sum"] == pytest.approx(0.5 + 3.0 + 3.0 + 77.0 + 1e9)
+        # ... and the JSONL history carries the raw snapshot the
+        # summarizer re-quantiles.
+        lines = [json.loads(ln) for ln in
+                 (tmp_path / "metrics.jsonl").read_text().splitlines()]
+        snap = lines[-1]["histograms"]["serve_queue_wait_ms"]
+        assert snap["count"] == 5
+        assert quantile_from_snapshot(snap, 0.5) > 0
+
+    def test_histogram_changes_trigger_redrain(self, tmp_path):
+        reg = MetricsRegistry()
+        h = reg.attach_histogram("h_ms", Histogram())
+        exporter = MetricsExporter(reg, str(tmp_path), interval_s=60)
+        assert exporter.drain()
+        assert not exporter.drain()             # nothing changed
+        h.observe(1.0)
+        assert exporter.drain()                 # histogram delta counts
+
+    def test_strict_parser_rejections(self):
+        ok = "# TYPE m gauge\nm 1.0\n"
+        assert parse_prom_text(ok)["gauges"]["m"] == 1.0
+        with pytest.raises(PromParseError, match="no preceding TYPE"):
+            parse_prom_text("m 1.0\n")
+        with pytest.raises(PromParseError, match="non-float"):
+            parse_prom_text("# TYPE m gauge\nm abc\n")
+        with pytest.raises(PromParseError, match="negative counter"):
+            parse_prom_text("# TYPE c counter\nc -1\n")
+        with pytest.raises(PromParseError, match="not cumulative"):
+            parse_prom_text(
+                '# TYPE h histogram\nh_bucket{le="1"} 5\n'
+                'h_bucket{le="2"} 3\nh_bucket{le="+Inf"} 5\n'
+                "h_sum 1\nh_count 5\n")
+        with pytest.raises(PromParseError, match=r"\+Inf"):
+            parse_prom_text(
+                '# TYPE h histogram\nh_bucket{le="1"} 5\n'
+                "h_sum 1\nh_count 5\n")
+        with pytest.raises(PromParseError, match="!= _count"):
+            parse_prom_text(
+                '# TYPE h histogram\nh_bucket{le="+Inf"} 4\n'
+                "h_sum 1\nh_count 5\n")
+        with pytest.raises(PromParseError, match="malformed sample"):
+            parse_prom_text("# TYPE m gauge\n3m&bad 1.0\n")

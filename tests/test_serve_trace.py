@@ -1,0 +1,418 @@
+"""Per-request serve observability (ISSUE 11): stage-stamped request
+traces, histogram-sourced SLO gauges, exemplars, and burn-rate
+monitoring.
+
+Acceptance contracts pinned here:
+
+- with tracing on, EVERY terminal request — completed, shed, expired,
+  failed — emits a ``serve_request`` trace span carrying its outcome,
+  and a completed request's stage spans sum to its end-to-end latency
+  (the telescoping-stamp invariant, also self-checked by the engine's
+  ``serve_trace_decomposition_error_total`` counter, which the soaks
+  assert stays 0);
+- ``serve_p50_ms``/``serve_p99_ms`` gauges now come from the mergeable
+  end-to-end histogram (per-window bucket deltas);
+- exemplar ring bounded at ``obs.exemplar_k`` per window, stage
+  breakdown included, exported to ``serve_exemplars.json``;
+- SLO burn gauges + a flight-ring event on threshold crossing;
+- obs off ⇒ zero artifacts; ``obs.request_trace=false`` ⇒ histograms
+  and exemplars but no per-request spans;
+- lint check 11 (bounded trace buffers) clean on the tree.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from sharetrade_tpu.config import (
+    ConfigError,
+    FrameworkConfig,
+    ModelConfig,
+    ServeConfig,
+)
+from sharetrade_tpu.models import build_model
+from sharetrade_tpu.obs import build_obs, read_trace, summarize_run_dir
+from sharetrade_tpu.serve.engine import (
+    ServeDeadlineExceeded,
+    ServeEngine,
+    ServeRejected,
+)
+from sharetrade_tpu.utils.metrics import MetricsRegistry
+
+OBS_DIM = 10
+
+
+@pytest.fixture(scope="module")
+def mlp_bundle():
+    model = build_model(ModelConfig(kind="mlp", hidden_dim=8), OBS_DIM,
+                        head="ac")
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def obs_for(i: float = 10.0) -> np.ndarray:
+    return np.full((OBS_DIM,), i, np.float32)
+
+
+def make_cfg(tmp_path, **obs_overrides):
+    cfg = FrameworkConfig()
+    cfg.obs.enabled = True
+    cfg.obs.dir = str(tmp_path / "obs")
+    cfg.obs.export_interval_s = 0.1
+    for key, value in obs_overrides.items():
+        setattr(cfg.obs, key, value)
+    cfg.serve = ServeConfig(max_batch=4, slots=8, batch_timeout_ms=1.0,
+                            swap_poll_s=0.0, stats_interval_s=0.1)
+    return cfg
+
+
+def build_engine(tmp_path, mlp_bundle, *, serve_cfg=None, **obs_overrides):
+    model, params = mlp_bundle
+    cfg = make_cfg(tmp_path, **obs_overrides)
+    if serve_cfg is not None:
+        cfg.serve = serve_cfg
+    registry = MetricsRegistry()
+    obs = build_obs(cfg, registry)
+    engine = ServeEngine(model, cfg.serve, params, registry=registry,
+                         obs=obs, obs_cfg=cfg.obs)
+    engine.warmup()
+    return engine, registry, obs, cfg
+
+
+class TestRequestTraces:
+    def test_every_terminal_outcome_traced(self, tmp_path, mlp_bundle):
+        """Completed, expired (pre-dispatch deadline), and rejected
+        (queue_full behind a stalled consumer) requests ALL leave a
+        serve_request span naming their outcome."""
+        model, params = mlp_bundle
+        serve_cfg = ServeConfig(max_batch=2, slots=4, batch_timeout_ms=1.0,
+                                swap_poll_s=0.0, stats_interval_s=0.1,
+                                max_queue=2, shed_policy="reject")
+        engine, registry, obs, cfg = build_engine(
+            tmp_path, mlp_bundle, serve_cfg=serve_cfg)
+        # Sequential submit-wait: max_queue is 2 here, so a burst of
+        # healthy submits would itself shed — this phase wants completions.
+        handles = []
+        for i in range(6):
+            h = engine.submit(f"ok{i}", obs_for())
+            handles.append(h)
+            assert h.wait(30.0) is not None
+        # Negative deadline = already expired at submit; expires at
+        # collection, never dispatched.
+        expired = engine.submit("late", obs_for(), deadline_ms=-1.0)
+        assert expired.wait(30.0) is None
+        assert isinstance(expired.error, ServeDeadlineExceeded)
+        # Stall the consumer, then flood past max_queue: rejections.
+        engaged = threading.Event()
+
+        def stall(_r):
+            engaged.set()
+            time.sleep(0.25)
+
+        stalled = engine.submit("stall", obs_for(), callback=stall)
+        assert engaged.wait(20.0)
+        flood = [engine.submit(f"f{i}", obs_for()) for i in range(40)]
+        rejected = 0
+        for h in flood:
+            h.wait(30.0)
+            if isinstance(h.error, ServeRejected):
+                rejected += 1
+        assert rejected > 0
+        assert stalled.wait(20.0) is not None
+        engine.stop()
+        obs.flush()
+        obs.close()
+
+        events = read_trace(os.path.join(cfg.obs.dir, "trace.jsonl"))
+        spans = [e for e in events if e.get("name") == "serve_request"]
+        total = len(handles) + 1 + 1 + len(flood)
+        assert len(spans) == total
+        outcomes = [e["args"]["outcome"] for e in spans]
+        assert outcomes.count("expired") == 1
+        assert outcomes.count("queue_full") == rejected
+        assert outcomes.count("completed") == total - 1 - rejected
+        # Completed spans carry batch/session keys; their stage child
+        # spans sum to the envelope duration (readback rides after the
+        # latency-defining device edge, inside the envelope).
+        by_req: dict = {}
+        for e in events:
+            if e.get("ph") == "X" and "args" in e \
+                    and "request" in e.get("args", {}):
+                by_req.setdefault(e["args"]["request"], {})[e["name"]] = e
+        completed_reqs = [e["args"]["request"] for e in spans
+                          if e["args"]["outcome"] == "completed"]
+        for rid in completed_reqs:
+            group = by_req[rid]
+            assert {"serve_request", "queue_wait", "batch_wait",
+                    "device", "readback"} <= set(group)
+            stage_sum = sum(group[n]["dur"] for n in
+                            ("queue_wait", "batch_wait", "device",
+                             "readback"))
+            assert stage_sum == pytest.approx(
+                group["serve_request"]["dur"], abs=1.0)   # µs units
+            assert group["serve_request"]["args"]["batch"] >= 1
+            assert "session" in group["serve_request"]["args"]
+
+    def test_stage_decomposition_exact_and_counter_zero(
+            self, tmp_path, mlp_bundle):
+        engine, registry, obs, cfg = build_engine(tmp_path, mlp_bundle)
+        for i in range(30):
+            r = engine.submit(f"s{i % 5}", obs_for()).wait(30.0)
+            assert r is not None
+            assert set(r.stages) == {"queue_wait_ms", "batch_wait_ms",
+                                     "device_ms"}
+            assert sum(r.stages.values()) == pytest.approx(
+                r.latency_ms, abs=1e-6)
+        engine.stop()
+        obs.close()
+        assert registry.counters().get(
+            "serve_trace_decomposition_error_total", 0) == 0
+        # Histograms saw every completed request, and the gauges came
+        # from them.
+        assert registry.histogram("serve_request_ms").count == 30
+        for stage in ("queue_wait", "batch_wait", "device", "readback"):
+            assert registry.histogram(f"serve_{stage}_ms").count == 30
+        assert registry.latest("serve_p50_ms") > 0
+        assert registry.latest("serve_p99_ms") >= registry.latest(
+            "serve_p50_ms")
+
+    def test_request_trace_knob_off_keeps_histograms(
+            self, tmp_path, mlp_bundle):
+        engine, registry, obs, cfg = build_engine(
+            tmp_path, mlp_bundle, request_trace=False)
+        assert engine.submit("a", obs_for()).wait(30.0) is not None
+        engine.stop()
+        obs.flush()
+        obs.close()
+        events = read_trace(os.path.join(cfg.obs.dir, "trace.jsonl"))
+        assert not any(e.get("name") == "serve_request" for e in events)
+        assert registry.histogram("serve_request_ms").count == 1
+        assert os.path.isfile(
+            os.path.join(cfg.obs.dir, "serve_exemplars.json"))
+
+    def test_obs_off_zero_artifacts(self, tmp_path, mlp_bundle):
+        model, params = mlp_bundle
+        cfg = ServeConfig(max_batch=2, slots=4, batch_timeout_ms=1.0,
+                          swap_poll_s=0.0, stats_interval_s=0.1)
+        engine = ServeEngine(model, cfg, params)
+        engine.warmup()
+        r = engine.submit("a", obs_for()).wait(30.0)
+        assert r is not None and r.stages is not None    # stamps always on
+        engine.stop()
+        assert engine._req_tracer is None
+        assert list(tmp_path.iterdir()) == []            # nothing written
+
+
+class TestExemplars:
+    def test_ring_bounded_sorted_with_stages(self, tmp_path, mlp_bundle):
+        engine, registry, obs, cfg = build_engine(
+            tmp_path, mlp_bundle, exemplar_k=2)
+        for i in range(40):
+            assert engine.submit(f"e{i % 6}", obs_for()).wait(30.0)
+        engine.stop()
+        obs.close()
+        ex = engine.exemplars()
+        # Ring bound: 4 windows x K plus the in-progress window's K.
+        assert 0 < len(ex) <= 4 * 2 + 2
+        lats = [e["latency_ms"] for e in ex]
+        assert lats == sorted(lats, reverse=True)
+        assert all({"queue_wait_ms", "batch_wait_ms", "device_ms"}
+                   <= set(e["stages"]) for e in ex)
+        artifact = json.load(open(
+            os.path.join(cfg.obs.dir, "serve_exemplars.json")))
+        assert artifact["exemplars"][0]["latency_ms"] == lats[0]
+
+    def test_exemplar_k_zero_disables(self, tmp_path, mlp_bundle):
+        engine, registry, obs, cfg = build_engine(
+            tmp_path, mlp_bundle, exemplar_k=0)
+        assert engine.submit("a", obs_for()).wait(30.0)
+        engine.stop()
+        obs.close()
+        assert engine.exemplars() == []
+
+
+class TestSlo:
+    def test_burn_gauges_and_flight_event(self, tmp_path, mlp_bundle):
+        """Half the traffic expires against a 0.9 availability
+        objective: availability burn >> 1, one alert (hysteresis), the
+        flight ring carries the slo_burn event with exemplars."""
+        engine, registry, obs, cfg = build_engine(
+            tmp_path, mlp_bundle, slo_availability=0.9,
+            slo_target_p99_ms=10_000.0, slo_window_s=60.0,
+            slo_burn_threshold=2.0)
+        for i in range(10):
+            assert engine.submit(f"g{i}", obs_for()).wait(30.0)
+        bad = [engine.submit(f"b{i}", obs_for(), deadline_ms=-1.0)
+               for i in range(10)]
+        for h in bad:
+            h.wait(30.0)
+            assert isinstance(h.error, ServeDeadlineExceeded)
+        time.sleep(0.3)                     # let a stats window publish
+        engine.stop()
+        obs.close()
+        burn = registry.latest("serve_slo_availability_burn")
+        # 10 bad / 20 total against a 10% budget = burn 5.0.
+        assert burn is not None and burn > 2.0
+        assert registry.latest("serve_slo_latency_burn") == 0.0
+        assert registry.counters()["serve_slo_burn_alerts_total"] == 1
+        kinds = [e for e in obs.flight.snapshot()
+                 if e["kind"] == "slo_burn"]
+        assert len(kinds) == 1
+        assert kinds[0]["burns"]["availability"] > 2.0
+        assert "exemplars" in kinds[0]
+
+    def test_burn_updates_during_total_outage(self, tmp_path, mlp_bundle):
+        """The availability-SLO scenario that matters most is a TOTAL
+        outage — and there no batch ever completes, so the consumer-thread
+        publish never runs. Terminal failures must drive the stats cadence
+        themselves: wedge the consumer with a sleeping callback, flood the
+        bounded queue, and the burn gauge + alert must fire MID-incident
+        (zero completions), not after recovery."""
+        model, params = mlp_bundle
+        serve_cfg = ServeConfig(max_batch=2, slots=4, batch_timeout_ms=1.0,
+                                swap_poll_s=0.0, stats_interval_s=0.05,
+                                max_queue=2, shed_policy="reject")
+        engine, registry, obs, cfg = build_engine(
+            tmp_path, mlp_bundle, serve_cfg=serve_cfg,
+            slo_availability=0.99, slo_window_s=60.0,
+            slo_burn_threshold=2.0)
+        for i in range(4):                       # healthy warm phase
+            assert engine.submit(f"g{i}", obs_for()).wait(30.0)
+        unwedge = threading.Event()
+        engine.submit("staller", obs_for(),
+                      callback=lambda r: unwedge.wait(20))
+        time.sleep(0.3)                          # let the stall engage
+        completed_before = engine._term_completed
+        deadline = time.perf_counter() + 10.0
+        while (registry.counters().get("serve_slo_burn_alerts_total", 0)
+               < 1 and time.perf_counter() < deadline):
+            engine.submit("flood", obs_for())
+            time.sleep(0.002)
+        # Nothing completed during the stall, yet the gauge moved and the
+        # alert fired — published from the terminal-failure path.
+        assert engine._term_completed == completed_before
+        assert registry.counters()["serve_slo_burn_alerts_total"] >= 1
+        burn = registry.latest("serve_slo_availability_burn")
+        assert burn is not None and burn > 2.0
+        unwedge.set()
+        assert engine.stop()
+        obs.close()
+
+    def test_window_base_survives_sparse_publishes(
+            self, tmp_path, mlp_bundle):
+        """Publishes sparser than slo_window_s must degrade the window to
+        one publish interval, never collapse the delta to zero: the prune
+        keeps the NEWEST snapshot at-or-before the window edge as the
+        base (a prune-past-the-edge bug made every delta self-subtract
+        whenever interval >= window_s)."""
+        engine, registry, obs, cfg = build_engine(
+            tmp_path, mlp_bundle, slo_availability=0.9, slo_window_s=60.0)
+        try:
+            t0 = time.perf_counter()
+            # Synthetic sparse publishes: snapshots 90 s apart (> window),
+            # cumulative terms climbing all-bad.
+            out1 = engine._slo_burn(t0, (0, 0, 0, 0))
+            out2 = engine._slo_burn(t0 + 90.0, (10, 10, 0, 0))
+            assert out2.get("serve_slo_availability_burn", 0.0) == (
+                pytest.approx(10.0))             # 100% bad / 10% budget
+            out3 = engine._slo_burn(t0 + 180.0, (30, 30, 0, 0))
+            assert out3.get("serve_slo_availability_burn", 0.0) == (
+                pytest.approx(10.0))
+        finally:
+            engine.stop()
+            obs.close()
+
+    def test_bad_slo_config_raises(self, tmp_path, mlp_bundle):
+        model, params = mlp_bundle
+        cfg = make_cfg(tmp_path, slo_availability=1.5)
+        with pytest.raises(ConfigError, match="slo_availability"):
+            ServeEngine(model, cfg.serve, params, obs_cfg=cfg.obs)
+        cfg = make_cfg(tmp_path, slo_window_s=0.0)
+        with pytest.raises(ConfigError, match="slo_window_s"):
+            ServeEngine(model, cfg.serve, params, obs_cfg=cfg.obs)
+
+
+class TestFailureForensics:
+    def test_terminal_failure_dumps_flight_bundle(
+            self, tmp_path, mlp_bundle):
+        """A restart storm past max_restarts ends in the terminal failed
+        state AND a serve_failed flight bundle carrying the restart
+        trail."""
+        model, params = mlp_bundle
+        serve_cfg = ServeConfig(max_batch=2, slots=4, batch_timeout_ms=1.0,
+                                swap_poll_s=0.0, stats_interval_s=0.1,
+                                max_restarts=1, restart_backoff_s=0.01,
+                                restart_backoff_max_s=0.02)
+        engine, registry, obs, cfg = build_engine(
+            tmp_path, mlp_bundle, serve_cfg=serve_cfg)
+        assert engine.submit("warm", obs_for()).wait(30.0) is not None
+        for i in range(2):                  # two malformed-obs faults
+            bad = engine.submit(f"bad{i}", np.ones(3, np.float32))
+            bad.wait(30.0)
+            assert bad.error is not None
+        deadline = time.monotonic() + 30
+        while engine.failed is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert engine.failed is not None
+        engine.stop(drain=False)
+        obs.close()
+        bundle = json.load(open(
+            os.path.join(cfg.obs.dir, "flight_recorder.json")))
+        assert bundle["reason"] == "serve_failed"
+        restarts = [e for e in bundle["events"]
+                    if e["kind"] == "serve_restart"]
+        assert len(restarts) == 1 and restarts[0]["streak"] == 1
+
+    def test_summarize_run_dir_serve_block(self, tmp_path, mlp_bundle):
+        engine, registry, obs, cfg = build_engine(tmp_path, mlp_bundle)
+        for i in range(20):
+            assert engine.submit(f"s{i % 4}", obs_for()).wait(30.0)
+        time.sleep(0.3)
+        engine.stop()
+        obs.flush()
+        obs.close()
+        summary = summarize_run_dir(cfg.obs.dir)
+        serve = summary["serve"]
+        assert serve["trace_decomposition_errors_total"] == 0
+        assert serve["stages"]["device"]["count"] == 20
+        assert serve["stages"]["queue_wait"]["p99_ms"] >= \
+            serve["stages"]["queue_wait"]["p50_ms"]
+        assert serve["slowest_exemplars"][0]["latency_ms"] > 0
+        assert summary["histograms"]["serve_request_ms"]["count"] == 20
+
+
+class TestLintCheck11:
+    def _load(self):
+        import importlib.util
+        import pathlib
+        tool = (pathlib.Path(__file__).resolve().parent.parent
+                / "tools" / "lint_hot_loop.py")
+        spec = importlib.util.spec_from_file_location("lint_hot_loop11",
+                                                      tool)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_tree_is_clean(self):
+        assert self._load().lint_bounded_trace_buffers() == []
+
+    def test_pattern_semantics(self, tmp_path):
+        mod = self._load()
+        fixture = tmp_path / "pkg"
+        fixture.mkdir()
+        (fixture / "sample.py").write_text(
+            "from collections import deque\n"
+            "a = deque()\n"                              # unbounded: flag
+            "b = deque(maxlen=16)\n"                     # bounded: ok
+            "c = deque(maxlen=None)\n"                   # literal None: flag
+            "d = deque([], 0)\n"                         # literal 0: flag
+            "e = deque([], cap)\n"                       # expression: ok
+            "# trace-buffer-ok: drained every tick\n"
+            "f = deque()\n"                              # marked above: ok
+            "g = deque()  # trace-buffer-ok: bounded by max_queue\n")
+        hits = mod.lint_bounded_trace_buffers(roots=[fixture])
+        assert [ln for _, ln, _ in hits] == [2, 4, 5]

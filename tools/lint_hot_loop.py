@@ -134,6 +134,19 @@ in tests/test_megachunk.py:
     the package — unless the line carries ``serve-block-ok`` naming why
     the block is off the serving path (e.g. a drain poll on the
     caller's thread, a load generator's pacing sleep).
+
+11. **No unbounded exemplar/trace accumulation** (the request-tracing
+    PR's guard) — per-request observability (stage stamps, exemplars,
+    trace buffers, SLO windows) accumulates at REQUEST rate: an
+    unbounded collection there is a slow memory leak that tracks
+    offered load, exactly the class of growth admission control (check
+    10) exists to prevent on the request side. Inside
+    ``sharetrade_tpu/serve/`` and ``sharetrade_tpu/obs/`` every
+    ``deque(...)`` construction must pass a bounded ``maxlen`` (not the
+    literal ``None``/``0``) — unless the construction, or a comment
+    within the two preceding lines, carries ``trace-buffer-ok`` naming
+    the logical bound (e.g. "drained every tick", "bounded by
+    max_queue shedding").
 """
 
 from __future__ import annotations
@@ -362,6 +375,13 @@ SERVE_PKG = (pathlib.Path(__file__).resolve().parent.parent
 #: event, so no serve/ code needs an unmarked time.sleep.
 SERVE_PKG_MARKER = "serve-block-ok"
 
+#: Check 11 (the request-tracing PR): packages whose deque buffers hold
+#: per-request observability state and must be bounded rings.
+TRACE_BUFFER_DIRS = ("serve", "obs")
+#: Escape hatch naming the LOGICAL bound of a maxlen-less deque (on the
+#: construction line or within the two preceding comment lines).
+TRACE_BUFFER_MARKER = "trace-buffer-ok"
+
 
 def lint_hot_loop_syncs() -> tuple[list[tuple[str, int, str]], set[str]]:
     return _scan_named_funcs(HOT_FUNCS, PATTERN, MARKER)
@@ -443,6 +463,45 @@ def lint_serve_overload_safety(
                 if time_sleep:
                     bad.append((f"serve/{path.name}", node.lineno,
                                 text.strip()))
+    return bad
+
+
+def lint_bounded_trace_buffers(
+        roots: list | None = None) -> list[tuple[str, int, str]]:
+    """Check 11: every ``deque(...)`` constructed inside ``serve/`` and
+    ``obs/`` must be a bounded ring — a ``maxlen`` argument that is not
+    the literal ``None``/``0`` — or carry ``trace-buffer-ok`` (on the
+    call line or within the two preceding lines) naming its logical
+    bound. Returns (relpath, line, text) hits. ``roots`` overrides the
+    scanned directories (tests exercise the pattern on fixtures)."""
+    targets = (roots if roots is not None
+               else [TARGET.parent.parent / d for d in TRACE_BUFFER_DIRS])
+    bad: list[tuple[str, int, str]] = []
+    for root in targets:
+        for path in sorted(pathlib.Path(root).glob("*.py")):
+            src = path.read_text()
+            lines = src.splitlines()
+            for node in ast.walk(ast.parse(src)):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                name = (fn.attr if isinstance(fn, ast.Attribute)
+                        else getattr(fn, "id", None))
+                if name != "deque":
+                    continue
+                bound_expr = (node.args[1] if len(node.args) >= 2
+                              else next((kw.value for kw in node.keywords
+                                         if kw.arg == "maxlen"), None))
+                bounded = bound_expr is not None and not (
+                    isinstance(bound_expr, ast.Constant)
+                    and bound_expr.value in (None, 0))
+                if bounded:
+                    continue
+                window = lines[max(0, node.lineno - 3):node.lineno]
+                if any(TRACE_BUFFER_MARKER in ln for ln in window):
+                    continue
+                bad.append((f"{pathlib.Path(root).name}/{path.name}",
+                            node.lineno, lines[node.lineno - 1].strip()))
     return bad
 
 
@@ -651,6 +710,17 @@ def main() -> int:
               f"the line '# {SERVE_PKG_MARKER}: <why this block is off "
               "the serving path>'")
         return 1
+    buf_bad = lint_bounded_trace_buffers()
+    if buf_bad:
+        print("trace-buffer bound lint FAILED:")
+        for rel, ln, text in buf_bad:
+            print(f"  {rel}:{ln}: {text}")
+        print("an unbounded deque in serve/ or obs/ accumulates per-"
+              "request observability state at request rate — a slow "
+              "memory leak that tracks offered load; give it a maxlen "
+              "ring bound, or tag it (call line or the two lines above) "
+              f"'# {TRACE_BUFFER_MARKER}: <the logical bound>'")
+        return 1
     dur_bad = lint_durable_replace()
     if dur_bad:
         print("durable-rename fsync lint FAILED:")
@@ -672,6 +742,7 @@ def main() -> int:
           f"serve batch-dispatch lint OK ({', '.join(SERVE_DISPATCH_FUNCS)}); "
           f"replay device-path lint OK ({', '.join(REPLAY_TREE_FUNCS + REPLAY_DQN_FUNCS)}); "
           f"serve overload-safety lint OK; "
+          f"trace-buffer bound lint OK ({', '.join(TRACE_BUFFER_DIRS)}); "
           f"durable-rename fsync lint OK ({', '.join(DURABLE_WRITE_FILES)})")
     return 0
 

@@ -1,13 +1,22 @@
 #!/usr/bin/env python
 """``make obs-demo``: the telemetry zero-to-summary loop, end to end.
 
-Runs a short obs-enabled training (tiny qlearn config, seconds on CPU),
-verifies the run dir contains every artifact the obs contract promises
-(manifest, Perfetto-loadable trace, metrics JSONL + Prometheus textfile),
-then prints the ``cli obs`` summary of that dir — the same command an
-operator runs against a production run dir. Wired into ``make check`` so
-the whole surface (orchestrator instrumentation → files → CLI reader)
-breaks loudly, not silently.
+Two phases, both seconds-scale on CPU:
+
+1. **Training** — a short obs-enabled run (tiny qlearn config) must leave
+   every artifact the obs contract promises (manifest, Perfetto-loadable
+   trace, metrics JSONL + a STRICTLY-parsed Prometheus textfile including
+   the training-side ``train_chunk_seconds``/``train_dispatch_gap_ms``
+   histograms), then print the ``cli obs`` summary of the dir.
+2. **Serving** (ISSUE 11) — a small obs-enabled ServeEngine under a burst
+   of traffic must emit per-request trace flows (async ``serve_request``
+   spans with stage children), per-stage latency histograms in valid
+   exposition format, SLO burn-rate gauges, and the slowest-request
+   exemplar artifact — then the same ``cli obs`` summary renders the
+   serve block.
+
+Wired into ``make check`` so the whole surface (instrumentation → files →
+CLI reader) breaks loudly, not silently.
 """
 
 from __future__ import annotations
@@ -60,7 +69,101 @@ def main() -> int:
         if not any(e.get("ph") == "X" for e in events):
             print("obs-demo: trace.jsonl holds no complete spans")
             return 1
-        return cli.main(["obs", "--dir", cfg.obs.dir])
+        from sharetrade_tpu.obs import parse_prom_text
+        prom = parse_prom_text(
+            open(os.path.join(cfg.obs.dir, "metrics.prom")).read())
+        for hist in ("sharetrade_train_chunk_seconds",
+                     "sharetrade_train_dispatch_gap_ms"):
+            if hist not in prom["histograms"]:
+                print(f"obs-demo: {hist} histogram missing from "
+                      "metrics.prom")
+                return 1
+        rc = cli.main(["obs", "--dir", cfg.obs.dir])
+        if rc != 0:
+            return rc
+        rc = serve_demo(d)
+        if rc != 0:
+            return rc
+        return cli.main(["obs", "--dir", os.path.join(d, "obs-serve")])
+
+
+def serve_demo(workdir: str) -> int:
+    """Phase 2: request traces + histograms + SLO artifacts from a live
+    engine — the serve half of the zero-to-summary loop."""
+    import json
+
+    import jax
+
+    from sharetrade_tpu.config import FrameworkConfig, ModelConfig
+    from sharetrade_tpu.models import build_model
+    from sharetrade_tpu.obs import (SERVE_STAGES, build_obs,
+                                    parse_prom_text, read_trace)
+    from sharetrade_tpu.serve.engine import ServeEngine
+    from sharetrade_tpu.utils.metrics import MetricsRegistry
+
+    cfg = FrameworkConfig()
+    cfg.obs.enabled = True
+    cfg.obs.dir = os.path.join(workdir, "obs-serve")
+    cfg.obs.export_interval_s = 0.1
+    cfg.obs.slo_availability = 0.999
+    cfg.obs.slo_target_p99_ms = 250.0
+    cfg.serve.max_batch = 8
+    cfg.serve.slots = 16
+    cfg.serve.batch_timeout_ms = 1.0
+    cfg.serve.swap_poll_s = 0.0
+    cfg.serve.stats_interval_s = 0.1
+
+    obs_dim = 10
+    model = build_model(ModelConfig(kind="mlp", hidden_dim=16), obs_dim,
+                        head="ac")
+    params = model.init(jax.random.PRNGKey(0))
+    registry = MetricsRegistry()
+    obs = build_obs(cfg, registry)
+    engine = ServeEngine(model, cfg.serve, params, registry=registry,
+                         obs=obs, obs_cfg=cfg.obs)
+    engine.warmup()
+    handles = [engine.submit(f"user{i % 24}",
+                             np.full((obs_dim,), 10.0 + i % 7, np.float32))
+               for i in range(192)]
+    failed = sum(1 for h in handles if h.wait(30.0) is None)
+    engine.stop()
+    obs.flush()
+    obs.close()
+    if failed:
+        print(f"obs-demo[serve]: {failed} requests failed")
+        return 1
+
+    events = read_trace(os.path.join(cfg.obs.dir, "trace.jsonl"))
+    flows = [e for e in events
+             if e.get("ph") == "X" and e.get("name") == "serve_request"]
+    if len(flows) != len(handles):
+        print(f"obs-demo[serve]: {len(flows)} serve_request trace flows "
+              f"for {len(handles)} requests")
+        return 1
+    prom = parse_prom_text(
+        open(os.path.join(cfg.obs.dir, "metrics.prom")).read())
+    for stage in SERVE_STAGES + ("request",):
+        name = f"sharetrade_serve_{stage}_ms"
+        hist = prom["histograms"].get(name)
+        if hist is None or hist["count"] != len(handles):
+            print(f"obs-demo[serve]: histogram {name} missing or "
+                  f"miscounted ({hist and hist['count']})")
+            return 1
+    if registry.latest("serve_slo_availability_burn") is None:
+        print("obs-demo[serve]: SLO burn gauge never published")
+        return 1
+    ex_path = os.path.join(cfg.obs.dir, "serve_exemplars.json")
+    if not os.path.isfile(ex_path):
+        print("obs-demo[serve]: serve_exemplars.json missing")
+        return 1
+    slowest = json.load(open(ex_path))["exemplars"]
+    if not slowest or "stages" not in slowest[0]:
+        print("obs-demo[serve]: exemplars carry no stage breakdown")
+        return 1
+    print(f"obs-demo[serve]: {len(handles)} requests traced; slowest "
+          f"{slowest[0]['latency_ms']:.2f} ms "
+          f"(stages {slowest[0]['stages']})")
+    return 0
 
 
 if __name__ == "__main__":

@@ -214,10 +214,26 @@ class ChaosHarness:
                        for sid in sids]
             for sid, handle in pending:
                 self.handles.append((handle, "traffic"))
-                if handle.wait(timeout) is None:
+                result = handle.wait(timeout)
+                if result is None:
                     raise ChaosError(
                         f"healthy traffic for {sid} failed: "
                         f"{handle.error!r}")
+                # ISSUE-11 invariant, asserted on EVERY completed
+                # request the soak observes: the stage decomposition
+                # (queue_wait + batch_wait + device) telescopes exactly
+                # to the end-to-end latency.
+                if result.stages is None:
+                    raise ChaosError(
+                        f"completed request for {sid} carries no stage "
+                        "decomposition")
+                drift = abs(sum(result.stages.values())
+                            - result.latency_ms)
+                if drift > 0.01:        # ms; exact modulo float adds
+                    raise ChaosError(
+                        f"stage decomposition {result.stages} sums "
+                        f"{drift:.4f} ms away from latency "
+                        f"{result.latency_ms:.4f}")
 
     def fresh_logits(self, obs: np.ndarray) -> np.ndarray:
         """What a FRESH session answers for ``obs`` under the CURRENT
@@ -496,7 +512,18 @@ class ChaosHarness:
         if not stopped:
             raise ChaosError("engine.stop() reported hung threads at "
                              "soak end")
-        return {"max_queue_depth_seen": max_depth}
+        # The engine's own structural self-check must agree: across the
+        # whole soak (faults, restarts, floods included) no completed
+        # request's stage decomposition drifted from its latency.
+        decomp_errors = self.registry.counters().get(
+            "serve_trace_decomposition_error_total", 0)
+        if decomp_errors:
+            raise ChaosError(
+                f"engine counted {int(decomp_errors)} stage-"
+                "decomposition drift(s) (serve_trace_decomposition_"
+                "error_total != 0)")
+        return {"max_queue_depth_seen": max_depth,
+                "decomposition_errors": int(decomp_errors)}
 
 
 def run_chaos(*, injections: int = 20, seed: int = 0,

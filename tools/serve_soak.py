@@ -85,6 +85,7 @@ def run_soak(*, duration_s: float = 5.0, sessions: int = 2000,
              registry=None, log=print) -> dict:
     """The three phases; returns the result object (see module doc)."""
     from sharetrade_tpu.config import ServeConfig
+    from sharetrade_tpu.obs import serve_stage_p99s
     from sharetrade_tpu.serve import ServeEngine
     from sharetrade_tpu.serve.driver import (
         BatchOneServer,
@@ -147,6 +148,22 @@ def run_soak(*, duration_s: float = 5.0, sessions: int = 2000,
             file=sys.stderr)
     engine.stop()
 
+    # ISSUE-11 stage decomposition: the engine self-checks that every
+    # completed request's queue_wait + batch_wait + device stages sum to
+    # its end-to-end latency; the soak asserts the violation counter
+    # stayed 0 and reports the histogram-derived per-stage tails (the
+    # perf-gate rows — *_ms suffixes gate lower-is-better).
+    reg = engine.registry
+    decomp_errors = int(reg.counters().get(
+        "serve_trace_decomposition_error_total", 0))
+    if decomp_errors:
+        # An explicit raise, not assert: the invariant must survive -O
+        # (serve_chaos raises ChaosError for the same check).
+        raise RuntimeError(
+            f"{decomp_errors} requests completed with a stage "
+            "decomposition that does not sum to their latency")
+    stage_p99 = serve_stage_p99s(reg)
+
     # Acceptance: >= 3x baseline QPS at p99 <= the batch=1 server's p99
     # under the SAME offered rate.
     accept_point = None
@@ -170,6 +187,8 @@ def run_soak(*, duration_s: float = 5.0, sessions: int = 2000,
         "best_open_loop_qps": best,
         "accepted_3x_at_rate": accept_point,
         "accepted": accept_point is not None,
+        "stage_p99_ms": stage_p99,
+        "decomposition_errors": decomp_errors,
     }
 
 
