@@ -8,13 +8,14 @@
 
 PYTHON ?= python
 
-.PHONY: check test slow native bench bench-async bench-ckpt bench-dispatch bench-obs bench-precision bench-replay bench-reshard bench-roofline bench-serve bench-serve-overload crash-soak obs-demo lint perf-gate serve-chaos serve-soak shard-audit clean
+.PHONY: check test slow native bench bench-actor bench-async bench-ckpt bench-dispatch bench-obs bench-precision bench-replay bench-reshard bench-roofline bench-serve bench-serve-overload actor-soak crash-soak obs-demo lint perf-gate serve-chaos serve-soak shard-audit clean
 
 check: native lint
 	$(PYTHON) -m pytest tests/ -q -m "not slow" -x
 	$(PYTHON) tools/smoke_compile.py
 	$(PYTHON) tools/obs_demo.py
 	$(PYTHON) tools/serve_chaos.py --injections 2
+	$(PYTHON) tools/actor_soak.py --kills 2 --actors 2 --quick --no-scale
 	$(PYTHON) tools/shard_audit.py
 	$(PYTHON) tools/perf_gate.py
 
@@ -144,6 +145,27 @@ serve-chaos:
 bench-serve-overload:
 	$(PYTHON) -c "import json, bench; \
 	print(json.dumps(bench.bench_serve_overload(), indent=2))"
+
+# Actor/learner disaggregation scaling (distrib/): experience produced
+# (summed actor rollouts) and ingested by the live learner at N in
+# {1,2,4} actor subprocesses vs the single-process train baseline — the
+# numbers behind BASELINE.md "Actor/learner disaggregation" and the
+# actor_rows_ingested_per_sec perf-gate series. CPU-framed (host-core
+# contention); the TPU row rides the item-4 measurement campaign.
+bench-actor:
+	$(PYTHON) -c "import json, bench; \
+	print(json.dumps(bench.bench_actor_scaling(), indent=2))"
+
+# Actor-process kill soak: >= 20 seeded SIGKILL/SIGTERM injections into
+# LIVE actor subprocesses under a training learner (N=4 pool), asserting
+# after every kill that the learner never restarts, journal CRC /
+# high-water invariants hold through the segmented reader, and
+# membership/restart counters reconcile exactly — plus the mid-soak
+# elastic-membership scale() join and the terminal-failure degrade
+# (tools/actor_soak.py; the 2-kill quick profile runs in tier-1 via
+# tests/test_actor_soak.py and in `make check`).
+actor-soak:
+	$(PYTHON) tools/actor_soak.py --kills 20 --actors 4
 
 # Process-kill chaos soak: >= 20 seeded SIGKILL/SIGTERM injections into real
 # training subprocesses (journaled DQN config), each followed by --resume,

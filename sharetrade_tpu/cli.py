@@ -407,6 +407,210 @@ def cmd_serve(args) -> int:
         service.close()
 
 
+def cmd_actor(args) -> int:
+    """One rollout-actor process (distrib/actor.py) — a separate failure
+    domain of the disaggregated actor/learner topology: verified-restore
+    weights from ``tag_best``, epsilon-greedy rollouts, transitions
+    appended to this actor's OWN journal under
+    ``<distrib.actor_dir>/<actor-id>/``, heartbeat stamps for the
+    supervising :class:`ActorPool`. Normally spawned BY the pool
+    (``cli learner``), but runnable by hand for debugging.
+
+    Preemption contract matches ``cli train``: SIGTERM/SIGINT drains
+    (journal flush + final heartbeat) and exits 75; a second signal hard-
+    exits."""
+    from sharetrade_tpu.distrib.actor import RolloutActor
+
+    cfg = _load_config(args)
+    if not args.actor_id:
+        log.error("--actor-id is required")
+        return 1
+    workdir = os.path.join(cfg.distrib.actor_dir, args.actor_id)
+    # The actor's data layer is scoped to ITS directory: sharing the
+    # learner's journal_dir would contend for the price-event journal's
+    # writer lock (and worse, interleave transition records — the exact
+    # torn-record scenario the per-actor layout exists to prevent).
+    cfg.data.journal_dir = workdir
+    # Telemetry stays with the learner: an actor writing the shared obs
+    # run dir would fight the learner's manifest/exporter; actor health
+    # flows through heartbeats -> pool gauges instead.
+    cfg.obs.enabled = False
+
+    stop_evt = threading.Event()
+    preempted: list[float] = []
+
+    def _on_signal(signum, frame):
+        if not preempted:
+            log.warning("actor %s received %s; draining", args.actor_id,
+                        signal.Signals(signum).name)
+            preempted.append(time.monotonic())
+            stop_evt.set()
+        else:
+            os._exit(EXIT_PREEMPTED)
+
+    prev_handlers = {
+        s: signal.signal(s, _on_signal)
+        for s in (signal.SIGTERM, signal.SIGINT)}
+    service = PriceDataService(config=cfg.data)
+    try:
+        response = service.request(args.symbol.split(",")[0].strip(),
+                                   args.start, args.end)
+        actor = RolloutActor(cfg, response.series.prices,
+                             actor_id=args.actor_id, workdir=workdir)
+        print(json.dumps({"event": "actor_ready",
+                          "actor_id": args.actor_id,
+                          "pid": os.getpid(),
+                          "params_step": actor.params_step,
+                          "journal": actor.journal_path}), flush=True)
+        summary = actor.run(stop_evt, max_chunks=args.max_chunks)
+        print(json.dumps(summary), flush=True)
+        return EXIT_PREEMPTED if preempted else 0
+    finally:
+        for s, h in prev_handlers.items():
+            signal.signal(s, h)
+        service.close()
+
+
+def cmd_learner(args) -> int:
+    """The learner process of the disaggregated topology: hosts the
+    :class:`ActorPool` supervisor (N ``cli actor`` subprocesses under the
+    process-granular supervision contract) AND the training loop, which
+    tails every actor's journal between megachunks
+    (``Orchestrator.ingest_actor_feeds``), trains, and republishes
+    ``tag_best`` for the actors to hot-swap — the closed loop.
+
+    The learner is its own failure domain: actors dying (and being
+    respawned, or failing terminally) never restarts this process — the
+    property the kill-test (tools/actor_soak.py) asserts after every
+    injection. SIGTERM drains BOTH tiers (pool SIGTERMs its actors, the
+    orchestrator writes ``tag_preempt``) and exits 75."""
+    from sharetrade_tpu.distrib.pool import ActorPool
+    from sharetrade_tpu.runtime import Orchestrator, ReplyState
+
+    cfg = _load_config(args)
+    if cfg.distrib.num_actors < 1:
+        log.error("cli learner needs distrib.num_actors >= 1 "
+                  "(got %d); use cli train for the single-process loop",
+                  cfg.distrib.num_actors)
+        return 1
+    if cfg.learner.algo != "dqn" and cfg.distrib.ingest_every_updates > 0:
+        log.error("actor-feed ingest requires learner.algo=dqn (replay "
+                  "buffer); got %r", cfg.learner.algo)
+        return 1
+    if cfg.data.journal_segment_records <= 0:
+        # Single-file actor journals would grow without bound (the
+        # actor-side retirement only runs with rotation on) and make
+        # every ingest tick re-decode the whole rollout history; the
+        # saved config flows to the spawned actors, so defaulting here
+        # covers the fleet.
+        cfg.data.journal_segment_records = 256
+        log.info("distrib: defaulting data.journal_segment_records=256 "
+                 "(rotation is required for bounded actor journals and "
+                 "bounded ingest reads)")
+    service = PriceDataService(config=cfg.data)
+    orch = None
+    pool = None
+    preempt_at: list[float] = []
+
+    def _on_signal(signum, frame):
+        if not preempt_at:
+            log.warning("received %s; draining learner + actor pool",
+                        signal.Signals(signum).name)
+            preempt_at.append(time.monotonic())
+        else:
+            log.warning("received %s during the drain; hard exit",
+                        signal.Signals(signum).name)
+            # os._exit skips every finally: anything not killed NOW is an
+            # orphaned actor rolling out forever with no supervisor.
+            if pool is not None:
+                pool.kill_all()
+            os._exit(EXIT_PREEMPTED)
+        if pool is not None:
+            # A fleet preemption TERMs the whole process group: the
+            # actors are draining alongside us, and the pool must stop
+            # classifying their graceful exits as crashes (respawning
+            # fresh actors into a dying run).
+            pool.quiesce()
+        if orch is not None:
+            orch.request_preempt()
+
+    prev_handlers = {
+        s: signal.signal(s, _on_signal)
+        for s in (signal.SIGTERM, signal.SIGINT)}
+    try:
+        response = service.request(args.symbol.split(",")[0].strip(),
+                                   args.start, args.end)
+        prices = response.series.prices
+        orch = Orchestrator(cfg)
+        if preempt_at:
+            orch.request_preempt()
+        pool = ActorPool(cfg, registry=orch.metrics, symbol=args.symbol,
+                         start=args.start, end=args.end).start()
+        if preempt_at:
+            # SIGTERM landed during orchestrator bring-up, before the
+            # handler had a pool to quiesce: re-apply it here or the pool
+            # respawns group-TERM'd actors into the dying run.
+            pool.quiesce()
+        print(json.dumps({"event": "learner_ready", "pid": os.getpid(),
+                          "actors": cfg.distrib.num_actors,
+                          "pool_dir": pool.dir}), flush=True)
+        t0 = time.perf_counter()
+        try:
+            orch.send_training_data(prices, resume=args.resume)
+        except FileNotFoundError as exc:
+            log.error("--resume: %s (train without --resume first)", exc)
+            return 1
+        orch.start_training(background=True)
+        grace = cfg.runtime.preempt_grace_s
+        while not orch.wait(timeout=cfg.runtime.poll_interval_s):
+            if preempt_at and (time.monotonic() - preempt_at[0]
+                               > grace + 5.0):
+                log.error("preemption grace (%.1fs) expired before the "
+                          "drain finished; hard exit", grace)
+                pool.kill_all()     # os._exit skips the finally teardown
+                os._exit(EXIT_PREEMPTED)
+        elapsed = time.perf_counter() - t0
+
+        done = orch.is_everything_done()
+        pool.stop(grace_s=grace)
+        counters = orch.metrics.counters()
+        snap = orch.snapshot()
+        summary = {
+            "env_steps": snap.get("env_steps"),
+            "updates": snap.get("updates"),
+            "elapsed_s": elapsed,
+            "learner_restarts": orch.restarts,
+            "actor_restarts": pool.restarts_total,
+            "rows_ingested": int(
+                counters.get("distrib_rows_ingested_total", 0)),
+            **{f"actors_{k}": v for k, v in pool.counts().items()},
+        }
+        if orch.preempted or (preempt_at
+                              and done.state is not ReplyState.COMPLETED):
+            summary["preempted"] = True
+            print(json.dumps(summary))
+            return EXIT_PREEMPTED
+        if done.state is not ReplyState.COMPLETED:
+            log.error("learner did not complete: %s (last error: %r)",
+                      done, orch.last_error)
+            print(json.dumps(summary))
+            return 1
+        avg, std = orch.get_avg(), orch.get_std()
+        if avg.ok:
+            summary["avg_portfolio"] = avg.value
+            summary["std_portfolio"] = std.value
+        print(json.dumps(summary))
+        return 0
+    finally:
+        for s, h in prev_handlers.items():
+            signal.signal(s, h)
+        if pool is not None:
+            pool.stop(grace_s=10.0)
+        if orch is not None:
+            orch.stop()
+        service.close()
+
+
 def cmd_obs(args) -> int:
     """Summarize a telemetry run dir (obs.enabled=true output): manifest
     identity, span aggregates from the Chrome trace, metrics tail, and the
@@ -449,7 +653,8 @@ def main(argv=None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
 
     for name, fn in [("train", cmd_train), ("query", cmd_query),
-                     ("serve", cmd_serve)]:
+                     ("serve", cmd_serve), ("actor", cmd_actor),
+                     ("learner", cmd_learner)]:
         p = sub.add_parser(name)
         p.add_argument("--config", default=None, help="JSON config file")
         p.add_argument("--set", action="append", default=[],
@@ -469,6 +674,17 @@ def main(argv=None) -> int:
             p.add_argument("--eval-best", action="store_true",
                            help="also evaluate the retained best-eval "
                                 "checkpoint (runtime.keep_best_eval)")
+        if name == "actor":
+            p.add_argument("--actor-id", default=None,
+                           help="this actor's id (its per-actor dir under "
+                                "distrib.actor_dir)")
+            p.add_argument("--max-chunks", type=int, default=0,
+                           help="stop after this many rollout chunks "
+                                "(0 = until SIGTERM)")
+        if name == "learner":
+            p.add_argument("--resume", action="store_true",
+                           help="restore the latest checkpoint and "
+                                "continue")
         if name == "serve":
             p.add_argument("--duration", type=float, default=10.0,
                            help="seconds to serve the synthetic load "

@@ -531,6 +531,72 @@ class ServeConfig:
 
 
 @dataclass
+class DistribConfig:
+    """Disaggregated actor/learner topology (distrib/) — the reference's
+    ten-worker/one-learner actor system (TrainerRouterActor.scala:36) run
+    as separate OS-process FAILURE DOMAINS: an :class:`ActorPool`
+    supervisor (distrib/pool.py) spawns ``num_actors`` rollout-actor
+    subprocesses (``cli actor``), each of which restores weights from the
+    training run's ``tag_best`` through the verified-restore path
+    (serve/swap.py semantics: checksums + finite + precision-mode check,
+    refusal-not-fatal), rolls out episodes, and appends transitions to its
+    OWN journal/feed (one writer per journal — the data plane's
+    concurrent-writer lock makes sharing one impossible by construction),
+    while the learner process tails all actor feeds between megachunks
+    (runtime/orchestrator.py ``ingest_actor_feeds``), splices the rows
+    into its device replay buffer (PER priorities reseeded the
+    ``_warm_start_replay`` way), trains, and republishes ``tag_best`` —
+    closing the loop without the learner ever restarting when an actor
+    dies (MSRL's per-fragment restart property, arxiv 2210.00882;
+    Podracer's Sebulba split, arxiv 2104.06272)."""
+
+    # Rollout-actor subprocesses the pool supervises. 0 (default) =
+    # disaggregation off: nothing spawns, the learner ingests nothing,
+    # single-process behavior is untouched.
+    num_actors: int = 0
+    # Root directory for per-actor state: ``<actor_dir>/<actor_id>/``
+    # holds each actor's transitions journal + heartbeat file; the pool's
+    # ``status.json`` (membership/counters, atomically rewritten) and the
+    # ``scale`` control file live at the root.
+    actor_dir: str = "actors"
+    # Supervision contract at PROCESS granularity (the PR-5/PR-10
+    # contract): a crashed actor respawns under seeded exponential
+    # backoff; more than this many CONSECUTIVE crashes (the streak resets
+    # once a respawned actor proves healthy by advancing its heartbeat)
+    # marks the actor TERMINALLY FAILED and the pool degrades gracefully
+    # onto the survivors (gauges actors_alive / actors_failed, counter
+    # actor_restarts_total).
+    max_actor_restarts: int = 5
+    actor_backoff_initial_s: float = 0.5
+    actor_backoff_max_s: float = 10.0
+    actor_backoff_jitter: float = 0.2   # seeded from the run's seed
+    # Actor heartbeat cadence (each actor rewrites its heartbeat stamp at
+    # least this often while rolling out) and the pool-side staleness
+    # bound: an actor whose heartbeat is older than ``heartbeat_timeout_s``
+    # is presumed wedged and killed (counts as a crash -> restart path).
+    # timeout 0 = observe-only (ages are still exported).
+    heartbeat_interval_s: float = 1.0
+    heartbeat_timeout_s: float = 0.0
+    # Pool supervise/reap cadence (seconds between membership scans).
+    supervise_interval_s: float = 0.25
+    # Learner-side feed ingest cadence: every this many updates the
+    # orchestrator tails every actor journal for rows newer than its
+    # per-actor cursor and splices them into the live replay buffer
+    # (requires learner.algo="dqn"; PER priorities reseed at the stored
+    # max). 0 disables ingest (the pool can still run for rollout-only
+    # workloads).
+    ingest_every_updates: int = 8
+    # Per-ingest row bound per actor journal (0 = learner.replay_capacity).
+    ingest_max_rows: int = 0
+    # Actor-side weight refresh: poll ``tag_best`` at this cadence and
+    # hot-swap the rollout policy through the verified-restore watcher
+    # (serve/swap.py). 0 = boot weights only.
+    weight_poll_s: float = 2.0
+    # Device steps per actor rollout chunk (0 = runtime.chunk_steps).
+    actor_chunk_steps: int = 0
+
+
+@dataclass
 class ObsConfig:
     """Telemetry (obs/): span trace, metrics export, crash flight recorder.
 
@@ -624,6 +690,7 @@ class FrameworkConfig:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     precision: PrecisionConfig = field(default_factory=PrecisionConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    distrib: DistribConfig = field(default_factory=DistribConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     seed: int = 0
 
@@ -705,5 +772,6 @@ _NESTED = {
     "checkpoint": CheckpointConfig,
     "precision": PrecisionConfig,
     "serve": ServeConfig,
+    "distrib": DistribConfig,
     "obs": ObsConfig,
 }

@@ -39,6 +39,107 @@ _HEADER = struct.Struct("<II")  # length, crc32
 #: ... — zero-padded so lexical order IS age order.
 _SEG_SUFFIX = ".seg"
 
+#: Writer-lock suffix: ``path.lock`` is ``flock``-held (and pid-stamped
+#: for forensics) while a :class:`Journal` (or :func:`acquire_writer_lock`
+#: caller) owns the path. A SECOND live process opening the same journal
+#: would interleave its framed records with the first's — each record is
+#: written with one ``write`` call but the OS only guarantees atomicity
+#: for small appends, so concurrent writers can tear records in a way the
+#: CRC catches only AFTER the damage. The lock makes the torn-record
+#: scenario impossible by construction: the actor/learner data plane gives
+#: every actor its OWN journal and this guard enforces it.
+_LOCK_SUFFIX = ".lock"
+
+#: Locks THIS process holds: lock path -> [fd, refcount]. The kernel keys
+#: flock by open-file-description, so in-process re-opens (close/reopen
+#: cycles, a reader-side Journal next to the writer) must share ONE fd —
+#: a second flock on a fresh fd of the same file would deadlock against
+#: ourselves. Refcounted so the first close of a pair doesn't drop the
+#: lock out from under the survivor.
+_HELD_LOCKS: dict[str, list] = {}
+_HELD_LOCKS_GUARD = threading.Lock()
+
+
+class JournalLockError(RuntimeError):
+    """The journal path is already held by another LIVE process."""
+
+
+def acquire_writer_lock(path: str) -> str:
+    """Take the writer lock for ``path``; returns the lock path. Raises
+    :class:`JournalLockError` when another LIVE process holds it.
+
+    The authority is a kernel ``flock`` on ``path.lock`` — dropped
+    automatically when the holding process dies, so a SIGKILLed writer's
+    lock is never stale and there is no sweep step to race (an earlier
+    pid-liveness sweep protocol had a TOCTOU hole: two processes sweeping
+    the same dead writer's lockfile could both "win" and co-hold the
+    journal). The holder's pid is still stamped into the file purely for
+    forensics/error messages. A lock held by THIS process is refcounted,
+    not an error: in-process re-opens (close/reopen cycles, a reader-side
+    Journal) were always legal and remain so — the guard targets
+    cross-process interleaving. The lockfile itself is left in place on
+    release (unlinking a flock'd file opens a different race: a waiter
+    holding the old inode while a third process locks a fresh one)."""
+    import fcntl
+    # Realpath both the registry key and the lockfile location: two
+    # in-process opens of one journal through different spellings
+    # (relative vs absolute, a symlink) must resolve to the SAME held
+    # entry — a second flock on a fresh fd of the same file would
+    # EWOULDBLOCK against ourselves and read as a foreign holder.
+    lock = os.path.realpath(path) + _LOCK_SUFFIX
+    with _HELD_LOCKS_GUARD:
+        held = _HELD_LOCKS.get(lock)
+        if held is not None:            # re-entrant within this process
+            held[1] += 1
+            return lock
+        fd = os.open(lock, os.O_CREAT | os.O_RDWR)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except BlockingIOError:
+            # EAGAIN/EWOULDBLOCK is the ONLY "held by someone" signal;
+            # any other OSError (ENOLCK on a lockd-less NFS mount,
+            # EINTR) is locking INFRASTRUCTURE failing and must surface
+            # as itself, not as a phantom concurrent writer.
+            try:
+                holder = int(os.read(fd, 64).decode().strip() or 0)
+            except (OSError, ValueError):
+                holder = 0
+            os.close(fd)
+            raise JournalLockError(
+                f"journal {path} is already held by live process "
+                f"{holder or '?'} (lock {lock}); a second writer would "
+                "interleave framed records — give each writer its own "
+                "journal path") from None
+        except OSError:
+            os.close(fd)
+            raise
+        os.ftruncate(fd, 0)
+        os.write(fd, str(os.getpid()).encode())
+        _HELD_LOCKS[lock] = [fd, 1]
+        return lock
+
+
+def release_writer_lock(path: str) -> None:
+    """Drop one hold on the writer lock; the flock releases (and the pid
+    stamp clears) when the LAST in-process holder lets go. A path this
+    process never locked is a no-op — another process's live lock must
+    not be disturbed."""
+    lock = os.path.realpath(path) + _LOCK_SUFFIX
+    with _HELD_LOCKS_GUARD:
+        held = _HELD_LOCKS.get(lock)
+        if held is None:
+            return
+        held[1] -= 1
+        if held[1] > 0:
+            return
+        del _HELD_LOCKS[lock]
+        fd = held[0]
+        try:
+            os.ftruncate(fd, 0)         # stamp cleared: not held
+        except OSError:
+            pass
+        os.close(fd)                    # releases the flock
+
 
 def _fsync_dir(path: str) -> None:
     """fsync the directory holding ``path`` so a rename/unlink published
@@ -164,18 +265,32 @@ class Journal:
         self._last_commit = time.monotonic()
         self._lock = threading.Lock()
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        valid = self._scan_valid_prefix()
-        # Truncate any torn tail so appends continue from a clean boundary
-        # (sealed segments were fsynced before publication — only the
-        # active segment can tear).
-        if valid is not None:
-            with open(self.path, "r+b") as f:
-                f.truncate(valid)
-        self._fh = open(self.path, "ab")
-        #: Records currently in the active segment — counted during the
-        #: torn-tail prefix scan above (one walk of the active file, not
-        #: a second one; a migrating pre-rotation journal can be large).
-        self._seg_records = self._scanned_records
+        # Concurrent-writer guard: the flock'd lockfile raises LOUDLY
+        # when another live process already owns this path (two writers
+        # would interleave framed records); a dead writer's flock died
+        # with it. Released at close().
+        acquire_writer_lock(self.path)
+        self._lock_held = True
+        try:
+            valid = self._scan_valid_prefix()
+            # Truncate any torn tail so appends continue from a clean
+            # boundary (sealed segments were fsynced before publication —
+            # only the active segment can tear).
+            if valid is not None:
+                with open(self.path, "r+b") as f:
+                    f.truncate(valid)
+            self._fh = open(self.path, "ab")
+            #: Records currently in the active segment — counted during
+            #: the torn-tail prefix scan above (one walk of the active
+            #: file, not a second one; a migrating pre-rotation journal
+            #: can be large).
+            self._seg_records = self._scanned_records
+        except BaseException:
+            # A failed construction must not leak the writer lock for
+            # the process lifetime (nothing holds a handle to release).
+            self._lock_held = False
+            release_writer_lock(self.path)
+            raise
 
     # ---- write path ----
 
@@ -341,6 +456,9 @@ class Journal:
             if not self._fh.closed:
                 self._commit_locked()
                 self._fh.close()
+            if getattr(self, "_lock_held", False):
+                release_writer_lock(self.path)
+                self._lock_held = False
 
     def __enter__(self) -> "Journal":
         return self

@@ -194,10 +194,33 @@ class FileTailFeed:
 def append_feed_rows(path: str, series: PriceSeries) -> None:
     """Producer-side helper: append a series as ``price, date`` rows to a
     feed file (the synthetic generator behind the file/FIFO provider).
-    Append-only by contract — the consumer tracks byte offsets."""
-    with open(path, "a", encoding="utf-8") as f:
-        for d, p in zip(series.dates, series.prices):
-            f.write(f"{float(p)}, {d}\n")
+    Append-only by contract — the consumer tracks byte offsets.
+
+    Concurrent-writer guard (same contract as the framed journal's): the
+    flock'd ``.lock`` is held for the duration of the append and
+    raises :class:`~sharetrade_tpu.data.journal.JournalLockError` when
+    another LIVE process is mid-append on the same feed — two producers
+    interleaving partial lines would corrupt rows in a way the parser can
+    only drop, not detect. A dead writer's flock dies with it. FIFOs
+    are exempt: the kernel serializes sub-PIPE_BUF writes there, and a
+    lockfile next to a FIFO consumer would outlive the pipe's semantics."""
+    import stat as stat_mod
+
+    from sharetrade_tpu.data.journal import (
+        acquire_writer_lock, release_writer_lock)
+    try:
+        is_fifo = stat_mod.S_ISFIFO(os.stat(path).st_mode)
+    except FileNotFoundError:
+        is_fifo = False
+    if not is_fifo:
+        acquire_writer_lock(path)
+    try:
+        with open(path, "a", encoding="utf-8") as f:
+            for d, p in zip(series.dates, series.prices):
+                f.write(f"{float(p)}, {d}\n")
+    finally:
+        if not is_fifo:
+            release_writer_lock(path)
 
 
 def synthetic_provider(length: int = 6046, seed: int = 1992) -> Callable[..., PriceSeries]:

@@ -54,6 +54,20 @@ def encode_transitions(obs, action, reward, next_obs,
     ])
 
 
+def peek_transitions_header(payload: bytes):
+    """``(batch, obs_dim, env_steps)`` from a transition payload WITHOUT
+    materializing the arrays — same well-formedness checks as
+    :func:`decode_transitions` (a record the peek accepts, decode
+    accepts). The ingest reader's steady no-new-rows tick rides this:
+    stamping out old records must not cost a full array decode."""
+    if len(payload) < _HEAD.size or payload[:4] != MAGIC:
+        return None
+    _magic, batch, obs_dim, env_steps = _HEAD.unpack_from(payload)
+    if len(payload) != _HEAD.size + (obs_dim * 8 + 8) * batch:
+        return None
+    return batch, obs_dim, env_steps
+
+
 def decode_transitions(payload: bytes):
     """Inverse of :func:`encode_transitions`.
 
@@ -108,18 +122,31 @@ def read_tail_transitions(path: str, max_rows: int, *,
         flush()
     from sharetrade_tpu.data.journal import segment_paths
     seals = segment_paths(path)
-    if seals:
-        # Segmented journal (data.journal_segment_records): walk the TAIL
-        # segments only — newest first, stopping once the kept rows cover
-        # max_rows — instead of scanning the whole history. env_steps
-        # stamps are monotone in append order (the orchestrator's
-        # high-water guard), so the high-water mark recovered from the
-        # scanned tail IS the global one.
-        return _read_tail_paths([*seals, path], max_rows, cutoff_env_steps)
-    native = _native_read_tail(path, max_rows, cutoff_env_steps)
-    if native is not NotImplemented:
-        return native
-    return _python_read_tail(path, max_rows, cutoff_env_steps)
+    if not seals:
+        native = _native_read_tail(path, max_rows, cutoff_env_steps)
+        if native is not NotImplemented:
+            return native
+        return _python_read_tail(path, max_rows, cutoff_env_steps)
+    # Segmented journal (data.journal_segment_records): walk the TAIL
+    # segments only — newest first, stopping once the kept rows cover
+    # max_rows — instead of scanning the whole history. env_steps
+    # stamps are monotone in append order (the orchestrator's
+    # high-water guard), so the high-water mark recovered from the
+    # scanned tail IS the global one. The snapshot must be STABLE
+    # across the walk: a LIVE writer rotating between the listing and
+    # the active-file read seals a segment this walk never visits, and
+    # the recovered high-water regresses (observed as a negative
+    # high-water delta in the scaling bench) — re-list and retry until
+    # the segment set held still.
+    for _ in range(6):
+        out = _read_tail_paths([*seals, path], max_rows, cutoff_env_steps)
+        reseals = segment_paths(path)
+        if reseals == seals:
+            return out
+        seals = reseals
+    # Rotation outpaced every snapshot (a pathologically fast writer);
+    # recovery callers read quiescent journals, so serve the last walk.
+    return out
 
 
 def _native_read_tail(path, max_rows, cutoff):
@@ -168,10 +195,18 @@ def _read_tail_paths(paths, max_rows, cutoff):
     seen_any = False
     for path in reversed(paths):          # newest file first
         recs = []
-        for _offset, payload in iter_framed_records(path):
-            decoded = decode_transitions(payload)
-            if decoded is not None:
-                recs.append(decoded)
+        try:
+            for _offset, payload in iter_framed_records(path):
+                decoded = decode_transitions(payload)
+                if decoded is not None:
+                    recs.append(decoded)
+        except FileNotFoundError:
+            # Rotation race on a LIVE writer's journal (the soak's
+            # high-water probe reads under a rolling-out actor): the
+            # active file was sealed-and-recreated between the existence
+            # check and the open; its rows are in the newest sealed
+            # segment, which this walk reads next.
+            continue
         if recs:
             seen_any = True
             high_water = max(high_water, max(r[4] for r in recs))
@@ -199,6 +234,125 @@ def _read_tail_paths(paths, max_rows, cutoff):
                 np.zeros((0,), np.int32), np.zeros((0,), np.float32),
                 np.zeros((0, obs_dim), np.float32), high_water)
     kept.reverse()                        # oldest-first
+    obs = np.concatenate([r[0] for r in kept])
+    action = np.concatenate([r[1] for r in kept])
+    reward = np.concatenate([r[2] for r in kept])
+    next_obs = np.concatenate([r[3] for r in kept])
+    return obs, action, reward, next_obs, high_water
+
+
+def read_new_transitions(path: str, floor_env_steps: int, max_rows: int):
+    """The learner-side INGEST read (actor/learner disaggregation): the
+    records with ``env_steps`` stamps STRICTLY ABOVE ``floor_env_steps`` —
+    the complement of :func:`read_tail_transitions`'s resume cutoff. The
+    learner keeps a per-actor cursor (the last stamp it ingested) and each
+    ingest tick consumes exactly the rows the actor committed since.
+
+    Stamps are monotone in append order (each actor stamps its own
+    monotone env-step counter, recovered across its own restarts from the
+    journal high-water), so the walk is bounded the same way the recovery
+    tail is: files are scanned newest-first and the descent stops at the
+    first file whose newest record is already at or below the floor —
+    older files cannot hold newer stamps. ``max_rows`` caps the kept rows
+    at whole-record granularity, keeping the OLDEST above-floor records
+    so the backlog streams across ticks; the returned high-water is the
+    max stamp over the KEPT records (the scanned tail when nothing was
+    capped), so advancing the cursor to it never skips a committed row —
+    capped-out newer rows are simply next tick's read. Returns
+    ``(obs, action, reward, next_obs, high_water)`` or ``None`` when no
+    transition records exist.
+
+    The segment snapshot must hold STILL across the walk: the actor
+    rotating between the listing and the active-file read seals a
+    segment the walk never visits while the NEW active file may already
+    hold higher stamps — advancing the cursor to them would skip the
+    sealed rows forever. Re-list and retry; if the set never stabilizes,
+    report nothing new (high-water == floor) so the next tick retries
+    rather than skip."""
+    from sharetrade_tpu.data.journal import segment_paths
+    seals = segment_paths(path)
+    for _ in range(6):
+        out = _read_new_paths([*seals, path], floor_env_steps, max_rows)
+        reseals = segment_paths(path)
+        if reseals == seals:
+            return out
+        seals = reseals
+    if out is None:
+        return None
+    obs_dim = out[0].shape[1]
+    return (np.zeros((0, obs_dim), np.float32),
+            np.zeros((0,), np.int32), np.zeros((0,), np.float32),
+            np.zeros((0, obs_dim), np.float32), floor_env_steps)
+
+
+def _read_new_paths(paths, floor_env_steps, max_rows):
+    kept, rows, obs_dim, high_water = [], 0, None, 0
+    seen_any = False
+    for p in reversed(paths):             # newest file first
+        # Header-only scan first: in the steady no-new-rows case (idle,
+        # caught-up, or dead actor) every record stamps at or below the
+        # floor, and a full array decode per record per ingest tick
+        # would be pure waste — stamps live in the record header.
+        heads = []
+        try:
+            for _offset, payload in iter_framed_records(p):
+                head = peek_transitions_header(payload)
+                if head is not None:
+                    heads.append((head, payload))
+        except FileNotFoundError:
+            # Rotation race on a LIVE writer's journal: the active file
+            # is renamed aside and re-created between our existence check
+            # and the open. The caller's stable-snapshot retry re-walks
+            # with the sealed segment included.
+            continue
+        if heads:
+            seen_any = True
+            high_water = max(high_water,
+                             max(h[2] for h, _payload in heads))
+            if obs_dim is None:
+                obs_dim = heads[-1][0][1]
+        satisfied = not heads and seen_any
+        for (batch, rec_dim, stamp), payload in reversed(heads):
+            if stamp <= floor_env_steps:
+                # Monotone stamps: everything at or before this record —
+                # in this file and in every older file — is already
+                # ingested; the descent stops here.
+                satisfied = True
+                break
+            if rec_dim != obs_dim:
+                continue
+            rec = decode_transitions(payload)
+            if rec is None:               # peek-accepted implies decodes
+                continue
+            kept.append(rec)
+            rows += batch
+        if satisfied:
+            # NOTE: a max_rows cap must NOT stop the descent — the
+            # unscanned records are the OLDEST above-floor ones, exactly
+            # the rows the cap keeps (see below).
+            break
+    if not seen_any:
+        return None
+    if not kept:
+        return (np.zeros((0, obs_dim), np.float32),
+                np.zeros((0,), np.int32), np.zeros((0,), np.float32),
+                np.zeros((0, obs_dim), np.float32), high_water)
+    kept.reverse()                        # oldest-first
+    if max_rows and rows > max_rows:
+        # Over-cap backlog: keep the OLDEST records up to the cap (whole
+        # records — a stamp is per-record, so splitting one would make
+        # the cursor ambiguous) and report the high-water of the KEPT
+        # tail only. Keeping the newest instead would advance the cursor
+        # past the dropped older rows and skip them FOREVER; this way
+        # the next tick resumes exactly where this one stopped.
+        capped, capped_rows = [], 0
+        for rec in kept:
+            if capped and capped_rows + rec[0].shape[0] > max_rows:
+                break
+            capped.append(rec)
+            capped_rows += rec[0].shape[0]
+        kept = capped
+        high_water = max(r[4] for r in kept)
     obs = np.concatenate([r[0] for r in kept])
     action = np.concatenate([r[1] for r in kept])
     reward = np.concatenate([r[2] for r in kept])

@@ -1,0 +1,22 @@
+"""Disaggregated actor/learner topology — the reference's ten-worker
+actor system (TrainerRouterActor.scala:36) run as separate OS-process
+failure domains (ROADMAP item 1; MSRL's per-fragment restart property,
+arxiv 2210.00882; Podracer's Sebulba actor/learner split, arxiv
+2104.06272).
+
+- :mod:`sharetrade_tpu.distrib.actor` — the rollout-actor process body
+  (``cli actor``): verified-restore weights from ``tag_best``, epsilon-
+  greedy episode rollouts, per-actor transitions journal, heartbeat.
+- :mod:`sharetrade_tpu.distrib.pool` — the :class:`ActorPool` supervisor:
+  spawns/reaps/respawns actor subprocesses under the PR-5/PR-10
+  supervision contract at process granularity, with elastic membership
+  (``scale``) against a live learner.
+
+The learner side lives in ``runtime/orchestrator.py``
+(``ingest_actor_feeds``): the training loop tails every actor journal
+between megachunks and splices the new rows into its device replay
+buffer — actors die and rejoin without the learner ever restarting.
+"""
+
+from sharetrade_tpu.distrib.actor import RolloutActor, write_heartbeat  # noqa: F401
+from sharetrade_tpu.distrib.pool import ActorPool, read_status  # noqa: F401

@@ -1,0 +1,344 @@
+"""The rollout-actor process body (``cli actor``) — one failure domain.
+
+The reference's worker actors roll out episodes against a shared learner
+(TrainerRouterActor broadcasts StartTraining to ten TrainerChildActors);
+here each worker is a whole OS process that:
+
+- restores its policy weights from the learner's ``tag_best`` through the
+  VERIFIED restore path (checkpoint/manager.py checksums + finite check +
+  precision-mode check) and keeps them fresh via the serve tier's
+  :class:`~sharetrade_tpu.serve.swap.WeightSwapWatcher` — a corrupt
+  candidate is refused-not-fatal and the actor keeps rolling out on its
+  current weights;
+- rolls out epsilon-greedy episodes with EXACTLY the DQN agent's rollout
+  semantics (quarantine mask, horizon freeze, epsilon ramp over the
+  actor's cumulative env-step count) but NO updates — the learner owns
+  the gradient;
+- appends its transitions to its OWN journal through the PR-9 data plane
+  (CRC-framed records via data/transitions.py, segment rotation +
+  retirement, flock'd writer lock — one journal per actor, so a
+  concurrent-writer torn record is impossible by construction);
+- stamps a heartbeat file the supervising :class:`ActorPool` reads for
+  liveness/ages, and drains on SIGTERM the way ``cli train`` does
+  (journal flush + final heartbeat, exit 75).
+
+Stamps are the actor's cumulative env-step counter, recovered from its
+journal's high-water mark at boot so they stay MONOTONE across actor
+restarts — the property the learner's per-actor ingest cursor
+(``read_new_transitions``) and the soak's lost-row checks rely on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sharetrade_tpu.config import ConfigError, FrameworkConfig
+from sharetrade_tpu.utils.logging import get_logger
+
+log = get_logger("distrib.actor")
+
+HEARTBEAT_FILE = "heartbeat.json"
+TRANSITIONS_FILE = "transitions.journal"
+
+
+def write_heartbeat(path: str, **fields: Any) -> None:
+    """Atomically rewrite the actor's heartbeat stamp (wall time + rollout
+    progress). A transient health stamp, not durable state: no fsync —
+    the pool tolerates a lost-on-power-loss heartbeat (the actor process
+    is gone too and the reap path owns that case)."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"time": time.time(), **fields}, f)
+    os.replace(tmp, path)
+
+
+def read_heartbeat(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def make_rollout_chunk(model, env, lcfg, num_agents: int,
+                       chunk_steps: int, precision):
+    """One jitted rollout chunk: ``chunk_steps`` epsilon-greedy env steps
+    over ``num_agents`` vectorized rows, transitions stacked ``(T, B)``.
+    Mirrors the DQN agent's ``one_step`` rollout half verbatim (quarantine
+    mask, horizon freeze, epsilon ramp over env_steps) minus the update —
+    an actor-produced transition is distributionally the transition the
+    integrated agent would have journaled."""
+    from sharetrade_tpu.agents.base import epsilon_greedy, quarantine_mask
+    from sharetrade_tpu.models.core import apply_batched
+    horizon = env.num_steps
+
+    def chunk(params, env_state, rng, env_steps):
+        params_c = precision.cast_compute(params)
+
+        def one(carry, _):
+            env_state, rng, env_steps = carry
+            rng, k_act = jax.random.split(rng)
+            act_keys = jax.random.split(k_act, num_agents)
+            obs_raw = jax.vmap(env.observe)(env_state)
+            healthy = quarantine_mask(obs_raw, env_state)
+            active = (env_state.t < horizon) & healthy
+            obs = jnp.where(healthy[:, None], obs_raw, 0.0)
+            outs, _ = apply_batched(model, params_c, obs, ())
+            actions = jax.vmap(
+                lambda k, q: epsilon_greedy(k, q, env_steps, lcfg))(
+                    act_keys, outs.logits)
+            stepped, rewards = jax.vmap(env.step)(env_state, actions)
+            env_state = jax.tree.map(
+                lambda new, old: jnp.where(
+                    active.reshape((-1,) + (1,) * (new.ndim - 1)),
+                    new, old),
+                stepped, env_state)
+            rewards = jnp.where(active, rewards, 0.0)
+            next_obs = jnp.where(
+                healthy[:, None], jax.vmap(env.observe)(env_state), 0.0)
+            env_steps = env_steps + jnp.where(jnp.any(active), 1, 0)
+            return ((env_state, rng, env_steps),
+                    (obs, actions, rewards, next_obs, active))
+
+        (env_state, rng, env_steps), tr = jax.lax.scan(
+            one, (env_state, rng, env_steps), None, length=chunk_steps)
+        # min cursor over rows: horizon-complete detection without a
+        # second readback (== horizon means every row finished its
+        # episode and the host re-arms a fresh one).
+        return env_state, rng, env_steps, jnp.min(env_state.t), tr
+
+    return jax.jit(chunk)
+
+
+class RolloutActor:
+    """One rollout actor: policy forwards only, transitions out, weights
+    in. Built from the same config the learner runs so env/model/precision
+    agree with the checkpoints it restores."""
+
+    def __init__(self, cfg: FrameworkConfig, prices, *, actor_id: str,
+                 workdir: str):
+        if not actor_id or not all(
+                c.isalnum() or c in "-_" for c in actor_id):
+            raise ConfigError(f"bad actor id {actor_id!r} "
+                              "(alphanumeric/-/_ only)")
+        self.cfg = cfg
+        self.actor_id = actor_id
+        self.workdir = workdir
+        os.makedirs(workdir, exist_ok=True)
+        self.heartbeat_path = os.path.join(workdir, HEARTBEAT_FILE)
+
+        from sharetrade_tpu.agents import build_agent
+        from sharetrade_tpu.env import trading
+        from sharetrade_tpu.env.portfolio import make_portfolio_env
+        from sharetrade_tpu.precision import policy_from_config
+        prices = np.asarray(prices)
+        if prices.ndim == 2 and prices.shape[0] > 1:
+            self.env = make_portfolio_env(
+                prices, window=cfg.env.window,
+                initial_budget=cfg.env.initial_budget,
+                initial_shares=cfg.env.initial_shares)
+        else:
+            self.env = trading.make_trading_env(
+                prices.reshape(-1), window=cfg.env.window,
+                initial_budget=cfg.env.initial_budget,
+                initial_shares=cfg.env.initial_shares)
+        # The FULL agent is built only for its model + checkpoint template
+        # (the TrainState pytree tag_best deserializes into — the same
+        # template a --resume or cli serve uses); the agent's step/update
+        # machinery is never called here.
+        self._agent = build_agent(cfg, self.env)
+        self._precision = policy_from_config(cfg.precision)
+        # Per-actor seed: distinct exploration streams per actor, stable
+        # across restarts of the same actor id.
+        import zlib
+        self._seed = cfg.seed + 101 + (
+            zlib.crc32(actor_id.encode()) % 100003)
+        self._template = self._agent.init(jax.random.PRNGKey(self._seed))
+
+        # Per-actor transitions journal (Python backend: writer lock +
+        # segment rotation; the native single-file writer has neither).
+        from sharetrade_tpu.data.journal import Journal
+        self.journal_path = os.path.join(workdir, TRANSITIONS_FILE)
+        self._journal = Journal(
+            self.journal_path,
+            fsync_every_records=cfg.data.journal_fsync_every_records,
+            fsync_interval_s=cfg.data.journal_fsync_interval_s,
+            segment_records=cfg.data.journal_segment_records)
+        # Monotone-stamp recovery: continue the env-step counter from the
+        # journal's high-water so a respawned actor never reuses a stamp
+        # (the learner's ingest cursor and the epsilon ramp both ride it).
+        from sharetrade_tpu.data.transitions import read_tail_transitions
+        tail = read_tail_transitions(self.journal_path, 1,
+                                     journal=self._journal)
+        self._env_steps0 = int(tail[4]) if tail is not None else 0
+        self._rows_since_retire = 0
+
+        # Weight flow: boot from tag_best -> latest step -> fresh init
+        # (loud), then keep fresh via the verified-restore swap watcher.
+        from sharetrade_tpu.checkpoint.manager import CheckpointManager
+        self._manager = CheckpointManager(
+            cfg.runtime.checkpoint_dir, keep=cfg.runtime.keep_checkpoints,
+            fsync=cfg.checkpoint.fsync, precision_mode=cfg.precision.mode)
+        self.registry = None        # duck-typed for WeightSwapWatcher
+        self._params_lock = threading.Lock()
+        self._pending: tuple[Any, int] | None = None
+        self.params, self.params_step, self._boot_meta = self._boot_params()
+        self._watcher = None
+        self.episodes = 0
+        self.chunks = 0
+        self.rows_journaled = 0
+        self.swaps_applied = 0
+
+        chunk_steps = (cfg.distrib.actor_chunk_steps
+                       or cfg.runtime.chunk_steps)
+        self._chunk_fn = make_rollout_chunk(
+            self._agent.model, self.env, cfg.learner,
+            cfg.parallel.num_workers, chunk_steps, self._precision)
+
+    # -- WeightSwapWatcher engine surface ------------------------------
+
+    def swap_params(self, params, step: int) -> None:
+        """Stage freshly-verified weights; the rollout loop installs them
+        at its next chunk boundary (no mid-chunk weight mix — the chunk's
+        program closed over its params argument when it dispatched)."""
+        with self._params_lock:
+            self._pending = (params, int(step))
+
+    def _boot_params(self):
+        tag = "best"
+        try:
+            state, meta = self._manager.restore_tagged(self._template, tag)
+            return (state.params,
+                    int(meta.get("updates", meta.get("step", 0)) or 0),
+                    meta)
+        except FileNotFoundError:
+            pass
+        except Exception as exc:        # refusal-not-fatal, like serve
+            log.warning("actor %s: tag_%s boot restore refused (%s: %s); "
+                        "falling back", self.actor_id, tag,
+                        type(exc).__name__, exc)
+        try:
+            state, step = self._manager.restore(self._template)
+            return state.params, int(step), None
+        except FileNotFoundError:
+            log.warning("actor %s: no checkpoint under %s; rolling out a "
+                        "fresh-initialized (UNTRAINED) policy",
+                        self.actor_id, self._manager.directory)
+            return self._template.params, 0, None
+
+    # ------------------------------------------------------------------
+
+    def run(self, stop: threading.Event, *,
+            max_chunks: int = 0) -> dict[str, Any]:
+        """The actor loop: rollout chunk -> journal append -> heartbeat,
+        until ``stop`` is set (or ``max_chunks`` chunks for tests).
+        Returns a summary dict. Never raises out of a single bad poll of
+        the weight watcher (its thread catches); a rollout/journal fault
+        does propagate — the POOL is the supervisor that restarts this
+        process, exactly the contract under test."""
+        cfg = self.cfg
+        from sharetrade_tpu.agents.base import batched_reset
+        from sharetrade_tpu.data.transitions import append_transitions
+        from sharetrade_tpu.serve.swap import WeightSwapWatcher
+        if cfg.distrib.weight_poll_s > 0:
+            self._watcher = WeightSwapWatcher(
+                self, self._manager, self._template, tag="best",
+                poll_s=cfg.distrib.weight_poll_s,
+                seen_meta=self._boot_meta,
+                breaker_failures=cfg.serve.swap_breaker_failures,
+                breaker_cooldown_s=cfg.serve.swap_breaker_cooldown_s,
+            ).start()
+        num_agents = cfg.parallel.num_workers
+        horizon = self.env.num_steps
+        env_state = batched_reset(self.env, num_agents)
+        rng = jax.random.PRNGKey(self._seed + 1)
+        env_steps = jnp.int32(self._env_steps0)
+        hb_every = max(cfg.distrib.heartbeat_interval_s, 0.05)
+        last_hb = 0.0
+        self._heartbeat(env_steps=self._env_steps0, phase="starting")
+        try:
+            while not stop.is_set():
+                with self._params_lock:
+                    if self._pending is not None:
+                        self.params, self.params_step = self._pending
+                        self._pending = None
+                        self.swaps_applied += 1
+                env_state, rng, env_steps, min_t, tr = self._chunk_fn(
+                    self.params, env_state, rng, env_steps)
+                stamp = int(env_steps)
+                self._journal_chunk(tr, stamp, append_transitions)
+                self.chunks += 1
+                if int(min_t) >= horizon:
+                    # Every row finished its episode: re-arm a fresh one
+                    # (cumulative env_steps keeps the epsilon ramp — the
+                    # Initialise->Train cycle at actor granularity).
+                    self.episodes += 1
+                    env_state = batched_reset(self.env, num_agents)
+                now = time.monotonic()
+                if now - last_hb >= hb_every:
+                    last_hb = now
+                    self._heartbeat(env_steps=stamp, phase="rolling")
+                if max_chunks and self.chunks >= max_chunks:
+                    break
+        finally:
+            if self._watcher is not None:
+                self._watcher.stop()
+            # Drain: every acked append durable, then the terminal stamp.
+            self._journal.flush()
+            self._journal.close()
+            self._heartbeat(env_steps=int(env_steps), phase="drained")
+        return self.summary(int(env_steps))
+
+    def _journal_chunk(self, tr, stamp: int, append_transitions) -> None:
+        """Host side of one chunk: ONE batched readback of the stacked
+        (T, B) transition buffers, valid rows flattened and appended as a
+        single packed record stamped with the chunk-end env-step count."""
+        obs, actions, rewards, next_obs, active = jax.device_get(tr)
+        valid = np.asarray(active).reshape(-1)
+        if not valid.any():
+            return
+        flat = lambda a: np.asarray(a).reshape(  # noqa: E731
+            (-1,) + np.asarray(a).shape[2:])
+        append_transitions(
+            self._journal, flat(obs)[valid], flat(actions)[valid],
+            flat(rewards)[valid], flat(next_obs)[valid], env_steps=stamp)
+        n = int(valid.sum())
+        self.rows_journaled += n
+        self._rows_since_retire += n
+        capacity = self.cfg.learner.replay_capacity
+        if (self.cfg.data.journal_segment_records > 0
+                and self._rows_since_retire >= capacity):
+            # Bounded per-actor disk: same 2x-capacity horizon as the
+            # learner's own journal (PR-9 retirement).
+            from sharetrade_tpu.data.transitions import (
+                retire_transition_segments)
+            retire_transition_segments(self._journal, 2 * capacity)
+            self._rows_since_retire = 0
+
+    def _heartbeat(self, *, env_steps: int, phase: str) -> None:
+        write_heartbeat(
+            self.heartbeat_path, pid=os.getpid(), actor_id=self.actor_id,
+            env_steps=env_steps, episodes=self.episodes,
+            chunks=self.chunks, rows=self.rows_journaled,
+            params_step=self.params_step, phase=phase)
+
+    def summary(self, env_steps: int) -> dict[str, Any]:
+        return {
+            "actor_id": self.actor_id,
+            "env_steps": env_steps,
+            "episodes": self.episodes,
+            "chunks": self.chunks,
+            "rows_journaled": self.rows_journaled,
+            "params_step": self.params_step,
+            "swaps_applied": self.swaps_applied,
+            "swaps_rejected": (self._watcher.rejected
+                               if self._watcher is not None else 0),
+        }

@@ -257,6 +257,33 @@ def summarize_run_dir(run_dir: str) -> dict:
                 "journal_compacted_bytes_total": counters.get(
                     "journal_compacted_bytes_total", 0.0),
             }
+        if any(k.startswith(("actors_", "actor_", "distrib_"))
+               for k in list(gauges) + list(counters)):
+            # Actor/learner disaggregation (distrib/): pool membership,
+            # supervision counters, per-actor ingest volume and heartbeat
+            # ages in one glanceable block — the operator's "is the fleet
+            # healthy and is the learner actually eating its output"
+            # answer without knowing the registry keys.
+            per_actor_rows = {
+                k[len("actor_rows_ingested_total_"):]: v
+                for k, v in counters.items()
+                if k.startswith("actor_rows_ingested_total_")}
+            heartbeat_ages = {
+                k[len("actor_heartbeat_age_s_"):]: round(v, 3)
+                for k, v in gauges.items()
+                if k.startswith("actor_heartbeat_age_s_")}
+            out["actors"] = {
+                "alive": gauges.get("actors_alive"),
+                "failed": gauges.get("actors_failed"),
+                "backoff": gauges.get("actors_backoff"),
+                "restarts_total": counters.get(
+                    "actor_restarts_total", 0.0),
+                "rows_ingested_total": counters.get(
+                    "distrib_rows_ingested_total", 0.0),
+                "feeds": gauges.get("distrib_actor_feeds"),
+                "rows_ingested_by_actor": per_actor_rows,
+                "heartbeat_age_s": heartbeat_ages,
+            }
         if any(k.startswith("serve_") for k in list(gauges)
                + list(counters)):
             # Serving tier (``cli serve`` run dirs): the SLO surface in

@@ -255,6 +255,20 @@ class Orchestrator:
         self._transitions_journal = None
         self._journal_high_water = 0  # env_steps already journaled
         self._journal_rows_since_compact = 0
+        # Actor/learner disaggregation (distrib/): the learner tails every
+        # actor's transitions journal between megachunks and splices the
+        # new rows into its device replay buffer — per-actor cursors are
+        # the last-ingested env-step stamps (monotone per journal, so a
+        # restarted actor resumes cleanly past them). DQN-only: the other
+        # algos have no replay buffer to feed.
+        self._actor_cursors: dict[str, int] = {}
+        self._last_ingest_updates = 0
+        # num_actors gates too: with no pool (plain ``cli train``) the
+        # cadence must not force pipeline-drain boundaries every
+        # ingest_every_updates just to glob an empty actors dir.
+        self._ingest_enabled = (cfg.distrib.num_actors > 0
+                                and cfg.distrib.ingest_every_updates > 0
+                                and cfg.learner.algo == "dqn")
         if cfg.learner.algo == "dqn" and cfg.learner.journal_replay:
             import os
             from sharetrade_tpu.data.service import _open_journal
@@ -1184,6 +1198,10 @@ class Orchestrator:
         for every in (rt.eval_every_updates, rt.checkpoint_every_updates):
             if every > 0 and updates // every > last // every:
                 return True
+        if self._ingest_enabled:
+            every = self.cfg.distrib.ingest_every_updates
+            if updates // every > self._last_ingest_updates // every:
+                return True
         return (int(row.get("env_steps", 0))
                 >= self.env.num_steps * (self.episode + 1))
 
@@ -1229,6 +1247,20 @@ class Orchestrator:
                                "(shared state poisoned)")
 
         updates = int(metrics.get("updates", 0))
+        if (self._ingest_enabled
+                and updates // self.cfg.distrib.ingest_every_updates
+                > self._last_ingest_updates
+                // self.cfg.distrib.ingest_every_updates):
+            # Actor-feed ingest (distrib/): contained like the periodic
+            # eval below — a torn actor journal or a transient read error
+            # is an ingest miss, not a training fault; the next cadence
+            # tick retries from the same cursors.
+            try:
+                self.ingest_actor_feeds()
+            except Exception:
+                log.exception("actor-feed ingest failed; "
+                              "training continues")
+            self._last_ingest_updates = updates
         if (rt.eval_every_updates > 0
                 and updates // rt.eval_every_updates
                 > self._last_ckpt_updates // rt.eval_every_updates):
@@ -1602,6 +1634,89 @@ class Orchestrator:
             self.metrics.record(
                 "journal_segments",
                 len(segment_paths(self._transitions_journal.path)) + 1)
+
+    def ingest_actor_feeds(self) -> int:
+        """Feed-driven ingest — the learner half of actor/learner
+        disaggregation (distrib/): tail every actor's transitions journal
+        under ``distrib.actor_dir`` for rows STAMPED past the per-actor
+        cursor, splice them into the live device replay buffer
+        (oldest-first circular pushes, exactly the ``_warm_start_replay``
+        fill path), and reseed PER priorities at the stored max (the
+        priorities were never journaled — same contract as a resume).
+
+        Membership is ELASTIC by construction: the journal set is
+        re-discovered from the filesystem every call, so an actor that
+        joined mid-run starts being ingested at its first committed
+        record and a dead actor simply stops producing — the learner
+        never needs to know the pool's membership, only its data. Runs on
+        the dispatcher thread at a drained boundary (``_boundary_actions``
+        cadence ``distrib.ingest_every_updates``), so no dispatch is in
+        flight; the step lock fences ``evaluate()`` racers exactly like
+        every other state mutation. Returns the rows ingested."""
+        if not self._ingest_enabled or self._ts is None:
+            return 0
+        import glob
+        import os
+        from sharetrade_tpu.agents.dqn import (
+            fill_replay_from_arrays, reseed_per_priorities)
+        from sharetrade_tpu.data.transitions import read_new_transitions
+        from sharetrade_tpu.distrib.actor import TRANSITIONS_FILE
+        root = self.cfg.distrib.actor_dir
+        max_rows = (self.cfg.distrib.ingest_max_rows
+                    or self.cfg.learner.replay_capacity)
+        total = 0
+        per_actor: dict[str, int] = {}
+        for path in sorted(glob.glob(
+                os.path.join(root, "*", TRANSITIONS_FILE))):
+            actor_id = os.path.basename(os.path.dirname(path))
+            cursor = self._actor_cursors.get(actor_id, 0)
+            try:
+                out = read_new_transitions(path, cursor, max_rows)
+            except OSError:
+                log.exception("actor feed %s unreadable; skipping this "
+                              "ingest tick", path)
+                continue
+            if out is None:
+                continue
+            obs, action, reward, next_obs, high_water = out
+            rows = int(obs.shape[0])
+            if rows:
+                if obs.shape[1] != self.env.obs_dim:
+                    log.error(
+                        "actor feed %s obs_dim %d != learner obs_dim %d; "
+                        "refusing the rows (actor running a different "
+                        "env config?)", path, obs.shape[1],
+                        self.env.obs_dim)
+                    self._actor_cursors[actor_id] = max(cursor, high_water)
+                    continue
+                with self._step_lock:
+                    extras = self._ts.extras
+                    extras = extras.replace(
+                        replay=fill_replay_from_arrays(
+                            extras.replay, obs, action, reward, next_obs))
+                    self._ts = self._ts.replace(extras=extras)
+                total += rows
+                per_actor[actor_id] = rows
+                self.metrics.inc(
+                    f"actor_rows_ingested_total_{actor_id}", rows)
+            # The cursor advances to the scanned high-water even when no
+            # rows were kept (all filtered): stamps are monotone, so
+            # nothing committed is ever skipped by advancing.
+            self._actor_cursors[actor_id] = max(cursor, high_water)
+        if total:
+            with self._step_lock:
+                # ONE tree rebuild per ingest tick, not per journal
+                # (no-op for uniform extras).
+                self._ts = self._ts.replace(
+                    extras=reseed_per_priorities(self._ts.extras))
+            self.metrics.inc("distrib_rows_ingested_total", total)
+            self.metrics.record("distrib_actor_feeds", len(per_actor))
+            self.events.emit("actor_feed_ingest", rows=total,
+                             actors=sorted(per_actor))
+            log.info("ingested %d actor transition rows (%s)", total,
+                     ", ".join(f"{k}:{v}"
+                               for k, v in sorted(per_actor.items())))
+        return total
 
     def _warm_start_replay(self, state: TrainState) -> TrainState:
         """Rebuild the DQN replay buffer from the transitions journal. The

@@ -1,3 +1,5 @@
+import os
+
 import numpy as np
 import pytest
 
@@ -94,6 +96,107 @@ def test_journal_survives_torn_tail(tmp_journal_path):
         assert [e["n"] for e in j.replay()] == [1]
         j.append({"n": 3})
         assert [e["n"] for e in j.replay()] == [1, 3]
+
+
+class TestWriterLock:
+    """Concurrent-writer guard (the disaggregation PR): a flock-held,
+    pid-stamped lockfile makes the torn-record scenario — two live
+    processes interleaving framed appends on one journal — impossible by
+    construction, while a SIGKILLed writer's lock releases with its
+    process (kernel flock, no sweep protocol to race)."""
+
+    def _foreign_holder(self, path):
+        """A real second PROCESS holding the writer lock on ``path``."""
+        import subprocess
+        import sys
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "import sys; sys.path.insert(0, sys.argv[2]); "
+             "from sharetrade_tpu.data.journal import acquire_writer_lock;"
+             "acquire_writer_lock(sys.argv[1]); print('locked', flush=True);"
+             "import time; time.sleep(60)",
+             path, os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__)))],
+            stdout=subprocess.PIPE, text=True)
+        assert proc.stdout.readline().strip() == "locked"
+        return proc
+
+    def test_second_live_writer_raises_loudly(self, tmp_journal_path):
+        from sharetrade_tpu.data.journal import JournalLockError
+        holder = self._foreign_holder(tmp_journal_path)
+        try:
+            with pytest.raises(JournalLockError):
+                Journal(tmp_journal_path)
+        finally:
+            holder.kill()
+            holder.wait(timeout=30)
+
+    def test_append_feed_rows_respects_live_lock(self, tmp_path):
+        from sharetrade_tpu.data.journal import JournalLockError
+        from sharetrade_tpu.data.service import append_feed_rows
+        feed = str(tmp_path / "prices.feed")
+        series = synthetic_price_series(symbol="T", length=4, seed=0)
+        holder = self._foreign_holder(feed)
+        try:
+            with pytest.raises(JournalLockError):
+                append_feed_rows(feed, series)
+        finally:
+            holder.kill()
+            holder.wait(timeout=30)
+        # Holder SIGKILLed: the kernel released its flock with it, so the
+        # append acquires and the stamp clears again on release.
+        append_feed_rows(feed, series)
+        with open(feed + ".lock") as f:
+            assert f.read() == ""
+
+    def test_sigkilled_writer_lock_releases_with_it(self,
+                                                    tmp_journal_path):
+        # The "stale lock" scenario: no sweep step exists to race — the
+        # dead writer's flock is simply gone, and a lingering pid stamp
+        # does not block the next writer.
+        holder = self._foreign_holder(tmp_journal_path)
+        holder.kill()
+        holder.wait(timeout=30)
+        with open(tmp_journal_path + ".lock") as f:
+            assert int(f.read()) == holder.pid     # stamp lingers...
+        with Journal(tmp_journal_path) as j:       # ...but does not hold
+            j.append({"n": 1})
+            with open(tmp_journal_path + ".lock") as f:
+                assert int(f.read()) == os.getpid()
+
+    def test_same_process_reopen_stays_legal(self, tmp_journal_path):
+        with Journal(tmp_journal_path) as j:
+            j.append({"n": 1})
+        with Journal(tmp_journal_path) as j:
+            assert [e["n"] for e in j.replay()] == [1]
+
+    def test_in_process_holds_are_refcounted(self, tmp_journal_path):
+        from sharetrade_tpu.data.journal import (
+            acquire_writer_lock, release_writer_lock)
+        with Journal(tmp_journal_path) as j:
+            # A second in-process hold (reader-side open) is legal, and
+            # ITS release must not drop the writer's lock mid-append.
+            acquire_writer_lock(tmp_journal_path)
+            release_writer_lock(tmp_journal_path)
+            j.append({"n": 1})
+            with open(tmp_journal_path + ".lock") as f:
+                assert int(f.read()) == os.getpid()   # still held
+        with open(tmp_journal_path + ".lock") as f:
+            assert f.read() == ""                      # now released
+
+    def test_release_of_unheld_path_is_a_noop(self, tmp_journal_path):
+        # Releasing a path THIS process never locked must not disturb
+        # another process's live lock.
+        from sharetrade_tpu.data.journal import (
+            JournalLockError, release_writer_lock)
+        holder = self._foreign_holder(tmp_journal_path)
+        try:
+            release_writer_lock(tmp_journal_path)
+            with pytest.raises(JournalLockError):
+                Journal(tmp_journal_path)              # still held
+        finally:
+            holder.kill()
+            holder.wait(timeout=30)
 
 
 class TestGroupCommit:

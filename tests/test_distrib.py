@@ -1,0 +1,383 @@
+"""ActorPool supervision-ladder unit tests (distrib/pool.py) — the
+PR-5/PR-10 contract at PROCESS granularity, driven deterministically.
+
+The kill soak (tests/test_actor_soak.py, tools/actor_soak.py) proves the
+topology end to end with real ``cli actor``/``cli learner`` processes;
+these tests pin the supervisor's LADDER with cheap stub children via the
+``spawn_fn`` hook (no jax bring-up): reap classification, seeded
+exponential backoff, the terminal FAILED state and graceful degrade,
+streak reset on a healthy heartbeat, elastic ``scale()`` both ways, the
+out-of-process ``scale`` control file, quiesce-on-preempt, the
+heartbeat-timeout wedge kill, and the status.json/gauge export the kill
+test reconciles against.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from sharetrade_tpu.config import ConfigError, FrameworkConfig
+from sharetrade_tpu.distrib.actor import (
+    HEARTBEAT_FILE, read_heartbeat, write_heartbeat)
+from sharetrade_tpu.distrib.pool import ActorPool, read_status
+
+
+def _sleeper(actor_id, workdir):
+    return subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)"])
+
+
+def _crasher(actor_id, workdir):
+    return subprocess.Popen([sys.executable, "-c", "raise SystemExit(3)"])
+
+
+def make_pool(tmp_path, spawn_fn, *, registry=None, **distrib):
+    cfg = FrameworkConfig()
+    cfg.distrib.actor_dir = str(tmp_path / "actors")
+    # The supervise thread must never race the test's poll_once() steps:
+    # park it on a first wait() longer than any test.
+    cfg.distrib.supervise_interval_s = 300.0
+    cfg.distrib.actor_backoff_jitter = 0.0
+    for key, value in distrib.items():
+        setattr(cfg.distrib, key, value)
+    return ActorPool(cfg, registry=registry, spawn_fn=spawn_fn)
+
+
+def wait_exit(pool, ids=None):
+    """Block until the named children (default: all) have actually
+    exited (a crasher's exit is asynchronous; the reap must not race
+    it)."""
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        if all(h.proc is None or h.proc.poll() is not None
+               for aid, h in pool._actors.items()
+               if ids is None or aid in ids):
+            return
+        time.sleep(0.01)
+    raise AssertionError("stub children did not exit in time")
+
+
+def stamp_rolling(pool, handle):
+    write_heartbeat(
+        os.path.join(pool.dir, handle.actor_id, HEARTBEAT_FILE),
+        pid=handle.pid, actor_id=handle.actor_id, env_steps=8,
+        episodes=0, chunks=1, rows=8, params_step=0, phase="rolling")
+
+
+@pytest.fixture
+def cleanup_pools():
+    pools = []
+    yield pools
+    for pool in pools:
+        pool.stop(grace_s=5.0)
+
+
+class TestSupervisionLadder:
+    def test_negative_restart_budget_rejected(self, tmp_path):
+        with pytest.raises(ConfigError):
+            make_pool(tmp_path, _sleeper, max_actor_restarts=-1)
+
+    def test_crash_backs_off_then_respawns(self, tmp_path, cleanup_pools):
+        pool = make_pool(tmp_path, _crasher, actor_backoff_initial_s=30.0,
+                         max_actor_restarts=5).start(1)
+        cleanup_pools.append(pool)
+        wait_exit(pool)
+        pool.poll_once()
+        (h,) = pool._actors.values()
+        assert h.state == "backoff"
+        assert h.last_rc == 3
+        assert pool.restarts_total == 1
+        assert h.respawn_at > time.monotonic()   # 30 s out, not yet due
+        pid_before = h.pid
+        pool.poll_once()                          # still inside backoff
+        assert h.state == "backoff" and h.pid == pid_before
+
+    def test_backoff_schedule_doubles_to_cap(self, tmp_path,
+                                             cleanup_pools):
+        pool = make_pool(tmp_path, _crasher, actor_backoff_initial_s=0.0,
+                         max_actor_restarts=10).start(1)
+        cleanup_pools.append(pool)
+        (h,) = pool._actors.values()
+        delays = []
+        for _ in range(4):
+            wait_exit(pool)
+            before = time.monotonic()
+            pool.poll_once()          # reap -> backoff (delay from streak)
+            delays.append(h.respawn_at - before)
+            pool.poll_once()          # 0-initial backoff: respawn now
+            assert h.state == "starting"
+        # initial_s=0 collapses every delay to 0 but the STREAK still
+        # climbed; re-run the math the pool used to prove the ladder.
+        assert [h.restarts, h.streak] == [4, 4]
+
+    def test_terminal_failure_degrades_onto_survivors(
+            self, tmp_path, cleanup_pools):
+        registry = _Registry()
+        pool = make_pool(tmp_path, _crasher, actor_backoff_initial_s=0.0,
+                         max_actor_restarts=2,
+                         registry=registry).start(1)
+        cleanup_pools.append(pool)
+        (h,) = pool._actors.values()
+        for _ in range(12):
+            if h.state == "failed":
+                break
+            wait_exit(pool)
+            pool.poll_once()
+        assert h.state == "failed"
+        assert h.streak == 3                      # budget 2, third strike
+        assert pool.counts()["failed"] == 1
+        pid_at_failure = h.pid
+        pool.poll_once()                          # a corpse never respawns
+        assert h.state == "failed" and h.pid == pid_at_failure
+        assert registry.counters["actor_restarts_total"] == 3.0
+        assert registry.gauges["actors_failed"] == 1.0
+
+    def test_rolling_heartbeat_resets_streak(self, tmp_path,
+                                             cleanup_pools):
+        # One crash, then the respawn proves healthy: the streak must
+        # reset so an occasional crash never accumulates to terminal.
+        pool = make_pool(tmp_path, _crasher, actor_backoff_initial_s=0.0,
+                         max_actor_restarts=5).start(1)
+        cleanup_pools.append(pool)
+        (h,) = pool._actors.values()
+        wait_exit(pool)
+        pool.poll_once()
+        pool.poll_once()
+        assert h.streak == 1 and h.state == "starting"
+        pool._spawn_fn = _sleeper
+        wait_exit(pool)
+        pool.poll_once()                          # crash 2 -> respawn as
+        pool.poll_once()                          # a healthy sleeper
+        assert h.streak == 2
+        stamp_rolling(pool, h)
+        pool.poll_once()
+        assert h.state == "alive" and h.streak == 0
+
+    def test_stale_previous_incarnation_heartbeat_ignored(
+            self, tmp_path, cleanup_pools):
+        # The dead incarnation's rolling stamp must not mark the fresh
+        # spawn healthy: _spawn_locked clears it, and the pid check
+        # guards the race besides.
+        pool = make_pool(tmp_path, _sleeper,
+                         actor_backoff_initial_s=0.0).start(1)
+        cleanup_pools.append(pool)
+        (h,) = pool._actors.values()
+        stamp_rolling(pool, h)
+        hb_path = os.path.join(pool.dir, h.actor_id, HEARTBEAT_FILE)
+        assert read_heartbeat(hb_path) is not None
+        h.proc.kill()
+        wait_exit(pool)
+        pool.poll_once()                          # crash -> backoff
+        pool.poll_once()                          # respawn (0 backoff)
+        assert read_heartbeat(hb_path) is None    # stamp wiped on spawn
+        pool.poll_once()
+        assert h.state == "starting"              # not falsely alive
+
+
+class TestElasticMembership:
+    def test_scale_up_and_down(self, tmp_path, cleanup_pools):
+        pool = make_pool(tmp_path, _sleeper).start(2)
+        cleanup_pools.append(pool)
+        assert pool.counts()["alive"] == 2
+        pool.scale(3)
+        assert pool.counts()["alive"] == 3
+        pool.scale(1)
+        retiring = [aid for aid, h in pool._actors.items()
+                    if h.state == "retiring"]
+        wait_exit(pool, retiring)                 # SIGTERM'd sleepers die
+        pool.poll_once()
+        counts = pool.counts()
+        assert counts["alive"] == 1 and counts["retired"] == 2
+        # Retiring exits are NOT crashes: no restart counted.
+        assert pool.restarts_total == 0
+
+    def test_scale_file_drives_live_pool(self, tmp_path, cleanup_pools):
+        pool = make_pool(tmp_path, _sleeper).start(1)
+        cleanup_pools.append(pool)
+        with open(os.path.join(pool.dir, "scale"), "w") as f:
+            f.write("3\n")
+        pool.poll_once()
+        assert pool.target == 3
+        assert pool.counts()["alive"] == 3
+        assert pool.scale_events == 1
+
+    def test_stale_scale_file_does_not_undo_api_scale(self, tmp_path,
+                                                      cleanup_pools):
+        # The control file is applied ONCE per written value: a lingering
+        # file must not re-override a later programmatic scale() on
+        # every supervise tick.
+        pool = make_pool(tmp_path, _sleeper).start(1)
+        cleanup_pools.append(pool)
+        with open(os.path.join(pool.dir, "scale"), "w") as f:
+            f.write("2")
+        pool.poll_once()
+        assert pool.target == 2
+        pool.scale(4)
+        pool.poll_once()                          # file still says 2
+        assert pool.target == 4
+        assert pool.counts()["alive"] == 4
+
+    def test_negative_scale_file_ignored(self, tmp_path, cleanup_pools):
+        pool = make_pool(tmp_path, _sleeper).start(1)
+        cleanup_pools.append(pool)
+        with open(os.path.join(pool.dir, "scale"), "w") as f:
+            f.write("-3")
+        pool.poll_once()                          # must not raise/spam
+        assert pool.target == 1
+
+    def test_failed_actor_excluded_from_target(self, tmp_path,
+                                               cleanup_pools):
+        # Replacing a corpse: scale(n) counts LIVE members only, so the
+        # same target respawns a fresh actor next to the failed one.
+        pool = make_pool(tmp_path, _crasher, actor_backoff_initial_s=0.0,
+                         max_actor_restarts=0).start(1)
+        cleanup_pools.append(pool)
+        wait_exit(pool)
+        pool.poll_once()
+        assert pool.counts()["failed"] == 1
+        pool._spawn_fn = _sleeper
+        pool.scale(1)
+        counts = pool.counts()
+        assert counts["alive"] == 1 and counts["failed"] == 1
+        assert len(pool._actors) == 2             # a0 corpse + a1 fresh
+
+    def test_quiesce_classifies_exits_as_retirement(
+            self, tmp_path, cleanup_pools):
+        pool = make_pool(tmp_path, _sleeper).start(2)
+        cleanup_pools.append(pool)
+        pool.quiesce()
+        for h in pool._actors.values():
+            h.proc.kill()
+        wait_exit(pool)
+        pool.poll_once()
+        assert pool.counts()["retired"] == 2
+        assert pool.restarts_total == 0           # a drain, not a storm
+
+    def test_quiesced_pool_refuses_scale(self, tmp_path, cleanup_pools):
+        # A scale request (or control-file write) landing inside the
+        # learner's drain window must not spawn fresh actors into a
+        # dying run.
+        pool = make_pool(tmp_path, _sleeper).start(1)
+        cleanup_pools.append(pool)
+        pool.quiesce()
+        pool.scale(3)
+        assert len(pool._actors) == 1
+        assert pool.scale_events == 0
+
+    def test_kill_all_leaves_no_live_children(self, tmp_path,
+                                              cleanup_pools):
+        # The hard-exit teardown (os._exit skips every finally): no
+        # actor may outlive it unsupervised.
+        pool = make_pool(tmp_path, _sleeper).start(3)
+        cleanup_pools.append(pool)
+        pids = [h.pid for h in pool._actors.values()]
+        pool.kill_all()
+        wait_exit(pool)
+        for pid in pids:
+            with pytest.raises(ProcessLookupError):
+                os.kill(pid, 0)
+        pool.poll_once()                          # ...and the reaps are
+        assert pool.restarts_total == 0           # drains, not crashes
+
+
+class TestHeartbeatTimeout:
+    def test_wedged_actor_killed_into_restart_ladder(
+            self, tmp_path, cleanup_pools):
+        pool = make_pool(tmp_path, _sleeper, heartbeat_timeout_s=5.0,
+                         actor_backoff_initial_s=30.0).start(1)
+        cleanup_pools.append(pool)
+        (h,) = pool._actors.values()
+        stamp_rolling(pool, h)
+        pool.poll_once()
+        assert h.state == "alive"
+        # Age the stamp past the timeout: presumed wedged, killed, and
+        # the DEATH feeds the normal crash ladder on the next reap.
+        hb_path = os.path.join(pool.dir, h.actor_id, HEARTBEAT_FILE)
+        hb = read_heartbeat(hb_path)
+        hb["time"] = time.time() - 60.0
+        with open(hb_path, "w") as f:
+            json.dump(hb, f)
+        pool.poll_once()                          # kill
+        wait_exit(pool)
+        pool.poll_once()                          # reap as crash
+        assert h.state == "backoff"
+        assert pool.restarts_total == 1
+
+    def test_wedged_during_bringup_also_killed(self, tmp_path,
+                                               cleanup_pools):
+        # An actor that stamps once and then hangs BEFORE reaching the
+        # rolling phase must not escape the timeout contract.
+        pool = make_pool(tmp_path, _sleeper, heartbeat_timeout_s=5.0,
+                         actor_backoff_initial_s=30.0).start(1)
+        cleanup_pools.append(pool)
+        (h,) = pool._actors.values()
+        write_heartbeat(
+            os.path.join(pool.dir, h.actor_id, HEARTBEAT_FILE),
+            pid=h.pid, actor_id=h.actor_id, env_steps=0, episodes=0,
+            chunks=0, rows=0, params_step=0, phase="starting")
+        hb_path = os.path.join(pool.dir, h.actor_id, HEARTBEAT_FILE)
+        hb = read_heartbeat(hb_path)
+        hb["time"] = time.time() - 60.0
+        with open(hb_path, "w") as f:
+            json.dump(hb, f)
+        pool.poll_once()                          # still STARTING: kill
+        wait_exit(pool)
+        pool.poll_once()
+        assert h.state == "backoff"
+        assert pool.restarts_total == 1
+
+    def test_timeout_zero_observes_only(self, tmp_path, cleanup_pools):
+        pool = make_pool(tmp_path, _sleeper,
+                         heartbeat_timeout_s=0.0).start(1)
+        cleanup_pools.append(pool)
+        (h,) = pool._actors.values()
+        stamp_rolling(pool, h)
+        hb_path = os.path.join(pool.dir, h.actor_id, HEARTBEAT_FILE)
+        hb = read_heartbeat(hb_path)
+        hb["time"] = time.time() - 3600.0
+        with open(hb_path, "w") as f:
+            json.dump(hb, f)
+        pool.poll_once()
+        assert h.proc.poll() is None              # still running
+        assert h.heartbeat_age_s > 3000           # ...but the age exports
+
+
+class TestStatusExport:
+    def test_status_json_names_every_member(self, tmp_path,
+                                            cleanup_pools):
+        registry = _Registry()
+        pool = make_pool(tmp_path, _sleeper, registry=registry).start(2)
+        cleanup_pools.append(pool)
+        pool.poll_once()
+        status = read_status(pool.dir)
+        assert status["pid"] == os.getpid()
+        assert status["target"] == 2
+        assert sorted(status["actors"]) == ["a0", "a1"]
+        for rec in status["actors"].values():
+            assert rec["pid"] and rec["state"] in ("starting", "alive")
+        assert registry.gauges["actors_alive"] == 2.0
+        assert registry.gauges["actors_failed"] == 0.0
+
+    def test_torn_or_absent_status_reads_none(self, tmp_path):
+        assert read_status(str(tmp_path)) is None
+        with open(tmp_path / "status.json", "w") as f:
+            f.write('{"pid": 12')                 # torn
+        assert read_status(str(tmp_path)) is None
+
+
+class _Registry:
+    """MetricsRegistry duck-type: last-value gauges + monotone counters."""
+
+    def __init__(self):
+        self.gauges = {}
+        self.counters = {}
+
+    def record(self, name, value):
+        self.gauges[name] = value
+
+    def inc(self, name, value=1.0):
+        self.counters[name] = self.counters.get(name, 0.0) + value

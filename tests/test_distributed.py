@@ -44,9 +44,14 @@ class _Recorder:
 def recorded_initialize(monkeypatch):
     rec = _Recorder()
     monkeypatch.setattr(mesh_mod.jax.distributed, "initialize", rec)
-    # Ensure the idempotence guard sees "not yet initialized".
+    # Ensure the idempotence guard sees "not yet initialized". Patched at
+    # the framework's version-portable probe, NOT at
+    # jax.distributed.is_initialized: the 0.4.x line on this container
+    # has no such attribute, and patching it errored every test in this
+    # tier at setup since seed (the same AttributeError the probe now
+    # shields init_distributed itself from).
     monkeypatch.setattr(
-        mesh_mod.jax.distributed, "is_initialized", lambda: False)
+        mesh_mod, "_distributed_initialized", lambda: False)
     for var in ("JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS"):
         monkeypatch.delenv(var, raising=False)
     return rec
@@ -80,7 +85,7 @@ class TestInitDistributedGating:
     def test_idempotent_after_bringup(self, recorded_initialize, monkeypatch):
         # Simulate an already-initialized runtime: no second initialize.
         monkeypatch.setattr(
-            mesh_mod.jax.distributed, "is_initialized", lambda: True)
+            mesh_mod, "_distributed_initialized", lambda: True)
         init_distributed("host0:8476", num_processes=2, process_id=0)
         assert recorded_initialize.calls == []
 

@@ -191,3 +191,71 @@ class TestTailReader:
         tail = read_tail_transitions(jpath, 0)
         np.testing.assert_array_equal(tail[0], obs)
         assert tail[4] == 11
+
+
+class TestIngestReader:
+    """read_new_transitions — the learner's actor-feed ingest read
+    (disaggregation PR): per-actor cursor streaming over a possibly
+    segmented journal, with the no-skip cursor guarantee under max_rows."""
+
+    def _write(self, jpath, stamps, rows_per=4, segment_records=0):
+        from sharetrade_tpu.data.transitions import append_transitions
+        with Journal(jpath, segment_records=segment_records) as j:
+            for i, es in enumerate(stamps):
+                append_transitions(j, *_batch(rows_per, seed=i),
+                                   env_steps=es)
+
+    def test_floor_filters_and_high_water_advances(self, jpath):
+        from sharetrade_tpu.data.transitions import read_new_transitions
+        self._write(jpath, [10, 20, 30])
+        out = read_new_transitions(jpath, 10, 0)
+        assert out[0].shape[0] == 8            # stamps 20, 30
+        assert out[4] == 30
+        # Cursor at the returned high-water: nothing new next tick.
+        out = read_new_transitions(jpath, 30, 0)
+        assert out[0].shape[0] == 0
+        assert out[4] >= 30
+
+    def test_no_records_returns_none(self, tmp_path):
+        from sharetrade_tpu.data.transitions import read_new_transitions
+        assert read_new_transitions(
+            str(tmp_path / "missing.journal"), 0, 0) is None
+
+    def test_segmented_walk_matches_single_file(self, tmp_path):
+        from sharetrade_tpu.data.transitions import read_new_transitions
+        flat = str(tmp_path / "flat.journal")
+        seg = str(tmp_path / "seg.journal")
+        stamps = list(range(10, 110, 10))
+        self._write(flat, stamps)
+        self._write(seg, stamps, segment_records=3)
+        a = read_new_transitions(flat, 40, 0)
+        b = read_new_transitions(seg, 40, 0)
+        np.testing.assert_array_equal(a[0], b[0])
+        assert a[4] == b[4] == 100
+
+    def test_max_rows_keeps_oldest_and_never_skips(self, jpath):
+        # THE cursor contract: a capped read must stream the backlog
+        # oldest-first, with high-water covering only the KEPT records —
+        # keeping the newest instead would advance the cursor past the
+        # dropped older rows and lose them forever.
+        from sharetrade_tpu.data.transitions import read_new_transitions
+        self._write(jpath, [10, 20, 30, 40], rows_per=4)
+        seen = []
+        cursor = 0
+        for _ in range(10):
+            out = read_new_transitions(jpath, cursor, 8)
+            if out[0].shape[0] == 0:
+                break
+            seen.append(out[4])
+            assert out[0].shape[0] <= 8
+            cursor = max(cursor, out[4])
+        # Every committed stamp ingested exactly once, in order.
+        assert seen == [20, 40]
+        assert cursor == 40
+
+    def test_cap_smaller_than_one_record_still_progresses(self, jpath):
+        from sharetrade_tpu.data.transitions import read_new_transitions
+        self._write(jpath, [10, 20], rows_per=6)
+        out = read_new_transitions(jpath, 0, 2)    # cap < record rows
+        assert out[0].shape[0] == 6                # whole record kept
+        assert out[4] == 10                        # cursor exact

@@ -1,0 +1,561 @@
+#!/usr/bin/env python
+"""Actor-process kill soak: a live learner, real actor subprocesses, real
+SIGKILLs — the disaggregation contract kill-tested.
+
+tools/crash_soak.py proves the durability layer survives the TRAINING
+process dying; this soak proves the actor/learner topology (distrib/)
+isolates failure domains: rollout actors die mid-run and the learner
+NEVER restarts. It launches one ``cli learner`` (which hosts the
+:class:`ActorPool` supervisor and spawns N ``cli actor`` subprocesses),
+injects seeded SIGKILL/SIGTERMs into whole actor processes, and asserts
+after EVERY kill:
+
+- **the learner never restarts** — same pid, still alive, its pool
+  status uninterrupted (``started_at`` constant), and its supervision
+  restart counter untouched by actor deaths;
+- **no committed transition is lost or torn** — every actor journal
+  reads cleanly through the segmented CRC reader after the kill, and the
+  per-actor env-step high-water NEVER goes backward (a respawned actor
+  continues its stamps past the recovered high-water, so the learner's
+  ingest cursors stay exact);
+- **membership and restart counters reconcile exactly** — the pool's
+  ``actor_restarts_total`` equals the injected kill count, membership
+  returns to the target after every respawn, and nobody failed
+  terminally (the kill cadence leaves room for the streak to reset);
+- **bounded disk** — per-actor sealed-segment sets stay inside the
+  retirement bound.
+
+Mid-soak the driver exercises **elastic membership**: it writes the
+pool's ``scale`` control file to join a fresh actor to the LIVE run (no
+learner restart), waits for the newcomer to roll out and journal, then
+scales back down (the retiring actor drains gracefully).
+
+The full profile then drives an actor to TERMINAL failure (kill-on-spawn
+past ``distrib.max_actor_restarts`` before the heartbeat can reset the
+streak) and asserts the pool degrades gracefully onto the survivors —
+and that one more ``scale`` call replaces the dead member, again with no
+learner restart.
+
+Seeded and reproducible: ``--seed`` fixes the kill schedule (victim,
+signal, delay). ``make actor-soak`` runs the full soak; the 2-kill quick
+profile runs in tier-1 (tests/test_actor_soak.py) and ``make check``.
+
+Usage:
+    python tools/actor_soak.py                     # full soak (N=4, 20 kills)
+    python tools/actor_soak.py --kills 2 --actors 2 --quick
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import signal
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from soak_common import (  # noqa: E402
+    SoakError, assert_segments_bounded, count_sealed_segments,
+    journal_high_water, launch_cli, log_tail, prom_value, read_json,
+    wait_until,
+)
+
+from sharetrade_tpu.cli import EXIT_PREEMPTED  # noqa: E402
+from sharetrade_tpu.distrib.actor import TRANSITIONS_FILE  # noqa: E402
+from sharetrade_tpu.distrib.pool import SCALE_FILE, STATUS_FILE  # noqa: E402
+
+
+def build_config(workdir: str, *, actors: int, quick: bool) -> dict:
+    """A small-but-real disaggregated config: journaled DQN learner with
+    feed ingest on, N rollout actors with segment rotation on (kills land
+    across rotation boundaries), tight eval/checkpoint cadence so
+    ``tag_best`` gets republished and the actors' swap watchers exercise
+    the verified-restore path mid-soak."""
+    return {
+        "seed": 7,
+        "data": {
+            "synthetic_length": 72,            # 64-step episodes (window 8)
+            "journal_dir": os.path.join(workdir, "journal"),
+            "use_native_journal": False,
+            "async_transition_writer": False,
+            "journal_fsync_every_records": 1,
+            "journal_fsync_interval_s": 0.0,
+            "journal_segment_records": 12,
+        },
+        "env": {"window": 8},
+        "model": {"hidden_dim": 8},
+        "learner": {
+            "algo": "dqn",
+            "journal_replay": True,
+            "replay_capacity": 512,
+            "replay_batch": 32,
+        },
+        "parallel": {"num_workers": 4},
+        "runtime": {
+            "chunk_steps": 8,
+            "episodes": 100000,                # the soak ends the run, not
+            "checkpoint_every_updates": 16,    # episode completion
+            "checkpoint_dir": os.path.join(workdir, "ckpts"),
+            "keep_checkpoints": 3,
+            "megachunk_factor": 2,
+            "metrics_every_chunks": 2,
+            "eval_every_updates": 32,          # republishes tag_best
+            "max_restarts": 3,
+            "backoff_initial_s": 0.05,
+            "backoff_max_s": 0.1,
+            "preempt_grace_s": 25.0,
+            "poll_interval_s": 0.05,
+        },
+        "distrib": {
+            "num_actors": actors,
+            "actor_dir": os.path.join(workdir, "actors"),
+            "max_actor_restarts": 4,
+            "actor_backoff_initial_s": 0.1,
+            "actor_backoff_max_s": 0.5,
+            "actor_backoff_jitter": 0.2,
+            "heartbeat_interval_s": 0.2,
+            "heartbeat_timeout_s": 0.0,        # exact kill/restart
+            "supervise_interval_s": 0.1,       # reconciliation needs no
+            "ingest_every_updates": 4,         # timeout-injected crashes
+            "weight_poll_s": 0.5,
+            "actor_chunk_steps": 8,
+        },
+        "obs": {"enabled": True, "dir": os.path.join(workdir, "obs")},
+    }
+
+
+def pool_status(pool_dir: str) -> dict:
+    status = read_json(os.path.join(pool_dir, STATUS_FILE))
+    if status is None:
+        raise SoakError(f"no pool status at {pool_dir}")
+    return status
+
+
+def alive_actor_pids(status: dict) -> dict[str, int]:
+    return {aid: a["pid"] for aid, a in status["actors"].items()
+            if a["state"] in ("starting", "alive") and a["pid"]}
+
+
+def actor_journal(pool_dir: str, actor_id: str) -> str:
+    return os.path.join(pool_dir, actor_id, TRANSITIONS_FILE)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+class Driver:
+    """One learner + pool under test, with the per-kill invariant block."""
+
+    def __init__(self, workdir: str, cfg: dict, *, verbose: bool):
+        self.workdir = workdir
+        self.cfg = cfg
+        self.verbose = verbose
+        self.pool_dir = cfg["distrib"]["actor_dir"]
+        self.learner = None
+        self.learner_pid = None
+        self.learner_started_at = None
+        self.high_water: dict[str, int] = {}
+        self.injected_kills = 0
+
+    def say(self, msg: str) -> None:
+        if self.verbose:
+            print(f"[actor-soak] {msg}", flush=True)
+
+    # ---- lifecycle ---------------------------------------------------
+
+    def start(self, timeout_s: float = 240.0) -> None:
+        cfg_path = os.path.join(self.workdir, "config.json")
+        with open(cfg_path, "w") as f:
+            json.dump(self.cfg, f, indent=2)
+        self.learner = launch_cli(
+            "learner", cfg_path, os.path.join(self.workdir, "learner.log"),
+            symbol="SOAK")
+        self.learner_pid = self.learner.pid
+        target = self.cfg["distrib"]["num_actors"]
+
+        def up() -> bool:
+            if self.learner.poll() is not None:
+                raise SoakError(
+                    f"learner exited rc={self.learner.returncode} during "
+                    f"bring-up:\n{log_tail(self.learner)}")
+            status = read_json(os.path.join(self.pool_dir, STATUS_FILE))
+            if status is None:
+                return False
+            self.learner_started_at = status["started_at"]
+            # Every actor rolled out at least one journaled chunk: the
+            # kill phase must land on actors with committed records.
+            pids = alive_actor_pids(status)
+            return (len(pids) >= target
+                    and all(journal_high_water(
+                        actor_journal(self.pool_dir, aid)) or 0
+                        for aid in pids))
+
+        wait_until(up, timeout_s, desc="learner + actor fleet bring-up")
+        self.say(f"fleet up: learner pid {self.learner_pid}, actors "
+                 f"{alive_actor_pids(pool_status(self.pool_dir))}")
+
+    def stop(self) -> dict:
+        """Graceful end: SIGTERM the learner, expect the preemption drain
+        contract (exit 75) and a clean pool shutdown."""
+        if self.learner.poll() is None:
+            self.learner.send_signal(signal.SIGTERM)
+        try:
+            rc = self.learner.wait(
+                timeout=self.cfg["runtime"]["preempt_grace_s"] + 60)
+        except Exception:
+            self.learner.kill()
+            self.learner.wait(timeout=30)
+            raise SoakError(
+                f"learner did not drain on SIGTERM:\n{log_tail(self.learner)}")
+        if rc != EXIT_PREEMPTED:
+            raise SoakError(
+                f"SIGTERM'd learner exited rc={rc}, expected "
+                f"{EXIT_PREEMPTED}:\n{log_tail(self.learner)}")
+        status = pool_status(self.pool_dir)
+        leaked = {aid: pid for aid, pid in (
+            (aid, a["pid"]) for aid, a in status["actors"].items()
+            if a["pid"]) if _pid_alive(pid) and pid != self.learner_pid}
+        if leaked:
+            raise SoakError(f"actor processes leaked past the learner "
+                            f"drain: {leaked}")
+        return status
+
+    # ---- invariants --------------------------------------------------
+
+    def assert_learner_never_restarted(self) -> None:
+        if self.learner.poll() is not None:
+            raise SoakError(
+                f"LEARNER DIED (rc={self.learner.returncode}) — the actor "
+                f"failure domain leaked:\n{log_tail(self.learner)}")
+        status = pool_status(self.pool_dir)
+        if status["pid"] != self.learner_pid:
+            raise SoakError(
+                f"pool status pid changed {self.learner_pid} -> "
+                f"{status['pid']}: the learner restarted")
+        if status["started_at"] != self.learner_started_at:
+            raise SoakError("pool started_at changed: the supervisor was "
+                            "re-created inside the learner")
+        # The learner's own supervision counter must not tick on actor
+        # deaths (restarts_total in the obs export is the orchestrator's).
+        value = self._prom_value("restarts_total")
+        if value and value > 0:
+            raise SoakError("learner supervision restarted during the "
+                            f"soak: restarts_total={value}")
+
+    def _prom_value(self, metric: str) -> float | None:
+        """A counter/gauge from the learner's metrics.prom export."""
+        return prom_value(
+            os.path.join(self.cfg["obs"]["dir"], "metrics.prom"), metric)
+
+    def assert_journals_intact(self) -> None:
+        """CRC + high-water through the segmented reader, per actor:
+        reads must succeed (torn tails recovered, never an exception) and
+        the recovered high-water never goes backward across kills."""
+        status = pool_status(self.pool_dir)
+        for aid in status["actors"]:
+            path = actor_journal(self.pool_dir, aid)
+            hw = journal_high_water(path)   # raises if unreadable
+            if hw is None:
+                continue
+            prev = self.high_water.get(aid, -1)
+            if hw < prev:
+                raise SoakError(
+                    f"actor {aid} journal high-water went BACKWARD "
+                    f"({prev} -> {hw}): committed transitions lost")
+            self.high_water[aid] = hw
+            assert_segments_bounded(
+                path,
+                replay_capacity=self.cfg["learner"]["replay_capacity"],
+                segment_records=self.cfg["data"]
+                ["journal_segment_records"])
+
+    def assert_counters_reconcile(self, *, expect_failed: int = 0) -> None:
+        status = pool_status(self.pool_dir)
+        if status["restarts_total"] != self.injected_kills:
+            raise SoakError(
+                f"restart counter does not reconcile: pool counted "
+                f"{status['restarts_total']} restarts, soak injected "
+                f"{self.injected_kills} kills")
+        if status["failed"] != expect_failed:
+            raise SoakError(
+                f"{status['failed']} actors failed terminally, expected "
+                f"{expect_failed}: {status['actors']}")
+
+    def wait_membership(self, n: int, timeout_s: float = 120.0) -> None:
+        def converged() -> bool:
+            self.assert_learner_never_restarted()
+            status = pool_status(self.pool_dir)
+            pids = alive_actor_pids(status)
+            return (len(pids) == n
+                    and all(_pid_alive(p) for p in pids.values()))
+        wait_until(converged, timeout_s,
+                   desc=f"membership to converge to {n} live actors")
+
+    def wait_healthy(self, n: int, timeout_s: float = 300.0) -> None:
+        """Every live member in the ALIVE state (rolling-phase heartbeat
+        from its current incarnation) — i.e. every respawn's crash streak
+        has RESET. Long kill schedules must pace on this: a random victim
+        can land on a still-starting respawn whose streak never reset, and
+        enough consecutive unlucky picks drive it past
+        distrib.max_actor_restarts into a LEGITIMATE terminal failure
+        (the pool cannot distinguish injected kills from a crash loop) —
+        the soak's failed==0 reconciliation then fails by design, not by
+        bug. Found the hard way at kill 12 of a 20-kill run on a loaded
+        host where bring-up outlasted the kill cadence."""
+        def healthy() -> bool:
+            self.assert_learner_never_restarted()
+            status = pool_status(self.pool_dir)
+            live = {aid: a for aid, a in status["actors"].items()
+                    if a["state"] in ("starting", "alive")}
+            return (len(live) == n
+                    and all(a["state"] == "alive"
+                            for a in live.values()))
+        wait_until(healthy, timeout_s,
+                   desc=f"{n} live actors to prove healthy "
+                        "(rolling heartbeat, streaks reset)")
+
+    # ---- injections --------------------------------------------------
+
+    def kill_actor(self, rng: random.Random, i: int, kills: int,
+                   *, sigterm_every: int, pace: bool = False) -> None:
+        status = pool_status(self.pool_dir)
+        pids = alive_actor_pids(status)
+        victim = rng.choice(sorted(pids))
+        pid = pids[victim]
+        use_term = sigterm_every > 0 and (i % sigterm_every
+                                          == sigterm_every - 1)
+        sig = signal.SIGTERM if use_term else signal.SIGKILL
+        delay = rng.uniform(0.1, 1.2)
+        time.sleep(delay)
+        try:
+            os.kill(pid, sig)
+        except ProcessLookupError:
+            # The actor crashed/respawned in the window between the
+            # status read and the kill — the pool already counted it;
+            # re-read and retry once on the fresh pid.
+            status = pool_status(self.pool_dir)
+            pid = alive_actor_pids(status).get(victim)
+            if pid is None:
+                raise SoakError(
+                    f"kill {i}: victim {victim} vanished without the "
+                    "soak killing it (spurious crash?)")
+            os.kill(pid, sig)
+        self.injected_kills += 1
+        self.say(f"kill {i + 1}/{kills}: {sig.name} -> {victim} "
+                 f"(pid {pid}) after {delay:.2f}s")
+        # The pool must notice the death, count exactly one restart, and
+        # bring membership back to target — with the learner untouched.
+        target = pool_status(self.pool_dir)["target"]
+
+        def counted() -> bool:
+            self.assert_learner_never_restarted()
+            return (pool_status(self.pool_dir)["restarts_total"]
+                    >= self.injected_kills)
+        wait_until(counted, 60.0, desc=f"pool to count kill {i + 1}")
+        self.wait_membership(target)
+        if pace:
+            self.wait_healthy(target)
+        self.assert_learner_never_restarted()
+        self.assert_journals_intact()
+        self.assert_counters_reconcile()
+
+    def scale_to(self, n: int, *, expect_failed: int = 0,
+                 timeout_s: float = 180.0) -> None:
+        """Elastic membership through the pool's control file: the LIVE
+        run converges to n actors, newcomers journal real rows, and the
+        learner never restarts."""
+        with open(os.path.join(self.pool_dir, SCALE_FILE), "w") as f:
+            f.write(str(n))
+        # The pool must ACKNOWLEDGE the target before anything else
+        # happens: a second scale write landing inside one supervise tick
+        # would otherwise overwrite this one unseen — and if the final
+        # value equals the pool's current target, the whole request
+        # becomes a permanent no-op (found the hard way).
+        wait_until(lambda: pool_status(self.pool_dir)["target"] == n,
+                   timeout_s, desc=f"pool to acknowledge target {n}")
+        self.wait_membership(n, timeout_s)
+
+        def newcomers_rolling() -> bool:
+            self.assert_learner_never_restarted()
+            status = pool_status(self.pool_dir)
+            return all(
+                (journal_high_water(actor_journal(self.pool_dir, aid))
+                 or 0) > 0
+                for aid in alive_actor_pids(status))
+        wait_until(newcomers_rolling, timeout_s,
+                   desc="every live actor (newcomers included) to journal")
+        status = pool_status(self.pool_dir)
+        if status["failed"] != expect_failed:
+            raise SoakError(
+                f"scale({n}): {status['failed']} terminally-failed actors, "
+                f"expected {expect_failed}")
+        self.say(f"scaled to {n}: membership "
+                 f"{sorted(alive_actor_pids(status))}")
+
+    def fail_actor_terminally(self, timeout_s: float = 240.0) -> str:
+        """Kill-on-spawn one actor past distrib.max_actor_restarts before
+        its heartbeat can reset the streak -> terminal FAILED; the pool
+        degrades onto the survivors."""
+        status = pool_status(self.pool_dir)
+        victim = sorted(alive_actor_pids(status))[0]
+        budget = self.cfg["distrib"]["max_actor_restarts"]
+        self.say(f"driving {victim} to terminal failure "
+                 f"(budget {budget})")
+        deadline = time.monotonic() + timeout_s
+        killed_pids = set()
+        while time.monotonic() < deadline:
+            self.assert_learner_never_restarted()
+            status = pool_status(self.pool_dir)
+            rec = status["actors"][victim]
+            if rec["state"] == "failed":
+                self.assert_journals_intact()
+                self.say(f"{victim} terminally failed after "
+                         f"{rec['restarts']} restarts; survivors: "
+                         f"{sorted(alive_actor_pids(status))}")
+                return victim
+            pid = rec["pid"]
+            if (rec["state"] in ("starting", "alive") and pid
+                    and pid not in killed_pids and _pid_alive(pid)):
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                    killed_pids.add(pid)
+                    self.injected_kills += 1
+                except ProcessLookupError:
+                    pass
+            time.sleep(0.05)
+        raise SoakError(f"{victim} never reached the terminal failed "
+                        f"state within {timeout_s:.0f}s")
+
+
+def run_soak(*, kills: int, actors: int, seed: int,
+             workdir: str | None = None, sigterm_every: int = 3,
+             terminal_failure: bool = True, scale_test: bool = True,
+             verbose: bool = True) -> dict:
+    """The soak driver; returns a summary dict, raises SoakError on any
+    invariant violation."""
+    rng = random.Random(seed)
+    own_dir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="actor_soak_")
+    os.makedirs(workdir, exist_ok=True)
+    cfg = build_config(workdir, actors=actors, quick=kills <= 4)
+    driver = Driver(workdir, cfg, verbose=verbose)
+    summary = {"seed": seed, "actors": actors, "kills": kills,
+               "workdir": workdir}
+    try:
+        driver.start()
+        # Pace kills on fleet health whenever the schedule is long enough
+        # that an unlucky victim sequence could legitimately exceed the
+        # terminal-failure budget (see wait_healthy); short schedules
+        # cannot, and skipping the wait keeps the tier-1 profile fast.
+        pace = kills > cfg["distrib"]["max_actor_restarts"]
+        for i in range(kills):
+            driver.kill_actor(rng, i, kills, sigterm_every=sigterm_every,
+                              pace=pace)
+        summary["injected"] = driver.injected_kills
+
+        if scale_test:
+            # Elastic membership against the LIVE learner: join one, then
+            # retire back to the original target (graceful drain).
+            driver.scale_to(actors + 1)
+            driver.scale_to(actors)
+            summary["scaled"] = True
+
+        failed_actor = None
+        if terminal_failure:
+            failed_actor = driver.fail_actor_terminally()
+            driver.assert_counters_reconcile(expect_failed=1)
+            # Replacement joins mid-run: a terminal failure does NOT move
+            # the pool's target (replacing a dead member is an explicit
+            # operator action), so acknowledge the corpse first
+            # (target -> live survivors) and then scale back up — the
+            # fresh actor joins the LIVE run, learner never restarted.
+            driver.scale_to(actors - 1, expect_failed=1)
+            driver.scale_to(actors, expect_failed=1)
+            summary["terminal_failed_actor"] = failed_actor
+
+        driver.assert_learner_never_restarted()
+        driver.assert_journals_intact()
+        # Learner actually TRAINED on actor experience during all of
+        # this: wait for the ingest counter to surface through the obs
+        # export (the first ingest tick needs a few learner updates plus
+        # an exporter drain — a snapshot check here raced the bring-up).
+        def ingested_rows() -> float:
+            return driver._prom_value("distrib_rows_ingested_total") or 0.0
+
+        def has_ingested() -> bool:
+            driver.assert_learner_never_restarted()
+            return ingested_rows() > 0
+        wait_until(has_ingested, 120.0,
+                   desc="the learner to ingest actor transitions")
+        summary["rows_ingested"] = ingested_rows()
+        summary["final_status"] = driver.stop()
+        summary["high_water"] = driver.high_water
+        summary["sealed_segments"] = {
+            aid: count_sealed_segments(
+                actor_journal(driver.pool_dir, aid))
+            for aid in summary["final_status"]["actors"]}
+        driver.say(
+            f"soak PASSED: {driver.injected_kills} kills, learner pid "
+            f"{driver.learner_pid} never restarted, "
+            f"{summary['rows_ingested']:.0f} rows ingested")
+        return summary
+    finally:
+        if driver.learner is not None and driver.learner.poll() is None:
+            driver.learner.kill()
+            driver.learner.wait(timeout=30)
+        # Belt-and-braces: no orphan actor may outlive the soak.
+        status = read_json(os.path.join(driver.pool_dir, STATUS_FILE))
+        for rec in ((status or {}).get("actors") or {}).values():
+            pid = rec.get("pid")
+            if pid and _pid_alive(pid):
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        if own_dir:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--kills", type=int, default=20)
+    parser.add_argument("--actors", type=int, default=4)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--sigterm-every", type=int, default=3,
+                        help="every Nth kill is a graceful SIGTERM "
+                             "(0 = SIGKILL only)")
+    parser.add_argument("--quick", action="store_true",
+                        help="skip the terminal-failure scenario "
+                             "(tier-1 profile)")
+    parser.add_argument("--no-scale", action="store_true",
+                        help="skip the elastic-membership scenario")
+    parser.add_argument("--workdir", default=None,
+                        help="keep artifacts here instead of a temp dir")
+    args = parser.parse_args()
+    try:
+        summary = run_soak(
+            kills=args.kills, actors=args.actors, seed=args.seed,
+            workdir=args.workdir, sigterm_every=args.sigterm_every,
+            terminal_failure=not args.quick,
+            scale_test=not args.no_scale)
+    except SoakError as exc:
+        print(f"[actor-soak] FAILED: {exc}", file=sys.stderr)
+        return 1
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,14 +53,20 @@ import sys
 import tempfile
 import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Invariant helpers shared with tools/actor_soak.py (the actor/learner
+# disaggregation kill-test) — one definition of the durability contract.
+from soak_common import (  # noqa: E402
+    REPO, SoakError, assert_no_stale_tmp, flip_byte, launch_cli, log_tail,
+    newest_intact_meta,
+)
+from soak_common import assert_segments_bounded as _assert_segments_bounded  # noqa: E402
+from soak_common import count_sealed_segments as _count_sealed_segments  # noqa: E402
+from soak_common import journal_high_water as _journal_high_water  # noqa: E402
+from soak_common import ls as _ls  # noqa: E402
 
 from sharetrade_tpu.cli import EXIT_PREEMPTED  # noqa: E402
-
-
-class SoakError(AssertionError):
-    """An invariant violation — the soak FAILED."""
 
 
 def build_config(workdir: str, *, algo: str, episodes: int,
@@ -123,31 +129,14 @@ def build_config(workdir: str, *, algo: str, episodes: int,
 
 def launch(cfg_path: str, log_path: str, *, resume: bool,
            overrides: list[str] | None = None) -> subprocess.Popen:
-    """Start a child ``cli train``; its merged stdout/stderr goes to
-    ``log_path`` (a FILE, not a pipe — a pipe nobody drains fills at
-    ~64 KB and wedges the child mid-log-write, turning a drain under test
-    into a spurious hang)."""
-    cmd = [sys.executable, "-m", "sharetrade_tpu.cli", "train",
-           "--config", cfg_path, "--symbol", "SOAK"]
-    if resume:
-        cmd.append("--resume")
-    for item in overrides or []:
-        cmd += ["--set", item]
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
-    with open(log_path, "w") as fh:
-        proc = subprocess.Popen(cmd, cwd=REPO, env=env,
-                                stdout=fh, stderr=subprocess.STDOUT)
-    proc.soak_log = log_path
-    return proc
+    """Start a child ``cli train`` (see soak_common.launch_cli for the
+    file-not-pipe rationale)."""
+    return launch_cli("train", cfg_path, log_path, symbol="SOAK",
+                      resume=resume, overrides=overrides)
 
 
 def _log_tail(proc: subprocess.Popen, limit: int = 4000) -> str:
-    try:
-        with open(proc.soak_log, errors="replace") as f:
-            return f.read()[-limit:]
-    except OSError:
-        return "<child log unreadable>"
+    return log_tail(proc, limit)
 
 
 def wait_for_progress(ckpt_dir: str, obs_dir: str, t_launch: float,
@@ -179,97 +168,21 @@ def wait_for_progress(ckpt_dir: str, obs_dir: str, t_launch: float,
                     f"{timeout_s:.0f}s:\n{_log_tail(proc)}")
 
 
-def _ls(path: str) -> list[str]:
-    try:
-        return sorted(os.listdir(path))
-    except FileNotFoundError:
-        return []
-
-
-def newest_intact_meta(ckpt_dir: str) -> dict | None:
-    """Metadata of the newest checkpoint that passes verification, walking
-    back over damaged ones WITHOUT quarantining (read-only observer — the
-    resumed child owns the quarantine action)."""
-    from sharetrade_tpu.checkpoint.manager import (
-        _PREFIX, CheckpointIntegrityError, verify_checkpoint_files)
-
-    steps = []
-    for name in _ls(ckpt_dir):
-        if name.startswith(_PREFIX):
-            try:
-                steps.append(int(name[len(_PREFIX):]))
-            except ValueError:
-                pass
-    for s in sorted(steps, reverse=True):
-        try:
-            return verify_checkpoint_files(
-                os.path.join(ckpt_dir, f"{_PREFIX}{s:010d}"))
-        except CheckpointIntegrityError:
-            continue
-    return None
-
-
 def journal_high_water(journal_dir: str) -> int | None:
-    """Recovered env-step high-water of the transitions journal (torn-tail
-    recovery included); None when nothing was journaled yet. Raises through
-    any reader exception — an unreadable journal is an invariant failure."""
-    from sharetrade_tpu.data.transitions import read_tail_transitions
-    path = os.path.join(journal_dir, "transitions.journal")
-    if not os.path.exists(path):
-        return None
-    tail = read_tail_transitions(path, 1)
-    return None if tail is None else int(tail[4])
+    return _journal_high_water(
+        os.path.join(journal_dir, "transitions.journal"))
 
 
 def count_sealed_segments(journal_dir: str) -> int:
-    from sharetrade_tpu.data.journal import segment_paths
-    return len(segment_paths(
-        os.path.join(journal_dir, "transitions.journal")))
+    return _count_sealed_segments(
+        os.path.join(journal_dir, "transitions.journal"))
 
 
 def assert_segments_bounded(journal_dir: str, cfg: dict) -> None:
-    """Bounded-disk invariant with rotation on: the sealed-segment set
-    must stay within what retirement promises to keep — the newest
-    segments covering 2x replay_capacity rows plus rotation/cadence
-    slack — instead of growing with the run's whole history. The bound is
-    generous (row counts per record vary near episode ends) but FINITE
-    and run-length-independent, which is the property under test."""
-    from sharetrade_tpu.data.journal import segment_paths
-    path = os.path.join(journal_dir, "transitions.journal")
-    if not os.path.exists(path):
-        return
-    seals = segment_paths(path)
-    keep_rows = 2 * cfg["learner"]["replay_capacity"]
-    # Worst-case rows per record ~= workers (one env step per record row
-    # set) is far below the typical chunk_steps x workers; allow a 4x
-    # cadence/rotation slack on top of the horizon's segment count.
-    seg_records = cfg["data"]["journal_segment_records"]
-    min_rows_per_seg = seg_records          # >= 1 row per record
-    bound = 4 * (keep_rows // min_rows_per_seg + 2)
-    if len(seals) > bound:
-        raise SoakError(
-            f"journal segment set grew past the retirement bound: "
-            f"{len(seals)} sealed segments > {bound} "
-            f"(keep_rows={keep_rows}, segment_records={seg_records})")
-
-
-def assert_no_stale_tmp(ckpt_dir: str) -> None:
-    """After a child ran (its manager init swept), no dead-pid tmp debris
-    may remain. Live-pid dirs would belong to a running child — the soak
-    only calls this between children, so ANY tmp dir is debris."""
-    debris = [n for n in _ls(ckpt_dir) if n.startswith("tmp-")]
-    if debris:
-        raise SoakError(f"stale checkpoint tmp debris accumulated: {debris}")
-
-
-def flip_byte(path: str, offset_frac: float = 0.5) -> None:
-    size = os.path.getsize(path)
-    off = max(0, min(size - 1, int(size * offset_frac)))
-    with open(path, "r+b") as f:
-        f.seek(off)
-        b = f.read(1)
-        f.seek(off)
-        f.write(bytes([b[0] ^ 0xFF]))
+    _assert_segments_bounded(
+        os.path.join(journal_dir, "transitions.journal"),
+        replay_capacity=cfg["learner"]["replay_capacity"],
+        segment_records=cfg["data"]["journal_segment_records"])
 
 
 def run_soak(*, kills: int, seed: int, algo: str, workdir: str | None,

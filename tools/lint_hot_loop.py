@@ -147,6 +147,21 @@ in tests/test_megachunk.py:
     within the two preceding lines, carries ``trace-buffer-ok`` naming
     the logical bound (e.g. "drained every tick", "bounded by
     max_queue shedding").
+
+12. **Process spawning stays in the actor-pool supervisor** (the
+    disaggregation PR's guard) — ``subprocess.Popen`` / ``os.fork`` /
+    ``os.spawn*`` / ``os.exec*`` inside ``sharetrade_tpu/`` creates a
+    child process whose lifecycle SOMEBODY must own: unsupervised spawns
+    are exactly the zombie/leak class the :class:`ActorPool` contract
+    (reap, seeded backoff, terminal-failed state, drain-on-stop) exists
+    to prevent. The only sanctioned spawn site is the supervisor module
+    itself (``distrib/pool.py``); anywhere else FAILS unless the line
+    carries ``actor-spawn-ok`` naming who supervises that child.
+    Blocking helpers (``subprocess.run`` — e.g. the manifest's git-rev
+    probe) are deliberately out of scope: they cannot outlive the call.
+    The supervisor's consumer-side functions (``_reap``,
+    ``_heartbeat_ages``) must keep existing — a rename must update this
+    lint, not silently un-guard the reap seam.
 """
 
 from __future__ import annotations
@@ -375,6 +390,20 @@ SERVE_PKG = (pathlib.Path(__file__).resolve().parent.parent
 #: event, so no serve/ code needs an unmarked time.sleep.
 SERVE_PKG_MARKER = "serve-block-ok"
 
+#: Check 12 (the disaggregation PR): the ONLY module allowed to spawn
+#: worker processes — the ActorPool supervisor owns every child's
+#: lifecycle (reap/backoff/terminal-failed/drain).
+ACTOR_SPAWN_MODULE = "distrib/pool.py"
+#: Supervisor consumer-side functions that must keep existing.
+ACTOR_POOL_FUNCS = ("_reap", "_heartbeat_ages")
+#: Process-creating calls: Popen detaches a child; fork/spawn*/exec*
+#: likewise. subprocess.run/check_* block until the child exits and are
+#: deliberately NOT matched (they cannot leak an unsupervised process).
+ACTOR_SPAWN_PATTERN = re.compile(
+    r"subprocess\.Popen\(|\bos\.fork\(|\bos\.spawn\w*\(|\bos\.exec\w*\(")
+#: Escape hatch naming who supervises the spawned child.
+ACTOR_SPAWN_MARKER = "actor-spawn-ok"
+
 #: Check 11 (the request-tracing PR): packages whose deque buffers hold
 #: per-request observability state and must be bounded rings.
 TRACE_BUFFER_DIRS = ("serve", "obs")
@@ -503,6 +532,38 @@ def lint_bounded_trace_buffers(
                 bad.append((f"{pathlib.Path(root).name}/{path.name}",
                             node.lineno, lines[node.lineno - 1].strip()))
     return bad
+
+
+def lint_actor_spawn(
+        root: pathlib.Path | None = None) -> tuple[
+            list[tuple[str, int, str]], set[str]]:
+    """Check 12: no process-creating call (``subprocess.Popen`` /
+    ``os.fork`` / ``os.spawn*`` / ``os.exec*``) anywhere in
+    ``sharetrade_tpu/`` outside the ActorPool supervisor module, unless
+    the line carries ``actor-spawn-ok``; the supervisor's ``_reap`` /
+    ``_heartbeat_ages`` must exist. Returns (hits, found supervisor
+    function names). ``root`` overrides the scanned package (tests
+    exercise the pattern semantics on fixtures)."""
+    root = root or TARGET.parent.parent     # sharetrade_tpu/
+    bad: list[tuple[str, int, str]] = []
+    found: set[str] = set()
+    for path in sorted(pathlib.Path(root).rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        src = path.read_text()
+        if rel == ACTOR_SPAWN_MODULE:
+            for node in ast.walk(ast.parse(src)):
+                if (isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                        and node.name in ACTOR_POOL_FUNCS):
+                    found.add(node.name)
+            continue
+        for ln, text in enumerate(src.splitlines(), 1):
+            if text.lstrip().startswith("#"):
+                continue
+            if (ACTOR_SPAWN_PATTERN.search(text)
+                    and ACTOR_SPAWN_MARKER not in text):
+                bad.append((rel, ln, text.strip()))
+    return bad, found
 
 
 def lint_dispatcher_blocking() -> tuple[list[tuple[str, int, str]], set[str]]:
@@ -721,6 +782,24 @@ def main() -> int:
               "ring bound, or tag it (call line or the two lines above) "
               f"'# {TRACE_BUFFER_MARKER}: <the logical bound>'")
         return 1
+    spawn_bad, spawn_found = lint_actor_spawn()
+    spawn_missing = set(ACTOR_POOL_FUNCS) - spawn_found
+    if spawn_missing:
+        print(f"actor-spawn lint: function(s) {sorted(spawn_missing)} not "
+              f"found in sharetrade_tpu/{ACTOR_SPAWN_MODULE} — the actor "
+              "pool's reap/heartbeat seam was renamed; update "
+              "tools/lint_hot_loop.py ACTOR_POOL_FUNCS")
+        return 1
+    if spawn_bad:
+        print("actor-spawn lint FAILED:")
+        for rel, ln, text in spawn_bad:
+            print(f"  sharetrade_tpu/{rel}:{ln}: {text}")
+        print("a process spawned outside the ActorPool supervisor has no "
+              "reap/backoff/terminal-failure owner (zombie and leak "
+              "territory); route it through distrib/pool.py, or tag the "
+              f"line '# {ACTOR_SPAWN_MARKER}: <who supervises this "
+              "child>'")
+        return 1
     dur_bad = lint_durable_replace()
     if dur_bad:
         print("durable-rename fsync lint FAILED:")
@@ -743,6 +822,7 @@ def main() -> int:
           f"replay device-path lint OK ({', '.join(REPLAY_TREE_FUNCS + REPLAY_DQN_FUNCS)}); "
           f"serve overload-safety lint OK; "
           f"trace-buffer bound lint OK ({', '.join(TRACE_BUFFER_DIRS)}); "
+          f"actor-spawn lint OK ({ACTOR_SPAWN_MODULE}); "
           f"durable-rename fsync lint OK ({', '.join(DURABLE_WRITE_FILES)})")
     return 0
 

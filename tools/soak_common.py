@@ -1,0 +1,185 @@
+"""Shared invariant helpers for the process-kill soaks.
+
+``tools/crash_soak.py`` (kill the TRAINING process, PR 5) and
+``tools/actor_soak.py`` (kill ACTOR subprocesses under a live learner,
+the actor/learner disaggregation kill-test) assert the same durability
+invariants — intact-checkpoint walk-back, journal CRC/high-water through
+the segmented reader, bounded segment sets, no tmp debris. One definition
+here so a contract fix lands in both soaks instead of drifting between
+copies.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+class SoakError(AssertionError):
+    """An invariant violation — the soak FAILED."""
+
+
+def ls(path: str) -> list[str]:
+    try:
+        return sorted(os.listdir(path))
+    except FileNotFoundError:
+        return []
+
+
+def log_tail(proc: subprocess.Popen, limit: int = 4000) -> str:
+    """Tail of a child's merged log file (``launch_cli`` attaches the
+    path as ``proc.soak_log``)."""
+    try:
+        with open(proc.soak_log, errors="replace") as f:
+            return f.read()[-limit:]
+    except (OSError, AttributeError):
+        return "<child log unreadable>"
+
+
+def launch_cli(subcommand: str, cfg_path: str, log_path: str, *,
+               symbol: str, resume: bool = False,
+               overrides: list[str] | None = None,
+               extra_args: list[str] | None = None) -> subprocess.Popen:
+    """Start a child ``cli <subcommand>``; merged stdout/stderr goes to
+    ``log_path`` (a FILE, not a pipe — a pipe nobody drains fills at
+    ~64 KB and wedges the child mid-log-write, turning a drain under test
+    into a spurious hang)."""
+    cmd = [sys.executable, "-m", "sharetrade_tpu.cli", subcommand,
+           "--config", cfg_path, "--symbol", symbol]
+    if resume:
+        cmd.append("--resume")
+    for item in overrides or []:
+        cmd += ["--set", item]
+    cmd += extra_args or []
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    with open(log_path, "w") as fh:
+        proc = subprocess.Popen(cmd, cwd=REPO, env=env,
+                                stdout=fh, stderr=subprocess.STDOUT)
+    proc.soak_log = log_path
+    return proc
+
+
+def newest_intact_meta(ckpt_dir: str) -> dict | None:
+    """Metadata of the newest checkpoint that passes verification, walking
+    back over damaged ones WITHOUT quarantining (read-only observer — the
+    resumed child owns the quarantine action)."""
+    from sharetrade_tpu.checkpoint.manager import (
+        _PREFIX, CheckpointIntegrityError, verify_checkpoint_files)
+
+    steps = []
+    for name in ls(ckpt_dir):
+        if name.startswith(_PREFIX):
+            try:
+                steps.append(int(name[len(_PREFIX):]))
+            except ValueError:
+                pass
+    for s in sorted(steps, reverse=True):
+        try:
+            return verify_checkpoint_files(
+                os.path.join(ckpt_dir, f"{_PREFIX}{s:010d}"))
+        except CheckpointIntegrityError:
+            continue
+    return None
+
+
+def prom_value(prom_path: str, metric: str) -> float | None:
+    """One gauge/counter from a MetricsExporter Prometheus textfile (the
+    exporter prefixes every series with ``sharetrade_``); None when the
+    file or the series is absent. The ONE definition of this scrape —
+    the soaks and the scaling bench all read learner counters this way."""
+    try:
+        with open(prom_path) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) == 2 and parts[0] == f"sharetrade_{metric}":
+                    return float(parts[1])
+    except OSError:
+        return None
+    return None
+
+
+def journal_high_water(journal_path: str) -> int | None:
+    """Recovered env-step high-water of a transitions journal (torn-tail
+    recovery + segment walk included); None when nothing was journaled
+    yet. Raises through any reader exception — an unreadable journal is
+    an invariant failure."""
+    from sharetrade_tpu.data.transitions import read_tail_transitions
+    if not os.path.exists(journal_path):
+        return None
+    tail = read_tail_transitions(journal_path, 1)
+    return None if tail is None else int(tail[4])
+
+
+def count_sealed_segments(journal_path: str) -> int:
+    from sharetrade_tpu.data.journal import segment_paths
+    return len(segment_paths(journal_path))
+
+
+def assert_segments_bounded(journal_path: str, *, replay_capacity: int,
+                            segment_records: int) -> None:
+    """Bounded-disk invariant with rotation on: the sealed-segment set
+    must stay within what retirement promises to keep — the newest
+    segments covering 2x replay_capacity rows plus rotation/cadence
+    slack — instead of growing with the run's whole history. The bound is
+    generous (row counts per record vary near episode ends) but FINITE
+    and run-length-independent, which is the property under test."""
+    from sharetrade_tpu.data.journal import segment_paths
+    if not os.path.exists(journal_path) or segment_records <= 0:
+        return
+    seals = segment_paths(journal_path)
+    keep_rows = 2 * replay_capacity
+    min_rows_per_seg = segment_records      # >= 1 row per record
+    bound = 4 * (keep_rows // min_rows_per_seg + 2)
+    if len(seals) > bound:
+        raise SoakError(
+            f"journal segment set grew past the retirement bound: "
+            f"{len(seals)} sealed segments > {bound} "
+            f"(keep_rows={keep_rows}, segment_records={segment_records}) "
+            f"at {journal_path}")
+
+
+def assert_no_stale_tmp(ckpt_dir: str) -> None:
+    """After a child ran (its manager init swept), no dead-pid tmp debris
+    may remain. Live-pid dirs would belong to a running child — the soaks
+    only call this between children, so ANY tmp dir is debris."""
+    debris = [n for n in ls(ckpt_dir) if n.startswith("tmp-")]
+    if debris:
+        raise SoakError(f"stale checkpoint tmp debris accumulated: {debris}")
+
+
+def flip_byte(path: str, offset_frac: float = 0.5) -> None:
+    size = os.path.getsize(path)
+    off = max(0, min(size - 1, int(size * offset_frac)))
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def wait_until(predicate, timeout_s: float, *, interval_s: float = 0.1,
+               desc: str = "condition") -> None:
+    """Poll ``predicate`` until truthy or raise SoakError at timeout."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval_s)
+    raise SoakError(f"timed out after {timeout_s:.0f}s waiting for {desc}")
+
+
+def read_json(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
