@@ -8,7 +8,7 @@
 
 PYTHON ?= python
 
-.PHONY: check test slow native bench bench-actor bench-async bench-ckpt bench-dispatch bench-obs bench-precision bench-replay bench-reshard bench-roofline bench-serve bench-serve-overload actor-soak crash-soak obs-demo lint perf-gate serve-chaos serve-soak shard-audit clean
+.PHONY: check test slow native bench autotune autotune-quick bench-actor bench-async bench-autotune bench-ckpt bench-dispatch bench-obs bench-precision bench-replay bench-reshard bench-roofline bench-serve bench-serve-overload actor-soak crash-soak obs-demo lint perf-gate serve-chaos serve-soak shard-audit clean
 
 check: native lint
 	$(PYTHON) -m pytest tests/ -q -m "not slow" -x
@@ -16,6 +16,7 @@ check: native lint
 	$(PYTHON) tools/obs_demo.py
 	$(PYTHON) tools/serve_chaos.py --injections 2
 	$(PYTHON) tools/actor_soak.py --kills 2 --actors 2 --quick --no-scale
+	$(PYTHON) tools/autotune.py --quick --out /tmp/tuned_profile_quick.json --json
 	$(PYTHON) tools/shard_audit.py
 	$(PYTHON) tools/perf_gate.py
 
@@ -174,6 +175,31 @@ actor-soak:
 # tests/test_crash_soak.py).
 crash-soak:
 	$(PYTHON) tools/crash_soak.py --kills 20
+
+# Offline autotune sweep (tools/autotune.py): successive-halving search
+# over the knob registry's train (megachunk K x pipeline depth) and
+# serve (max_batch x batch_timeout_ms x max_queue) grids on short
+# measured windows, writing the per-host tuned_profile.json that
+# `tuning.profile` loads (explicit config > profile > defaults). Add
+# `--spec train,serve,distrib --exhaustive` for the acceptance
+# comparison against the full hand-sweep grid (BASELINE.md
+# "Self-tuning").
+autotune:
+	$(PYTHON) tools/autotune.py --out tuned_profile.json
+
+# Seconds-scale profile of the same sweep (tiny grid, short windows) —
+# wired into `make check` as the end-to-end gate that the sweep ->
+# profile -> load path stays green; writes to /tmp, never the repo.
+autotune-quick:
+	$(PYTHON) tools/autotune.py --quick --out /tmp/tuned_profile_quick.json --json
+
+# Online-controller A/B (bench.py bench_autotune): a ramping open-loop
+# arrival schedule where the static default config misses the target
+# p99, static arm vs the ServeController arm holding it (or shedding
+# within SLO) — the autotune_controller_p99_ms perf-gate row.
+bench-autotune:
+	$(PYTHON) -c "import json, bench; \
+	print(json.dumps(bench.bench_autotune(), indent=2))"
 
 # Static guard: no bare scalar device syncs in the orchestrator hot loop.
 lint:

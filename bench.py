@@ -106,6 +106,12 @@ def _result_envelope(cfg: FrameworkConfig | None = None) -> dict:
         # precision): a bf16_mixed row must never gate against fp32
         # history — different compute tier, different roofline.
         env["precision"] = cfg.precision.mode
+        # The RESOLVED tunable-knob vector (tuning.py registry): BENCH
+        # rows and autotune trials join on the actual knob values a
+        # measurement ran under, not just the opaque config_hash — the
+        # ISSUE-14 provenance contract.
+        from sharetrade_tpu.tuning import knob_vector
+        env["knobs"] = knob_vector(cfg)
     return env
 
 
@@ -992,9 +998,15 @@ def bench_serve(*, duration_s: float = 2.5, sessions: int = 512,
     import serve_soak
 
     cfg = FrameworkConfig()
+    # The envelope's knob vector must name the values the measurement
+    # ACTUALLY ran under (the provenance contract): mirror the soak
+    # engine's serve knobs into cfg, and pass the SAME values through —
+    # a hard-coded mirror of run_soak's default would silently diverge.
+    cfg.serve.max_batch = max_batch
     soak = serve_soak.run_soak(
         duration_s=duration_s, sessions=sessions, rates=rates,
-        max_batch=max_batch, mlp=True)
+        max_batch=max_batch,
+        batch_timeout_ms=cfg.serve.batch_timeout_ms, mlp=True)
     episode = serve_soak.run_soak(
         duration_s=min(duration_s, 2.0), sessions=4 * max_batch,
         rates=(), max_batch=max_batch, mlp=False)
@@ -1088,6 +1100,10 @@ def bench_serve_overload(*, duration_s: float = 2.5, sessions: int = 2048,
     from sharetrade_tpu.utils.metrics import MetricsRegistry
 
     cfg_env = FrameworkConfig()
+    # Envelope provenance: the gated (shedding) arm's actual knobs —
+    # build() below reads THESE fields, so row and engine can't diverge.
+    cfg_env.serve.max_batch = max_batch
+    cfg_env.serve.max_queue = max_queue
     model, params, prices, window = serve_soak.build_workload(mlp=True)
     slots = max(4 * max_batch, sessions // 4)
 
@@ -1096,7 +1112,8 @@ def bench_serve_overload(*, duration_s: float = 2.5, sessions: int = 2048,
         engine = ServeEngine(
             model,
             ServeConfig(max_batch=max_batch, slots=slots,
-                        batch_timeout_ms=2.0, swap_poll_s=0.0,
+                        batch_timeout_ms=cfg_env.serve.batch_timeout_ms,
+                        swap_poll_s=0.0,
                         stats_interval_s=0.5, max_queue=queue_bound,
                         shed_policy=policy),
             params, registry=registry)
@@ -1171,6 +1188,159 @@ def bench_serve_overload(*, duration_s: float = 2.5, sessions: int = 2048,
         "shed_rate": round(shed_events / max(offered_to_engine, 1), 4),
         "shedding": shed,
         "unbounded": arms["unbounded"],
+    }
+
+
+def bench_autotune(*, duration_s: float = 1.2, sessions: int = 1024,
+                   max_batch: int = 16, max_queue: int = 512,
+                   batch_timeout_ms: float = 25.0,
+                   ramp: tuple[float, ...] = (0.5, 1.0, 1.5)) -> dict:
+    """Online-controller A/B (ISSUE 14; BASELINE.md "Self-tuning"): a
+    RAMPING open-loop arrival schedule (``ramp`` multiples of the
+    engine's own measured saturation) against two identically-configured
+    engines whose static knobs are deliberately un-tuned for a latency
+    SLO (generous ``batch_timeout_ms``/``max_queue`` — a throughput
+    hand-tune):
+
+    - **static**: the knobs stay at config. As the ramp passes
+      saturation the queue fills toward ``max_queue`` and p99 rides the
+      whole backlog — the "nobody tuned this" failure the ISSUE names.
+    - **controller**: a :class:`ServeController` holds
+      ``target_p99_ms`` (derived from the measured low-load p99, so the
+      row is host-relative) by tightening the same knobs below their
+      configured ceilings — bounded hysteresis steps, every adjustment
+      a gauge + counter.
+
+    Each ramp stage runs TWICE — an un-recorded adapt pass (the
+    controller converges; feedback loops are steady-state devices) then
+    the measured pass; the static arm runs the identical schedule so
+    both arms see the same offered-load history.
+
+    Gate row: ``autotune_controller_p99_ms`` = the controller arm's
+    WORST measured-stage p99 (HIGHER is worse; the gate inverts
+    ``*_ms`` bands). The static arm is recorded but NOT gated — it
+    measures the backlog by construction, exactly like
+    bench_serve_overload's unbounded arm."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import serve_soak
+
+    from sharetrade_tpu.config import ServeConfig
+    from sharetrade_tpu.serve import ServeController, ServeEngine
+    from sharetrade_tpu.serve.driver import (
+        make_sessions,
+        run_closed_loop,
+        run_open_loop,
+    )
+    from sharetrade_tpu.utils.metrics import MetricsRegistry
+
+    cfg_env = FrameworkConfig()
+    # Envelope provenance: both arms share these CONFIGURED knobs (the
+    # controller arm's live adjustments are recorded per-arm below).
+    cfg_env.serve.max_batch = max_batch
+    cfg_env.serve.batch_timeout_ms = batch_timeout_ms
+    cfg_env.serve.max_queue = max_queue
+    model, params, prices, window = serve_soak.build_workload(mlp=True)
+
+    def build():
+        registry = MetricsRegistry()
+        engine = ServeEngine(
+            model,
+            ServeConfig(max_batch=max_batch, slots=4 * max_batch,
+                        batch_timeout_ms=batch_timeout_ms,
+                        max_queue=max_queue, shed_policy="reject",
+                        swap_poll_s=0.0, stats_interval_s=0.25),
+            params, registry=registry)
+        engine.warmup()
+        return engine, registry
+
+    # Capacity anchor + target derivation on a throwaway probe engine:
+    # the target is a margin over "what this host serves comfortably at
+    # half load", so the row compares across hosts like
+    # bench_serve_overload's self-normalized rate does.
+    probe, _ = build()
+    saturation = run_closed_loop(
+        probe, make_sessions(prices, window, 8 * max_batch,
+                             prefix="at-sat-"),
+        concurrency=2 * max_batch, duration_s=min(duration_s, 1.0))
+    low = run_open_loop(
+        probe, make_sessions(prices, window, 8 * max_batch,
+                             prefix="at-low-"),
+        rate_qps=0.5 * saturation["qps"],
+        duration_s=min(duration_s, 1.0))
+    probe.stop(drain=False)
+    target = max(20.0, 5.0 * low["p99_ms"])
+
+    arms: dict = {}
+    for arm in ("static", "controller"):
+        engine, registry = build()
+        controller = None
+        if arm == "controller":
+            controller = ServeController(
+                engine, target_p99_ms=target, interval_s=0.2).start()
+        stages = []
+        serial = [0]
+
+        def offer(mult: float, seconds: float):
+            serial[0] += 1
+            return run_open_loop(
+                engine,
+                make_sessions(prices, window, sessions,
+                              prefix=f"at-{arm}-{serial[0]}-"),
+                rate_qps=mult * saturation["qps"], duration_s=seconds)
+
+        for mult in ramp:
+            offer(mult, duration_s)             # adapt pass (unrecorded)
+            run = offer(mult, duration_s)       # measured pass
+            stages.append({
+                "rate_multiple": mult,
+                "qps": round(run["qps"], 1),
+                "p99_ms": round(run["p99_ms"], 3),
+                "completed": run["completed"],
+                "failed": run["failed"],
+            })
+        if controller is not None:
+            controller.stop()
+        engine.stop(drain=False)
+        counters = registry.counters()
+        completed = sum(s["completed"] for s in stages)
+        failed = sum(s["failed"] for s in stages)
+        arms[arm] = {
+            "worst_p99_ms": max(s["p99_ms"] for s in stages),
+            "stages": stages,
+            "availability": round(
+                completed / max(completed + failed, 1), 4),
+            "shed_total": int(counters.get("serve_shed_total", 0)
+                              + counters.get("serve_queue_rejected_total",
+                                             0)),
+            "adjustments": int(counters.get(
+                "serve_controller_adjustments_total", 0)),
+            "final_knobs": {
+                "batch_timeout_ms": registry.latest(
+                    "serve_knob_batch_timeout_ms"),
+                "max_queue": registry.latest("serve_knob_max_queue"),
+            },
+        }
+    ctl = arms["controller"]
+    precision = cfg_env.precision.mode
+    return {
+        **_result_envelope(cfg_env),
+        "metric": "autotune_controller_p99_ms",
+        "value": ctl["worst_p99_ms"],
+        "unit": "ms",
+        "precision": precision,
+        "note": "controller arm's worst ramp-stage p99; higher is worse "
+                "(gate band inverted). Static arm recorded, not gated.",
+        "target_p99_ms": round(target, 3),
+        "saturation_qps": round(saturation["qps"], 1),
+        "ramp": list(ramp),
+        "static_missed_target":
+            arms["static"]["worst_p99_ms"] > target,
+        "controller_held_target": ctl["worst_p99_ms"] <= target,
+        "controller": ctl,
+        "static": arms["static"],
     }
 
 
@@ -1738,11 +1908,18 @@ def bench_actor_scaling(actor_counts: tuple[int, ...] = (1, 2, 4), *,
         }
 
     # --- disaggregated arms: N actors + one live learner --------------
-    for n in actor_counts:
+    def measure_learner_arm(n: int, tag: str,
+                            cfg_updates: dict | None = None) -> dict:
+        """One real ``cli learner`` arm: fleet bring-up, produced
+        high-water delta over the steady window, ingest counter delta
+        over an extended window (the rate is bursty — see the comment
+        inline)."""
         with tempfile.TemporaryDirectory(
-                prefix=f"bench_actor_n{n}_") as workdir:
+                prefix=f"bench_actor_{tag}_") as workdir:
             cfg = base_cfg(workdir)
             cfg["distrib"]["num_actors"] = n
+            for section, values in (cfg_updates or {}).items():
+                cfg.setdefault(section, {}).update(values)
             # The ingest rate is sampled as a COUNTER DELTA over the same
             # steady window as the produced-steps high-water delta —
             # dividing the run total by full elapsed time would mostly
@@ -1756,10 +1933,13 @@ def bench_actor_scaling(actor_counts: tuple[int, ...] = (1, 2, 4), *,
                 json.dump(cfg, f)
             paths = actor_journals(workdir, n)
 
-            def ingest_counter(workdir=workdir) -> float:
+            def prom(metric: str) -> float:
                 return prom_value(
                     os.path.join(workdir, "obs", "metrics.prom"),
-                    "distrib_rows_ingested_total") or 0.0
+                    metric) or 0.0
+
+            def ingest_counter() -> float:
+                return prom("distrib_rows_ingested_total")
 
             proc = launch_cli("learner", cfg_path,
                               os.path.join(workdir, "learner.log"),
@@ -1772,7 +1952,7 @@ def bench_actor_scaling(actor_counts: tuple[int, ...] = (1, 2, 4), *,
                     and all((journal_high_water(p) or 0) > 0
                             for p in paths)
                     and ingest_counter() > 0,
-                    240, f"N={n} fleet bring-up + first ingest")
+                    240, f"{tag} fleet bring-up + first ingest")
                 hw0 = high_waters(paths)
                 c0 = ingest_counter()
                 t0 = _time.monotonic()
@@ -1796,21 +1976,56 @@ def bench_actor_scaling(actor_counts: tuple[int, ...] = (1, 2, 4), *,
                     _time.sleep(0.5)
                     c1 = ingest_counter()
                 ingest_window = _time.monotonic() - t0
+                ingest_adjustments = prom("ingest_adjustments_total")
+                ingest_every = prom("ingest_every_updates_current")
             finally:
                 summary = last_json(terminate(proc))
             produced = sum(hw1[p] - hw0[p] for p in paths) \
                 * workers / window
             ingested = max(0.0, c1 - c0) / ingest_window
-            result[f"n{n}"] = {
-                "metric": f"actor_produced_steps_per_sec_n{n}",
-                "value": round(produced, 2),
-                "unit": "agent-steps/s (summed actor rollouts)",
+            return {
+                "produced_steps_per_sec": round(produced, 2),
                 "ingested_rows_per_sec": round(ingested, 2),
                 "ingest_window_s": round(ingest_window, 2),
-                "vs_single_process": round(
-                    produced / max(baseline_steps, 1e-9), 2),
+                "ingest_adjustments": int(ingest_adjustments),
+                "ingest_every_final": (int(ingest_every)
+                                       if ingest_every else None),
                 "actor_restarts": summary.get("actor_restarts"),
             }
+
+    for n in actor_counts:
+        arm = measure_learner_arm(n, f"n{n}")
+        result[f"n{n}"] = {
+            "metric": f"actor_produced_steps_per_sec_n{n}",
+            "value": arm["produced_steps_per_sec"],
+            "unit": "agent-steps/s (summed actor rollouts)",
+            "vs_single_process": round(
+                arm["produced_steps_per_sec"]
+                / max(baseline_steps, 1e-9), 2),
+            **{k: v for k, v in arm.items()
+               if k != "produced_steps_per_sec"},
+        }
+
+    # --- adaptive-ingest A/B (ISSUE 14): the widest fleet at the
+    # DEFAULT cadence (ingest_every_updates=8 — the constant nobody
+    # tuned), tuning.adaptive_ingest off vs on. The adaptive arm's
+    # backlog signal (full per-actor windows) tightens the cadence
+    # toward base/4, recovering ingest throughput the static default
+    # leaves on the table; recorded either way (a host where the
+    # learner is CPU-starved outright is recorded honestly as such).
+    n_ab = max(actor_counts)
+    ab: dict = {"cadence_base": 8, "actors": n_ab}
+    for mode, adaptive in (("static", False), ("adaptive", True)):
+        arm = measure_learner_arm(
+            n_ab, f"ab_{mode}",
+            {"distrib": {"ingest_every_updates": 8,
+                         "ingest_max_rows": 1024},
+             "tuning": {"adaptive_ingest": adaptive}})
+        ab[mode] = arm
+    ab["adaptive_vs_static"] = round(
+        ab["adaptive"]["ingested_rows_per_sec"]
+        / max(ab["static"]["ingested_rows_per_sec"], 1e-9), 2)
+    result["adaptive_ingest_ab"] = ab
 
     # Headline gate row: the BEST arm's ingested rows/s — the ingest
     # path's demonstrated capacity (rows actually reaching the learner's
@@ -1904,6 +2119,7 @@ def _await_devices(attempts: int = 3, timeout_s: float = 180.0,
                  "r['precision'] = bench.bench_precision(); "
                  "r['serve'] = bench.bench_serve(); "
                  "r['serve_overload'] = bench.bench_serve_overload(); "
+                 "r['autotune'] = bench.bench_autotune(); "
                  "r['replay'] = bench.bench_replay(); "
                  "r['actor_scaling'] = bench.bench_actor_scaling(); "
                  "print(json.dumps(r))"],
@@ -1969,6 +2185,7 @@ def main() -> None:
     result["precision"] = bench_precision()
     result["serve"] = bench_serve()
     result["serve_overload"] = bench_serve_overload()
+    result["autotune"] = bench_autotune()
     result["replay"] = bench_replay()
     result["actor_scaling"] = bench_actor_scaling()
     print(json.dumps(result), flush=True)

@@ -41,7 +41,13 @@ def _load_config(args) -> FrameworkConfig:
            else FrameworkConfig())
     if args.set:
         cfg = cfg.apply_overrides(args.set)
-    return cfg
+    # Tuned-profile resolution (tuning.py): file/--set values are the
+    # EXPLICIT tier and win; registered knobs still at their defaults
+    # take the per-host profile's values. Resolved here once so every
+    # subcommand (train/serve/learner/actor) runs the same knobs the
+    # manifest will report.
+    from sharetrade_tpu.tuning import apply_profile
+    return apply_profile(cfg)
 
 
 def cmd_train(args) -> int:
@@ -252,7 +258,7 @@ def cmd_serve(args) -> int:
 
     cfg = _load_config(args)
     service = PriceDataService(config=cfg.data)
-    engine = watcher = obs_bundle = None
+    engine = watcher = obs_bundle = controller = None
     stop_evt = threading.Event()
     preempt_at: list[float] = []
 
@@ -295,6 +301,16 @@ def cmd_serve(args) -> int:
                              registry=registry, obs=obs_bundle,
                              obs_cfg=cfg.obs)
         engine.warmup()
+        if cfg.tuning.serve_controller:
+            # Online self-tuning (serve/controller.py): hold
+            # tuning.target_p99_ms by adapting batch_timeout_ms/max_queue
+            # below their configured ceilings — every adjustment lands as
+            # gauges + flight-ring events.
+            from sharetrade_tpu.serve import ServeController
+            controller = ServeController(
+                engine, target_p99_ms=cfg.tuning.target_p99_ms,
+                interval_s=cfg.tuning.controller_interval_s,
+                obs=obs_bundle).start()
         if cfg.serve.swap_poll_s > 0:
             watcher = WeightSwapWatcher(
                 engine, manager, template, tag=cfg.serve.swap_tag,
@@ -328,6 +344,8 @@ def cmd_serve(args) -> int:
         # us, losing the summary entirely.
         grace = cfg.runtime.preempt_grace_s
         drained = engine.drain(timeout_s=grace * 0.5)
+        if controller is not None:
+            controller.stop()
         if watcher is not None:
             watcher.stop()
         # Per-seam timeout: the 1 s floor keeps healthy shutdowns from
@@ -358,6 +376,8 @@ def cmd_serve(args) -> int:
             "deadline_expired": int(
                 counters.get("serve_deadline_expired_total", 0)),
             "restarts": int(counters.get("serve_restarts_total", 0)),
+            "controller_adjustments": int(
+                counters.get("serve_controller_adjustments_total", 0)),
             "drained": drained,
             "stopped_clean": stopped_clean,
             "engine_failed": engine_failed,
@@ -398,6 +418,8 @@ def cmd_serve(args) -> int:
     finally:
         for s, h in prev_handlers.items():
             signal.signal(s, h)
+        if controller is not None:
+            controller.stop()
         if watcher is not None:
             watcher.stop()
         if engine is not None:

@@ -597,6 +597,53 @@ class DistribConfig:
 
 
 @dataclass
+class TuningConfig:
+    """Self-tuning runtime (tuning.py, serve/controller.py,
+    tools/autotune.py) — the layer that closes the telemetry loop into
+    the performance knobs (ROADMAP item 5).
+
+    Two tiers:
+
+    - **Offline profile** (``profile``): path of a ``tuned_profile.json``
+      written by ``tools/autotune.py`` (``make autotune``). Registered
+      knobs (tuning.py ``KNOBS``) still at their dataclass defaults take
+      the profile's per-host values; anything the operator set explicitly
+      wins over the profile, the profile wins over defaults, and the
+      resolution is stamped into the run manifest. A profile whose host
+      fingerprint (cores/backend/device count) mismatches this host is
+      refused LOUDLY unless ``allow_fingerprint_mismatch``.
+    - **Online serve controller** (``serve_controller``): a feedback loop
+      (serve/controller.py) on the engine's own windowed latency
+      histogram and overload gauges that adapts ``serve.batch_timeout_ms``
+      and ``serve.max_queue`` — bounded, hysteresis-guarded, rate-limited
+      steps, never ABOVE the configured values (config is the safety
+      ceiling) — to hold ``target_p99_ms`` under the measured arrival
+      rate. Plus the learner-side ``adaptive_ingest``: the orchestrator
+      backs off ``distrib.ingest_every_updates`` while the actor feeds
+      are dry and tightens it (down to the configured cadence and below,
+      bounded) when a tick reads a full backlog window.
+    """
+
+    # Path of the per-host tuned_profile.json; None = no profile.
+    profile: str | None = None
+    # Apply a fingerprint-mismatched profile anyway (logged, not silent).
+    allow_fingerprint_mismatch: bool = False
+    # Online serve controller: off by default — an SLO target is an
+    # operator decision, not a guessable constant.
+    serve_controller: bool = False
+    # The controller's latency objective (end-to-end request p99, ms).
+    target_p99_ms: float = 50.0
+    # Controller tick cadence (seconds): at most ONE knob adjustment per
+    # interval (the rate limit), objectives windowed per interval.
+    controller_interval_s: float = 1.0
+    # Adaptive learner-ingest cadence (distrib runs only; inert without
+    # a pool): on by default — it only ever moves within bounds derived
+    # from the configured cadence, and a dry-feed backoff is pure waste
+    # reduction.
+    adaptive_ingest: bool = True
+
+
+@dataclass
 class ObsConfig:
     """Telemetry (obs/): span trace, metrics export, crash flight recorder.
 
@@ -692,6 +739,7 @@ class FrameworkConfig:
     serve: ServeConfig = field(default_factory=ServeConfig)
     distrib: DistribConfig = field(default_factory=DistribConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    tuning: TuningConfig = field(default_factory=TuningConfig)
     seed: int = 0
 
     # ---- serialization ----
@@ -720,8 +768,16 @@ class FrameworkConfig:
         Values are parsed as JSON when possible, else kept as strings, so
         ``learner.gamma=0.99``, ``model.kind=lstm`` and
         ``parallel.mesh_shape={"dp":4,"tp":2}`` all work.
+
+        The overridden dotted paths are remembered on the returned
+        instance (``_explicit_overrides``, instance attribute — not a
+        field, so it never serializes): the tuned-profile resolution
+        (tuning.py) consults it so a knob EXPLICITLY ``--set`` back to
+        its default value still beats the profile — value-equality alone
+        cannot see that decision.
         """
         cfg = FrameworkConfig.from_dict(self.to_dict())
+        explicit = set(getattr(self, "_explicit_overrides", ()))
         for item in overrides:
             if "=" not in item:
                 raise ConfigError(f"override must look like section.key=value, got {item!r}")
@@ -739,6 +795,8 @@ class FrameworkConfig:
             if not hasattr(target, leaf):
                 raise KeyError(f"unknown config key {leaf!r} in {dotted!r}")
             setattr(target, leaf, value)
+            explicit.add(dotted)
+        cfg._explicit_overrides = frozenset(explicit)
         return cfg
 
 
@@ -774,4 +832,5 @@ _NESTED = {
     "serve": ServeConfig,
     "distrib": DistribConfig,
     "obs": ObsConfig,
+    "tuning": TuningConfig,
 }

@@ -184,6 +184,7 @@ def summarize_run_dir(run_dir: str) -> dict:
     JSON object (manifest identity, span aggregates, metrics tail, flight
     bundle verdict)."""
     out: dict[str, Any] = {"run_dir": run_dir}
+    manifest_tuning = None
     manifest_path = os.path.join(run_dir, "manifest.json")
     if os.path.isfile(manifest_path):
         with open(manifest_path, encoding="utf-8") as f:
@@ -191,6 +192,24 @@ def summarize_run_dir(run_dir: str) -> dict:
         out["manifest"] = {k: m.get(k) for k in (
             "config_hash", "backend", "device_count", "mesh_shape",
             "git_rev", "created_at")}
+        manifest_tuning = m.get("tuning")
+    if manifest_tuning:
+        # Self-tuning provenance (tuning.py, stamped into the manifest):
+        # the active profile + fingerprint and, per registered knob, the
+        # resolved value vs its default and which tier won (explicit /
+        # profile / default) — enriched below with the live controller
+        # gauges when the run exported metrics.
+        out["tuning"] = {
+            "profile": manifest_tuning.get("profile"),
+            "profile_error": manifest_tuning.get("profile_error"),
+            "fingerprint": manifest_tuning.get("fingerprint"),
+            "knobs": {
+                path: {"value": info.get("value"),
+                       "default": info.get("default"),
+                       "source": info.get("source")}
+                for path, info in sorted(
+                    (manifest_tuning.get("knobs") or {}).items())},
+        }
     trace_path = os.path.join(run_dir, "trace.jsonl")
     if os.path.isfile(trace_path):
         spans: dict[str, dict[str, float]] = {}
@@ -339,6 +358,31 @@ def summarize_run_dir(run_dir: str) -> dict:
                             quantile_from_snapshot(snap, 0.99), 3)}
             if stages:
                 out["serve"]["stages"] = stages
+        if (manifest_tuning
+                or any(k.startswith(("serve_knob_", "serve_controller_",
+                                     "ingest_"))
+                       for k in list(gauges) + list(counters))):
+            # Live self-tuning state (ISSUE 14): current knob values as
+            # the controllers last set them, adjustment counters, and
+            # the last objective reading — next to the provenance block
+            # above so "what is it tuned to" and "who set it" read as
+            # one section.
+            tuning_out = out.setdefault("tuning", {})
+            tuning_out["live"] = {
+                "serve_batch_timeout_ms": gauges.get(
+                    "serve_knob_batch_timeout_ms"),
+                "serve_max_queue": gauges.get("serve_knob_max_queue"),
+                "controller_adjustments_total": counters.get(
+                    "serve_controller_adjustments_total", 0.0),
+                "controller_target_p99_ms": gauges.get(
+                    "serve_controller_target_p99_ms"),
+                "controller_last_p99_ms": gauges.get(
+                    "serve_controller_p99_ms"),
+                "ingest_every_updates_current": gauges.get(
+                    "ingest_every_updates_current"),
+                "ingest_adjustments_total": counters.get(
+                    "ingest_adjustments_total", 0.0),
+            }
     exemplars_path = os.path.join(run_dir, "serve_exemplars.json")
     if os.path.isfile(exemplars_path):
         with open(exemplars_path, encoding="utf-8") as f:

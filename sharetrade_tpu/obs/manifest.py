@@ -50,7 +50,7 @@ def build_manifest(cfg: Any, *, mesh: Any = None) -> dict:
         jax_version = jax.__version__
     except Exception:       # manifest must not force device discovery to work
         backend, device_count, jax_version = None, None, None
-    return {
+    manifest = {
         "created_at": time.time(),
         "config_hash": config_hash(cfg),
         "config": cfg_dict,
@@ -63,6 +63,18 @@ def build_manifest(cfg: Any, *, mesh: Any = None) -> dict:
         "hostname": platform.node(),
         "pid": os.getpid(),
     }
+    try:
+        # Tuned-knob provenance (tuning.py): which registered knobs ran
+        # at default / profile / explicit values, and under which
+        # profile + host fingerprint — the ``cli obs`` tuning section's
+        # source. Best-effort like the git probe: a vanished profile
+        # must not block a run from writing its manifest.
+        if hasattr(cfg, "tuning"):
+            from sharetrade_tpu.tuning import describe
+            manifest["tuning"] = describe(cfg)
+    except Exception:
+        pass
+    return manifest
 
 
 def write_manifest(path: str, cfg: Any, *, mesh: Any = None) -> dict:

@@ -121,6 +121,13 @@ class Orchestrator:
                  step_override: Callable[[TrainState], tuple[TrainState, dict]] | None = None,
                  fault_hook: Callable[[int, dict], None] | None = None,
                  error_policy: dict[type, str] | None = None):
+        # Tuned-profile resolution (tuning.py): registered knobs still at
+        # their defaults take the per-host profile's values; explicit
+        # config wins; a fingerprint-mismatched profile raises loudly
+        # (ProfileError is ConfigError = STOP territory). Idempotent, so
+        # a cfg the CLI already resolved passes through unchanged.
+        from sharetrade_tpu.tuning import apply_profile
+        cfg = apply_profile(cfg)
         self.cfg = cfg
         self.mesh = mesh
         if cfg.runtime.megachunk_factor < 1:
@@ -269,6 +276,27 @@ class Orchestrator:
         self._ingest_enabled = (cfg.distrib.num_actors > 0
                                 and cfg.distrib.ingest_every_updates > 0
                                 and cfg.learner.algo == "dqn")
+        # Adaptive ingest cadence (tuning.adaptive_ingest — the online
+        # half of ROADMAP item 5 on the learner side): the LIVE cadence
+        # the boundary checks read. The configured value is the BASE;
+        # the controller backs off (doubling, up to 8x base) after
+        # consecutive all-dry ticks — a caught-up learner must not keep
+        # paying a pipeline-drain boundary + header-peek scan of every
+        # actor journal each `base` updates for nothing — and snaps back
+        # to base the moment rows arrive; a tick that reads a FULL
+        # per-actor window (backlog: the actors are outrunning the
+        # learner, the N=4 ingest-collapse signature) tightens below
+        # base (halving, down to base/4) so the backlog streams in
+        # sooner. Every move is bounded, visible (gauge + counter +
+        # flight event) and inert without a pool.
+        self._ingest_every = max(1, cfg.distrib.ingest_every_updates)
+        self._ingest_base = self._ingest_every
+        self._adaptive_ingest = (self._ingest_enabled
+                                 and cfg.tuning.adaptive_ingest)
+        self._ingest_dry_streak = 0
+        if self._ingest_enabled:
+            self.metrics.record("ingest_every_updates_current",
+                                float(self._ingest_every))
         if cfg.learner.algo == "dqn" and cfg.learner.journal_replay:
             import os
             from sharetrade_tpu.data.service import _open_journal
@@ -1199,7 +1227,10 @@ class Orchestrator:
             if every > 0 and updates // every > last // every:
                 return True
         if self._ingest_enabled:
-            every = self.cfg.distrib.ingest_every_updates
+            # Live cadence (adaptive ingest): benign race with the
+            # dispatcher's adjustments — over-triggering just drains and
+            # re-evaluates, like every other attention hint here.
+            every = self._ingest_every
             if updates // every > self._last_ingest_updates // every:
                 return True
         return (int(row.get("env_steps", 0))
@@ -1248,9 +1279,8 @@ class Orchestrator:
 
         updates = int(metrics.get("updates", 0))
         if (self._ingest_enabled
-                and updates // self.cfg.distrib.ingest_every_updates
-                > self._last_ingest_updates
-                // self.cfg.distrib.ingest_every_updates):
+                and updates // self._ingest_every
+                > self._last_ingest_updates // self._ingest_every):
             # Actor-feed ingest (distrib/): contained like the periodic
             # eval below — a torn actor journal or a transient read error
             # is an ingest miss, not a training fault; the next cadence
@@ -1665,6 +1695,7 @@ class Orchestrator:
         max_rows = (self.cfg.distrib.ingest_max_rows
                     or self.cfg.learner.replay_capacity)
         total = 0
+        backlog = False
         per_actor: dict[str, int] = {}
         for path in sorted(glob.glob(
                 os.path.join(root, "*", TRANSITIONS_FILE))):
@@ -1680,6 +1711,13 @@ class Orchestrator:
                 continue
             obs, action, reward, next_obs, high_water = out
             rows = int(obs.shape[0])
+            if rows >= max_rows:
+                # A FULL window means the reader truncated: this actor's
+                # journal holds more committed rows than one tick may
+                # splice — the backlog signal the adaptive cadence
+                # tightens on (the rest streams across later ticks, the
+                # read_new_transitions oldest-first contract).
+                backlog = True
             if rows:
                 if obs.shape[1] != self.env.obs_dim:
                     log.error(
@@ -1716,7 +1754,59 @@ class Orchestrator:
             log.info("ingested %d actor transition rows (%s)", total,
                      ", ".join(f"{k}:{v}"
                                for k, v in sorted(per_actor.items())))
+        self._adapt_ingest_cadence(total, backlog)
         return total
+
+    #: Adaptive-cadence bounds, as factors of the configured base
+    #: cadence: backoff doubles up to base*8 (dry feeds), tightening
+    #: halves down to max(1, base/4) (backlog). Class attributes so the
+    #: fake-clock tests and the bench name the same contract.
+    INGEST_BACKOFF_MAX_FACTOR = 8
+    INGEST_TIGHTEN_DIV = 4
+    #: Consecutive all-dry ticks before the first backoff step: one dry
+    #: tick is a scheduling phase artifact, three is a caught-up learner.
+    INGEST_DRY_TICKS = 3
+
+    def _adapt_ingest_cadence(self, rows: int, backlog: bool) -> None:
+        """One bounded AIMD step of the live ingest cadence (see the
+        ``_ingest_every`` construction comment for the policy). Runs on
+        the dispatcher thread right after an ingest tick — the only
+        writer of ``_ingest_every``."""
+        if not self._adaptive_ingest:
+            return
+        base = self._ingest_base
+        every = self._ingest_every
+        new = every
+        reason = None
+        if rows == 0:
+            self._ingest_dry_streak += 1
+            if (self._ingest_dry_streak >= self.INGEST_DRY_TICKS
+                    and every < base * self.INGEST_BACKOFF_MAX_FACTOR):
+                new = min(base * self.INGEST_BACKOFF_MAX_FACTOR, every * 2)
+                reason = "feeds_dry"
+        else:
+            self._ingest_dry_streak = 0
+            if backlog:
+                floor = max(1, base // self.INGEST_TIGHTEN_DIV)
+                if every > floor:
+                    new = max(floor, every // 2)
+                    reason = "backlog"
+            elif every > base:
+                # Data is flowing again after a dry backoff: snap back
+                # to the configured cadence in one step (a gradual walk
+                # down would under-ingest for several boundaries).
+                new = base
+                reason = "recovered"
+        if new == every:
+            return
+        self._ingest_every = new
+        self.metrics.inc("ingest_adjustments_total")
+        self.metrics.record("ingest_every_updates_current", float(new))
+        self.obs.record("ingest_cadence_adjust", reason=reason,
+                        every=new, base=base, rows=rows,
+                        backlog=backlog)
+        log.info("adaptive ingest cadence: every %d -> %d updates (%s)",
+                 every, new, reason)
 
     def _warm_start_replay(self, state: TrainState) -> TrainState:
         """Rebuild the DQN replay buffer from the transitions journal. The

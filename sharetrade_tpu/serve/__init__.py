@@ -12,8 +12,14 @@
   circuit breaker instead of re-hammering a wedged tag.
 - :mod:`driver` — synthetic portfolio sessions + closed/open-loop load
   harnesses (``cli serve``, ``tools/serve_soak.py``, ``bench_serve``).
+- :mod:`controller` — :class:`ServeController`: the ONLINE half of the
+  self-tuning runtime (ROADMAP item 5): a hysteresis-guarded feedback
+  loop on the engine's own windowed latency histogram that adapts the
+  ``batch_timeout_ms``/``max_queue`` knobs (bounded steps, configured
+  values as ceilings) to hold a target p99 under the measured load.
 """
 
+from sharetrade_tpu.serve.controller import ServeController  # noqa: F401
 from sharetrade_tpu.serve.engine import (  # noqa: F401
     ServeDeadlineExceeded,
     ServeEngine,

@@ -264,6 +264,20 @@ class _Live(NamedTuple):
     step: int
 
 
+class _LiveKnobs(NamedTuple):
+    """The engine's RUNTIME-TUNABLE knobs as one immutable reference —
+    the same atomicity pattern as :class:`_Live` weights: swapped by
+    :meth:`ServeEngine.set_knobs` (the online controller's actuator,
+    serve/controller.py), read once per decision site, so a tick never
+    sees a half-applied knob vector. The CONFIGURED values are the
+    ceilings: the controller only ever tightens below them (config is
+    the operator's safety rail, never something the controller can
+    exceed)."""
+
+    batch_timeout_ms: float
+    max_queue: int
+
+
 class _Request:
     """A submitted query; completed by the consumer thread (or, for
     rejected/expired work, by the thread that discovered the terminal
@@ -431,8 +445,21 @@ class ServeEngine:
         self._carry0 = precision.cast_carry(model.init_carry(), model)
         self._build_arena_and_programs()
 
-        # Bounded ingress: depth caps at serve.max_queue, the overload
-        # surface (submit sheds/rejects instead of growing host memory).
+        # Live tunable knobs (tuned-knob-ok: seeded from config — the
+        # ceiling — then adjusted only DOWNWARD by the online controller
+        # through set_knobs). Read via self._knobs at each decision site.
+        self._knobs = _LiveKnobs(
+            batch_timeout_ms=float(cfg.batch_timeout_ms),
+            max_queue=int(cfg.max_queue))
+        # Current-knob gauges: every adjustment is VISIBLE (the ISSUE-14
+        # contract — the controller may never move a knob silently).
+        self._registry.record_many({
+            "serve_knob_batch_timeout_ms": self._knobs.batch_timeout_ms,
+            "serve_knob_max_queue": float(self._knobs.max_queue)})
+        # Bounded ingress: depth caps at the live max_queue knob (seeded
+        # from serve.max_queue, the hard ceiling), the overload surface
+        # (submit sheds/rejects instead of growing host memory).
+        # set_knobs() retargets the bound in place under the queue mutex.
         self._q: queue.Queue = queue.Queue(maxsize=cfg.max_queue)
         # trace-buffer-ok: bounded by logic, not maxlen — _collect_batch
         # sheds/rejects past cfg.max_queue (the deferred-overflow branch)
@@ -705,8 +732,8 @@ class ServeEngine:
                 self._registry.inc("serve_queue_rejected_total")
                 self._registry.record("serve_overload", 1.0)
                 self._finish_failed(req, ServeRejected(
-                    f"ingress queue full ({self.cfg.max_queue}); request "
-                    "rejected under shed_policy='reject'",
+                    f"ingress queue full ({self._knobs.max_queue}); "
+                    "request rejected under shed_policy='reject'",
                     reason="queue_full"))
                 return req
             # shed_policy == "oldest": drop the oldest queued request and
@@ -720,7 +747,8 @@ class ServeEngine:
             self._registry.record("serve_overload", 1.0)
             self._finish_failed(victim, ServeRejected(
                 f"shed from the ingress queue under overload "
-                f"(shed_policy='oldest', max_queue={self.cfg.max_queue})",
+                f"(shed_policy='oldest', "
+                f"max_queue={self._knobs.max_queue})",
                 reason="shed_oldest"))
 
     def _finish_failed(self, req: _Request, exc: BaseException) -> None:
@@ -834,6 +862,61 @@ class ServeEngine:
     def registry(self) -> MetricsRegistry:
         """The engine's metrics registry (counters + SLO gauges)."""
         return self._registry
+
+    @property
+    def knobs(self) -> _LiveKnobs:
+        """The CURRENT live knob vector (one immutable reference — the
+        controller's read side)."""
+        return self._knobs
+
+    @property
+    def latency_histogram(self):
+        """The end-to-end request-latency histogram (obs/hist.py): the
+        online controller windows its p99 objective off snapshot deltas
+        of this — the same bucket math as the ``serve_p99_ms`` gauge."""
+        return self._h_e2e
+
+    def set_knobs(self, *, batch_timeout_ms: float | None = None,
+                  max_queue: int | None = None) -> _LiveKnobs:
+        """Atomically install new runtime knob values (the online
+        controller's actuator; also usable by hand). Both knobs are
+        clamped to the CONFIGURED values as ceilings — ``serve.
+        batch_timeout_ms`` / ``serve.max_queue`` are the operator's
+        safety rails, and a controller that could raise the queue bound
+        above config would re-open the unbounded-ingress memory hole
+        admission control closed. Values are validated loudly; the new
+        vector is returned and published as gauges."""
+        cur = self._knobs
+        if batch_timeout_ms is None:
+            batch_timeout_ms = cur.batch_timeout_ms
+        if max_queue is None:
+            max_queue = cur.max_queue
+        batch_timeout_ms = float(batch_timeout_ms)
+        max_queue = int(max_queue)
+        if batch_timeout_ms < 0:
+            raise ConfigError(
+                f"batch_timeout_ms must be >= 0, got {batch_timeout_ms}")
+        if max_queue < 1:
+            raise ConfigError(f"max_queue must be >= 1, got {max_queue}")
+        batch_timeout_ms = min(batch_timeout_ms, self.cfg.batch_timeout_ms)
+        max_queue = min(max_queue, self.cfg.max_queue)
+        new = _LiveKnobs(batch_timeout_ms=batch_timeout_ms,
+                         max_queue=max_queue)
+        self._knobs = new
+        if max_queue != cur.max_queue:
+            # Retarget the physical ingress bound in place: put_nowait
+            # checks maxsize under this mutex, so the new bound applies
+            # to the very next admission. Shrinking below the current
+            # depth is safe — admissions fail (shed/reject) until the
+            # dispatcher drains back under the bound, which is exactly
+            # the brownout behavior the shrink asked for.
+            with self._q.mutex:
+                self._q.maxsize = max_queue
+                self._q.not_full.notify_all()
+        self._registry.record_many({
+            "serve_knob_batch_timeout_ms": new.batch_timeout_ms,
+            "serve_knob_max_queue": float(new.max_queue)})
+        return new
 
     def swap_params(self, master_params: Any, step: int) -> None:
         """Atomically install new serving weights between batches. The
@@ -1125,10 +1208,13 @@ class ServeEngine:
         work the tick could have served. Expired requests are completed
         with a deadline error at pop time and never join the batch."""
         cfg = self.cfg
+        # ONE knob read per tick (the _Live atomicity pattern): a
+        # mid-collection set_knobs never hands this tick a mixed vector.
+        knobs = self._knobs
         batch: list[_Request] = []
         seen: set = set()
         kept: deque[_Request] = deque()  # trace-buffer-ok: re-queued subset
-        # of _deferred, which _collect_batch bounds at cfg.max_queue
+        # of _deferred, which _collect_batch bounds at the max_queue knob
         now = time.perf_counter()
         while self._deferred:
             req = self._deferred.popleft()
@@ -1152,7 +1238,7 @@ class ServeEngine:
             req.trace.t_collected = time.perf_counter()
             batch.append(req)
             seen.add(req.session_id)
-        deadline = time.perf_counter() + cfg.batch_timeout_ms / 1e3
+        deadline = time.perf_counter() + knobs.batch_timeout_ms / 1e3
         for req in batch:           # anchor to the earliest survivor
             if req.t_deadline is not None:
                 deadline = min(deadline, req.t_deadline)
@@ -1167,7 +1253,7 @@ class ServeEngine:
             if self._expire_if_dead(req, time.perf_counter()):
                 continue
             if req.session_id in seen:
-                if len(self._deferred) >= cfg.max_queue:
+                if len(self._deferred) >= knobs.max_queue:
                     # The deferred side-queue is bounded too: a single-
                     # session flood must not re-grow the memory the
                     # ingress bound just capped. The loser follows the
@@ -1554,7 +1640,7 @@ class ServeEngine:
             self._stats_occupancy = []
         depth = self._q.qsize()
         overloaded = (overload_events > 0
-                      or depth >= self.cfg.max_queue)
+                      or depth >= self._knobs.max_queue)
         row: dict[str, float] = {
             "serve_qps": completed / interval,
             "serve_queue_depth": float(depth),

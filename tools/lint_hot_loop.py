@@ -162,6 +162,21 @@ in tests/test_megachunk.py:
     The supervisor's consumer-side functions (``_reap``,
     ``_heartbeat_ages``) must keep existing — a rename must update this
     lint, not silently un-guard the reap seam.
+
+13. **Registered knobs have no hard-coded shadows** (the self-tuning
+    PR's guard) — a knob in the tuning registry
+    (``sharetrade_tpu/tuning.py`` ``KNOBS``) is read through the
+    profile/controller layer: config seeds it, the tuned profile may
+    override the default, and the online controllers adjust it within
+    config ceilings. A fresh ASSIGNMENT of a NUMERIC LITERAL to a name
+    or attribute matching a registered knob's leaf inside
+    ``sharetrade_tpu/serve/`` or ``sharetrade_tpu/runtime/`` re-creates
+    the hand-set constant the registry exists to retire — the value
+    silently stops following the profile and the controller gauges lie.
+    FAILS on such an assignment unless the line (or the two preceding
+    lines) carries ``tuned-knob-ok`` naming why a literal is correct
+    there; also fails when a registered dotted path disappears from
+    tuning.py (the registry and this lint must move together).
 """
 
 from __future__ import annotations
@@ -411,6 +426,22 @@ TRACE_BUFFER_DIRS = ("serve", "obs")
 #: construction line or within the two preceding comment lines).
 TRACE_BUFFER_MARKER = "trace-buffer-ok"
 
+#: Check 13 (the self-tuning PR): the knob registry file — every dotted
+#: path below must stay registered there — and the packages where a
+#: registered knob must be read through the profile/controller layer,
+#: never re-hard-coded.
+TUNING_REGISTRY_FILE = (pathlib.Path(__file__).resolve().parent.parent
+                        / "sharetrade_tpu" / "tuning.py")
+TUNED_KNOB_PATHS = (
+    "runtime.megachunk_factor", "runtime.pipeline_depth",
+    "serve.max_batch", "serve.batch_timeout_ms", "serve.max_queue",
+    "distrib.ingest_every_updates", "distrib.ingest_max_rows",
+)
+TUNED_KNOB_DIRS = ("serve", "runtime")
+#: Escape hatch naming why a literal assignment of a registered knob is
+#: correct (construction line or the two preceding lines).
+TUNED_KNOB_MARKER = "tuned-knob-ok"
+
 
 def lint_hot_loop_syncs() -> tuple[list[tuple[str, int, str]], set[str]]:
     return _scan_named_funcs(HOT_FUNCS, PATTERN, MARKER)
@@ -563,6 +594,60 @@ def lint_actor_spawn(
             if (ACTOR_SPAWN_PATTERN.search(text)
                     and ACTOR_SPAWN_MARKER not in text):
                 bad.append((rel, ln, text.strip()))
+    return bad, found
+
+
+def lint_tuned_knob_shadows(
+        roots: list | None = None,
+        registry: pathlib.Path | None = None
+        ) -> tuple[list[tuple[str, int, str]], set[str]]:
+    """Check 13: no numeric-literal ASSIGNMENT to a name/attribute whose
+    leaf matches a registered tuning knob inside ``serve/``/``runtime/``
+    (marker-exempt on the line or the two above); the registry file must
+    still name every dotted path. Returns (hits, registered-paths found
+    in the registry file). ``roots``/``registry`` override the scanned
+    locations (tests exercise the semantics on fixtures)."""
+    targets = (roots if roots is not None
+               else [TARGET.parent.parent / d for d in TUNED_KNOB_DIRS])
+    registry = registry or TUNING_REGISTRY_FILE
+    leaves = {p.split(".")[-1] for p in TUNED_KNOB_PATHS}
+    found: set[str] = set()
+    reg_src = registry.read_text()
+    for node in ast.walk(ast.parse(reg_src)):
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and node.value in TUNED_KNOB_PATHS):
+            found.add(node.value)
+    bad: list[tuple[str, int, str]] = []
+    for root in targets:
+        for path in sorted(pathlib.Path(root).glob("*.py")):
+            src = path.read_text()
+            lines = src.splitlines()
+            for node in ast.walk(ast.parse(src)):
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    targets_ = (node.targets
+                                if isinstance(node, ast.Assign)
+                                else [node.target])
+                    value = node.value
+                else:
+                    continue
+                if value is None or not (
+                        isinstance(value, ast.Constant)
+                        and type(value.value) in (int, float)):
+                    continue
+                names = set()
+                for tgt in targets_:
+                    if isinstance(tgt, ast.Name):
+                        names.add(tgt.id)
+                    elif isinstance(tgt, ast.Attribute):
+                        names.add(tgt.attr)
+                if not names & leaves:
+                    continue
+                window = lines[max(0, node.lineno - 3):node.lineno]
+                if any(TUNED_KNOB_MARKER in ln for ln in window):
+                    continue
+                bad.append((f"{pathlib.Path(root).name}/{path.name}",
+                            node.lineno, lines[node.lineno - 1].strip()))
     return bad, found
 
 
@@ -800,6 +885,25 @@ def main() -> int:
               f"line '# {ACTOR_SPAWN_MARKER}: <who supervises this "
               "child>'")
         return 1
+    knob_bad, knob_found = lint_tuned_knob_shadows()
+    knob_missing = set(TUNED_KNOB_PATHS) - knob_found
+    if knob_missing:
+        print(f"tuned-knob lint: knob path(s) {sorted(knob_missing)} not "
+              f"found in {TUNING_REGISTRY_FILE} — the tuning registry "
+              "and tools/lint_hot_loop.py TUNED_KNOB_PATHS must move "
+              "together")
+        return 1
+    if knob_bad:
+        print("tuned-knob shadow lint FAILED:")
+        for rel, ln, text in knob_bad:
+            print(f"  sharetrade_tpu/{rel}:{ln}: {text}")
+        print("a numeric-literal assignment to a registered tuning knob "
+              "in serve//runtime/ re-creates the hand-set constant the "
+              "registry retired (the profile/controller layer silently "
+              "stops owning it); read it through config/set_knobs, or "
+              f"tag the line '# {TUNED_KNOB_MARKER}: <why a literal is "
+              "correct here>'")
+        return 1
     dur_bad = lint_durable_replace()
     if dur_bad:
         print("durable-rename fsync lint FAILED:")
@@ -823,6 +927,8 @@ def main() -> int:
           f"serve overload-safety lint OK; "
           f"trace-buffer bound lint OK ({', '.join(TRACE_BUFFER_DIRS)}); "
           f"actor-spawn lint OK ({ACTOR_SPAWN_MODULE}); "
+          f"tuned-knob shadow lint OK ({len(TUNED_KNOB_PATHS)} knobs, "
+          f"{', '.join(TUNED_KNOB_DIRS)}); "
           f"durable-rename fsync lint OK ({', '.join(DURABLE_WRITE_FILES)})")
     return 0
 

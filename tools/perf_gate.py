@@ -62,13 +62,17 @@ DEFAULT_TOLERANCES = {"value": 0.25, "mfu": 0.25}
 def lower_is_better(metric: str) -> bool:
     """Gate direction per metric: throughput-like metrics fail when the
     newest value falls BELOW the band; latency-like metrics (``*_ms`` —
-    the serve tier's ``serve_p99_ms``/``serve_p50_ms``) and size-like
-    metrics (``*_bytes`` / ``*_bytes_per_record`` — the replay data
-    plane's ``journal_bytes_per_record``) fail when it rises ABOVE it.
-    Suffix-based so future latency/size rows inherit the right direction
-    without touching the gate."""
+    the serve tier's ``serve_p99_ms``/``serve_p50_ms``, the self-tuning
+    PR's ``autotune_controller_p99_ms``), size-like metrics (``*_bytes``
+    / ``*_bytes_per_record`` — the replay data plane's
+    ``journal_bytes_per_record``), and cost-fraction metrics (``*_frac``
+    / ``*_cost_s`` — the autotune sweep's cost vs the exhaustive grid)
+    fail when it rises ABOVE it. Suffix-based so future latency/size/
+    cost rows inherit the right direction without touching the gate."""
     return (metric.endswith("_ms") or metric.endswith("_latency")
-            or metric.endswith("_bytes") or metric.endswith("_bytes_per_record"))
+            or metric.endswith("_bytes")
+            or metric.endswith("_bytes_per_record")
+            or metric.endswith("_frac") or metric.endswith("_cost_s"))
 
 
 def _legacy_backend(path_keys: tuple[str, ...], row: dict) -> str:
