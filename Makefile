@@ -8,7 +8,7 @@
 
 PYTHON ?= python
 
-.PHONY: check test slow native bench autotune autotune-quick bench-actor bench-async bench-autotune bench-ckpt bench-dispatch bench-obs bench-precision bench-replay bench-reshard bench-roofline bench-serve bench-serve-overload actor-soak crash-soak obs-demo lint perf-gate serve-chaos serve-soak shard-audit clean
+.PHONY: check test slow native bench autotune autotune-quick bench-actor bench-async bench-autotune bench-ckpt bench-dispatch bench-fleet bench-obs bench-precision bench-replay bench-reshard bench-roofline bench-serve bench-serve-overload actor-soak crash-soak fleet-soak obs-demo lint perf-gate serve-chaos serve-soak shard-audit clean
 
 check: native lint
 	$(PYTHON) -m pytest tests/ -q -m "not slow" -x
@@ -16,6 +16,7 @@ check: native lint
 	$(PYTHON) tools/obs_demo.py
 	$(PYTHON) tools/serve_chaos.py --injections 2
 	$(PYTHON) tools/actor_soak.py --kills 2 --actors 2 --quick --no-scale
+	$(PYTHON) tools/fleet_soak.py --quick
 	$(PYTHON) tools/autotune.py --quick --out /tmp/tuned_profile_quick.json --json
 	$(PYTHON) tools/shard_audit.py
 	$(PYTHON) tools/perf_gate.py
@@ -167,6 +168,27 @@ bench-actor:
 # tests/test_actor_soak.py and in `make check`).
 actor-soak:
 	$(PYTHON) tools/actor_soak.py --kills 20 --actors 4
+
+# Fleet kill-test (tools/fleet_soak.py): one cli fleet tier (router +
+# N cli serve --listen engine workers + live learner) under closed-loop
+# journaling load; whole-engine SIGKILLs mid-ramp, asserting after every
+# kill: router answers immediately, zero client requests fail (migration
+# through prefill), restart counters reconcile exactly — then the
+# flywheel closes (session journals ingested, tag_best republished,
+# every engine hot-swaps) and SIGTERM drains the tier with exit 75. The
+# quick 1-kill profile rides tier-1 (tests/test_fleet_soak.py) and
+# `make check`.
+fleet-soak:
+	$(PYTHON) tools/fleet_soak.py --engines 3 --kills 3
+
+# Fleet scale-out bench (bench.py bench_fleet): single-engine saturation
+# vs N=2/4 engines behind the router, wire-framed, each engine pinned to
+# its own core slice — the numbers behind BASELINE.md "Fleet serving"
+# and the fleet_qps / fleet_p99_ms perf-gate series (acceptance: N=4 >=
+# 2.5x single-engine saturation).
+bench-fleet:
+	$(PYTHON) -c "import json, bench; \
+	print(json.dumps(bench.bench_fleet(), indent=2))"
 
 # Process-kill chaos soak: >= 20 seeded SIGKILL/SIGTERM injections into real
 # training subprocesses (journaled DQN config), each followed by --resume,

@@ -1344,6 +1344,203 @@ def bench_autotune(*, duration_s: float = 1.2, sessions: int = 1024,
     }
 
 
+def bench_fleet(*, engine_counts: tuple[int, ...] = (1, 2, 4),
+                duration_s: float = 3.0, engine_cpus: int = 2,
+                max_batch: int = 4, window: int = 384,
+                workers: int = 96, sessions: int = 256,
+                rate_ladder: tuple[float, ...] = (100.0, 200.0, 400.0,
+                                                  800.0, 1600.0)) -> dict:
+    """Fleet scale-out (fleet/ — ISSUE 15): single-engine saturation vs
+    N=2/4 engines behind the telemetry router — every arm is a REAL
+    ``cli fleet`` subprocess (router + supervised ``cli serve --listen``
+    workers, the deployment topology) driven over the wire by the same
+    closed/open-loop harnesses as every other serving number.
+
+    Framing (CPU, BASELINE.md conventions): each engine worker process
+    is PINNED to its own ``engine_cpus``-core slice
+    (``fleet.engine_cpus`` → ``sched_setaffinity``, inherited by XLA) —
+    the one-host stand-in for one-engine-per-machine. Without the pin a
+    single engine's XLA pool spreads over every core and "adding
+    engines" measures scheduler contention, not scale-out. The workload
+    is the WINDOW-mode transformer policy (re-attends the full price
+    window per request — genuinely compute-heavy serving), sized so a
+    pinned engine saturates on COMPUTE well below the router's
+    byte-relay ceiling — the regime a fleet exists for. The client
+    shape is fixed across arms (one loadgen process, ``workers``
+    persistent connections bounding in-flight): the comparison is
+    "same offered load, more engines behind the router". Latencies are
+    CLIENT-OBSERVED wire round trips.
+
+    Gate rows (tools/perf_gate.py):
+
+    - ``fleet_qps`` — widest-fleet (N=4) best achieved QPS over the
+      offered-rate ramp, through the router. Lower is worse.
+    - ``fleet_p99_ms`` — N=4 open-loop p99 at the FIXED offered rate
+      (1.5x the measured single-engine saturation — the rate one engine
+      cannot hold). ``_ms`` suffix: the gate inverts the band.
+
+    Acceptance (ISSUE 15): N=4 sustains >= 2.5x the single-engine
+    saturation QPS.
+    """
+    import os
+    import shutil
+    import signal
+    import sys
+    import tempfile
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import fleet_soak
+    from soak_common import launch_cli
+
+    from sharetrade_tpu.data.synthetic import synthetic_price_series
+    from sharetrade_tpu.fleet.loadgen import WireEngine
+    from sharetrade_tpu.serve.driver import make_sessions, run_open_loop
+
+    prices = np.asarray(
+        synthetic_price_series(length=4096, seed=0).prices, np.float32)
+
+    def make_cfg(n: int, workdir: str) -> FrameworkConfig:
+        cfg = FrameworkConfig()
+        cfg.env.window = window
+        cfg.model.kind = "transformer"
+        cfg.model.seq_mode = "window"
+        cfg.model.num_layers = 1
+        cfg.model.num_heads = 2
+        cfg.model.head_dim = 64
+        cfg.learner.algo = "ppo"        # the transformer agent family
+        cfg.data.csv_path = None
+        cfg.data.synthetic_length = 4096
+        cfg.data.journal_dir = os.path.join(workdir, "journal")
+        cfg.runtime.checkpoint_dir = os.path.join(workdir, "ckpt")
+        cfg.serve.max_batch = max_batch
+        cfg.serve.slots = 4 * max_batch
+        cfg.serve.batch_timeout_ms = 2.0
+        cfg.serve.swap_poll_s = 0.0
+        cfg.fleet.num_engines = n
+        cfg.fleet.dir = os.path.join(workdir, "fleet")
+        cfg.fleet.engine_cpus = engine_cpus
+        cfg.fleet.telemetry_poll_s = 0.5
+        return cfg
+
+    def run_arm(n: int, rate_qps: float | None) -> dict:
+        workdir = tempfile.mkdtemp(prefix=f"bench_fleet_n{n}_")
+        cfg = make_cfg(n, workdir)
+        cfg_path = os.path.join(workdir, "config.json")
+        cfg.save(cfg_path)
+        proc = launch_cli(
+            "fleet", cfg_path, os.path.join(workdir, "fleet.log"),
+            symbol="MSFT",
+            extra_args=["--engines", str(n), "--duration", "0"])
+        wire_eng = None
+        try:
+            ready = fleet_soak.wait_ready(
+                proc, os.path.join(workdir, "fleet.log"),
+                timeout_s=240.0)
+            if ready["engines"] < n:
+                raise RuntimeError(
+                    f"only {ready['engines']}/{n} engines came up")
+            wire_eng = WireEngine(ready["host"], ready["port"],
+                                  workers=workers)
+            # Saturation via an ascending OPEN-loop rate ramp: offered
+            # arrivals at each rung, saturation = the best achieved QPS
+            # (a rung whose achieved falls well under offered means the
+            # ramp passed capacity; stop there). A closed loop at deep
+            # concurrency measures its own resubmission convoy instead
+            # of the fleet (tails in the seconds while the same fleet
+            # holds the equivalent OPEN rate at double-digit p99 —
+            # measured), so the throughput claim comes from offered
+            # load, like every overload number in BASELINE.md.
+            ramp = []
+            best_qps = 0.0
+            best_p99 = None
+            for i, rung in enumerate(rate_ladder):
+                st = run_open_loop(
+                    wire_eng,
+                    make_sessions(prices, window, sessions,
+                                  prefix=f"bf{n}r{i}-"),
+                    rate_qps=rung, duration_s=duration_s)
+                ramp.append({"offered_qps": rung,
+                             "qps": round(st["qps"], 1),
+                             "p99_ms": round(st["p99_ms"], 3),
+                             "dropped": st["dropped"],
+                             "failed": st["failed"]})
+                if st["qps"] > best_qps:
+                    best_qps, best_p99 = st["qps"], st["p99_ms"]
+                if st["qps"] < 0.75 * rung:
+                    break               # past capacity: ramp done
+            if rate_qps is None:
+                # Base arm: ITS saturation sets the fixed offered rate
+                # every arm (itself included) is measured at.
+                rate_qps = 1.5 * best_qps
+            open_stats = run_open_loop(
+                wire_eng,
+                make_sessions(prices, window, sessions,
+                              prefix=f"bf{n}o-"),
+                rate_qps=rate_qps, duration_s=duration_s)
+            return {
+                "engines": n,
+                "saturation_qps": round(best_qps, 1),
+                "saturation_p99_ms": round(best_p99, 3),
+                "ramp": ramp,
+                "fixed_rate": {
+                    "rate_qps": round(rate_qps, 1),
+                    "qps": round(open_stats["qps"], 1),
+                    "p99_ms": round(open_stats["p99_ms"], 3),
+                    "dropped": open_stats["dropped"],
+                    "failed": open_stats["failed"],
+                },
+            }
+        finally:
+            if wire_eng is not None:
+                wire_eng.stop()
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=60)
+                except Exception:   # noqa: BLE001
+                    proc.kill()
+                    proc.wait(timeout=30)
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    # Single-engine arm first: its saturation sets the FIXED offered
+    # rate every wider arm is measured at.
+    arms = [run_arm(engine_counts[0], rate_qps=None)]
+    base_qps = arms[0]["saturation_qps"]
+    fixed_rate = arms[0]["fixed_rate"]["rate_qps"]
+    for n in engine_counts[1:]:
+        arms.append(run_arm(n, rate_qps=fixed_rate))
+    widest = arms[-1]
+    scale = widest["saturation_qps"] / max(base_qps, 1e-9)
+    cfg_env = make_cfg(engine_counts[-1], "/tmp")
+    precision = cfg_env.precision.mode
+    return {
+        **_result_envelope(cfg_env),
+        "metric": "fleet_qps",
+        "value": widest["saturation_qps"],
+        "unit": "requests/s",
+        "precision": precision,
+        "p99": {"metric": "fleet_p99_ms",
+                "value": widest["fixed_rate"]["p99_ms"],
+                "precision": precision,
+                "note": f"N={engine_counts[-1]} wire p99 at the fixed "
+                        f"{fixed_rate:.0f} QPS offered rate (1.5x the "
+                        "single-engine saturation); higher is worse "
+                        "(gate band inverted)"},
+        "engine_cpus": engine_cpus,
+        "fixed_rate_qps": round(fixed_rate, 1),
+        "arms": arms,
+        "scale_factor_widest": round(scale, 2),
+        "accepted_2p5x": scale >= 2.5,
+        "note": ("wire-framed through a real cli fleet subprocess on "
+                 f"CPU; each engine pinned to {engine_cpus} cores "
+                 "(one-host stand-in for one-engine-per-machine); "
+                 "latencies are client-observed wire round trips"),
+    }
+
+
 def bench_replay(*, chunks: int = 24, trials: int = 2,
                  sample_iters: int = 100,
                  eff_max_chunks: int = 150) -> dict:
@@ -2122,6 +2319,7 @@ def _await_devices(attempts: int = 3, timeout_s: float = 180.0,
                  "r['autotune'] = bench.bench_autotune(); "
                  "r['replay'] = bench.bench_replay(); "
                  "r['actor_scaling'] = bench.bench_actor_scaling(); "
+                 "r['fleet'] = bench.bench_fleet(); "
                  "print(json.dumps(r))"],
                 env=scrub, cwd=repo,
                 # Sized for the fallback workloads (reference_shape, the
@@ -2188,6 +2386,7 @@ def main() -> None:
     result["autotune"] = bench_autotune()
     result["replay"] = bench_replay()
     result["actor_scaling"] = bench_actor_scaling()
+    result["fleet"] = bench_fleet()
     print(json.dumps(result), flush=True)
 
 

@@ -324,15 +324,50 @@ def cmd_serve(args) -> int:
                           "max_batch": cfg.serve.max_batch,
                           "slots": cfg.serve.slots}), flush=True)
 
-        sessions = make_sessions(prices, cfg.env.window, args.sessions,
-                                 seed=cfg.seed)
-        if args.rate > 0:
-            stats = run_open_loop(engine, sessions, rate_qps=args.rate,
-                                  duration_s=args.duration, stop=stop_evt)
+        if args.listen:
+            # Fleet worker mode (fleet/frontend.py): expose submit over
+            # the wire instead of driving synthetic load. The client's
+            # X-Deadline-Ms header flows into submit(deadline_ms=);
+            # SIGTERM drains in-flight requests and exits 75 — the same
+            # contract as the synthetic-driver mode, over a socket.
+            from sharetrade_tpu.fleet import EngineBackend, ServeFrontend
+            host, _, port_s = args.listen.rpartition(":")
+            frontend = ServeFrontend(
+                EngineBackend(
+                    engine,
+                    request_timeout_s=cfg.fleet.request_timeout_s),
+                registry, host=host or "127.0.0.1",
+                port=int(port_s or 0)).start()
+            # The pool tails the worker's log for this line to learn the
+            # ephemeral port (fleet/pool.py LISTENING_EVENT).
+            print(json.dumps({"event": "engine_listening",
+                              "host": frontend.host,
+                              "port": frontend.port,
+                              "pid": os.getpid(),
+                              "params_step": step}), flush=True)
+            deadline = (time.monotonic() + args.duration
+                        if args.duration > 0 else None)
+            while not stop_evt.is_set():
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                stop_evt.wait(0.2)
+            frontend.drain(
+                timeout_s=cfg.runtime.preempt_grace_s * 0.25)
+            frontend.stop()
+            stats = {"mode": "listen", "host": frontend.host,
+                     "port": frontend.port}
         else:
-            stats = run_closed_loop(
-                engine, sessions, concurrency=cfg.serve.max_batch,
-                duration_s=args.duration, stop=stop_evt)
+            sessions = make_sessions(prices, cfg.env.window,
+                                     args.sessions, seed=cfg.seed)
+            if args.rate > 0:
+                stats = run_open_loop(engine, sessions,
+                                      rate_qps=args.rate,
+                                      duration_s=args.duration,
+                                      stop=stop_evt)
+            else:
+                stats = run_closed_loop(
+                    engine, sessions, concurrency=cfg.serve.max_batch,
+                    duration_s=args.duration, stop=stop_evt)
 
         # Drain + stop INSIDE the preemption grace budget (the hung-
         # thread check must run BEFORE the summary so the exit code
@@ -633,6 +668,167 @@ def cmd_learner(args) -> int:
         service.close()
 
 
+def cmd_fleet(args) -> int:
+    """The whole serving fleet in one command (fleet/): N supervised
+    ``cli serve --listen`` engine workers (EnginePool), the telemetry-
+    driven router behind one public front-end port, and — with
+    ``--learner`` — a live in-process learner closing the
+    train→serve→train flywheel: served sessions journal transitions
+    under ``distrib.actor_dir`` (fleet/flywheel.py), the learner tails
+    them between megachunks (``distrib.ingest_without_pool``),
+    republishes ``tag_best``, and every engine's swap watcher hot-swaps
+    it in.
+
+    Machine-readable ``fleet_ready`` line once the router port is bound
+    and every engine reported listening; SIGTERM drains the front-end,
+    the engines (their own drain → 75 contract) and the learner, then
+    exits 75."""
+    from sharetrade_tpu.fleet import EnginePool, FleetRouter, ServeFrontend
+    from sharetrade_tpu.utils.metrics import MetricsRegistry
+    from sharetrade_tpu.obs import build_obs
+
+    cfg = _load_config(args)
+    if args.engines:
+        cfg.fleet.num_engines = args.engines
+    if args.learner:
+        # The flywheel's learner half: ingest session journals with no
+        # ActorPool in this process, and evaluate often enough that
+        # tag_best republishes while the fleet is live.
+        cfg.distrib.ingest_without_pool = True
+        if cfg.learner.algo != "dqn":
+            log.error("--learner requires learner.algo=dqn (replay "
+                      "ingest); got %r", cfg.learner.algo)
+            return 1
+        if cfg.data.journal_segment_records <= 0:
+            cfg.data.journal_segment_records = 256
+    service = orch = None
+    pool = router = frontend = obs_bundle = None
+    stop_evt = threading.Event()
+    preempt_at: list[float] = []
+
+    def _on_signal(signum, frame):
+        if not preempt_at:
+            log.warning("received %s; draining the fleet",
+                        signal.Signals(signum).name)
+            preempt_at.append(time.monotonic())
+            stop_evt.set()
+            if pool is not None:
+                pool.quiesce()
+            if orch is not None:
+                orch.request_preempt()
+        else:
+            log.warning("received %s during the drain; hard exit",
+                        signal.Signals(signum).name)
+            if pool is not None:
+                pool.kill_all()     # os._exit skips every finally
+            os._exit(EXIT_PREEMPTED)
+
+    prev_handlers = {
+        s: signal.signal(s, _on_signal)
+        for s in (signal.SIGTERM, signal.SIGINT)}
+    try:
+        registry = MetricsRegistry(
+            max_points=cfg.obs.max_metric_points or None)
+        obs_bundle = build_obs(cfg, registry)
+        pool = EnginePool(cfg, registry=registry, symbol=args.symbol,
+                          start=args.start, end=args.end).start()
+        if preempt_at:
+            pool.quiesce()
+        router = FleetRouter(pool, cfg.fleet, registry,
+                             workdir=cfg.fleet.dir, obs_cfg=cfg.obs,
+                             obs=obs_bundle).start()
+        frontend = ServeFrontend(router, registry, host=cfg.fleet.host,
+                                 port=cfg.fleet.port).start()
+
+        if args.learner:
+            from sharetrade_tpu.config import FrameworkConfig
+            from sharetrade_tpu.runtime import Orchestrator
+            service = PriceDataService(config=cfg.data)
+            response = service.request(args.symbol.split(",")[0].strip(),
+                                       args.start, args.end)
+            # The orchestrator owns its OWN obs bundle; scope it to a
+            # subdir so two exporters never fight over one run dir's
+            # manifest/metrics files (learner telemetry lands in
+            # <obs.dir>/learner, fleet telemetry in <obs.dir>).
+            learner_cfg = FrameworkConfig.from_dict(cfg.to_dict())
+            learner_cfg.distrib.ingest_without_pool = True
+            if learner_cfg.obs.enabled:
+                learner_cfg.obs.dir = os.path.join(cfg.obs.dir,
+                                                   "learner")
+            orch = Orchestrator(learner_cfg)
+            if preempt_at:
+                orch.request_preempt()
+            orch.send_training_data(response.series.prices,
+                                    resume=args.resume)
+            orch.start_training(background=True)
+
+        # Readiness: every engine reported its port (or hit its
+        # bring-up budget — surface what came up either way).
+        deadline = time.monotonic() + cfg.fleet.startup_timeout_s + 10.0
+        while (time.monotonic() < deadline and not stop_evt.is_set()
+               and len(pool.endpoints()) < cfg.fleet.num_engines):
+            stop_evt.wait(0.25)
+        router.poll_once()
+        print(json.dumps({"event": "fleet_ready",
+                          "host": frontend.host, "port": frontend.port,
+                          "engines": len(pool.endpoints()),
+                          "target_engines": cfg.fleet.num_engines,
+                          "dir": cfg.fleet.dir,
+                          "learner": bool(args.learner),
+                          "pid": os.getpid()}), flush=True)
+
+        run_deadline = (time.monotonic() + args.duration
+                        if args.duration > 0 else None)
+        while not stop_evt.is_set():
+            if (run_deadline is not None
+                    and time.monotonic() >= run_deadline):
+                break
+            stop_evt.wait(0.25)
+
+        grace = cfg.fleet.drain_grace_s
+        frontend.drain(timeout_s=grace * 0.5)
+        frontend.stop()
+        router.stop()
+        pool.stop(grace_s=grace)
+        if orch is not None:
+            orch.stop()
+        obs_bundle.flush()
+        counters = registry.counters()
+        summary = {
+            "requests": int(counters.get("fleet_requests_total", 0)),
+            "completed": int(counters.get("fleet_completed_total", 0)),
+            "refused": int(counters.get("fleet_refused_total", 0)),
+            "migrations": int(
+                counters.get("fleet_migrations_total", 0)),
+            "engine_restarts": pool.restarts_total,
+            **{f"engines_{k}": v for k, v in pool.counts().items()},
+        }
+        if orch is not None:
+            snap = orch.snapshot() or {}
+            summary["learner_updates"] = snap.get("updates")
+            summary["rows_ingested"] = int(orch.metrics.counters().get(
+                "distrib_rows_ingested_total", 0))
+        if preempt_at:
+            summary["preempted"] = True
+        print(json.dumps(summary))
+        return EXIT_PREEMPTED if preempt_at else 0
+    finally:
+        for s, h in prev_handlers.items():
+            signal.signal(s, h)
+        if frontend is not None:
+            frontend.stop()
+        if router is not None:
+            router.stop()
+        if pool is not None:
+            pool.stop(grace_s=10.0)
+        if orch is not None:
+            orch.stop()
+        if obs_bundle is not None:
+            obs_bundle.close()
+        if service is not None:
+            service.close()
+
+
 def cmd_obs(args) -> int:
     """Summarize a telemetry run dir (obs.enabled=true output): manifest
     identity, span aggregates from the Chrome trace, metrics tail, and the
@@ -676,7 +872,7 @@ def main(argv=None) -> int:
 
     for name, fn in [("train", cmd_train), ("query", cmd_query),
                      ("serve", cmd_serve), ("actor", cmd_actor),
-                     ("learner", cmd_learner)]:
+                     ("learner", cmd_learner), ("fleet", cmd_fleet)]:
         p = sub.add_parser(name)
         p.add_argument("--config", default=None, help="JSON config file")
         p.add_argument("--set", action="append", default=[],
@@ -710,12 +906,30 @@ def main(argv=None) -> int:
         if name == "serve":
             p.add_argument("--duration", type=float, default=10.0,
                            help="seconds to serve the synthetic load "
-                                "(SIGTERM drains and exits 75 earlier)")
+                                "(SIGTERM drains and exits 75 earlier; "
+                                "with --listen, 0 = until SIGTERM)")
             p.add_argument("--sessions", type=int, default=512,
                            help="synthetic user sessions to replay")
             p.add_argument("--rate", type=float, default=0.0,
                            help="open-loop offered QPS; 0 = closed loop "
                                 "at serve.max_batch concurrency")
+            p.add_argument("--listen", default=None, metavar="HOST:PORT",
+                           help="fleet worker mode: expose submit over "
+                                "the wire (fleet/frontend.py) instead "
+                                "of driving synthetic load; port 0 = "
+                                "ephemeral, reported in the "
+                                "engine_listening line")
+        if name == "fleet":
+            p.add_argument("--engines", type=int, default=0,
+                           help="engine workers (0 = fleet.num_engines)")
+            p.add_argument("--duration", type=float, default=0.0,
+                           help="seconds to run (0 = until SIGTERM)")
+            p.add_argument("--learner", action="store_true",
+                           help="run the flywheel's live learner in-"
+                                "process (ingest session journals, "
+                                "republish tag_best)")
+            p.add_argument("--resume", action="store_true",
+                           help="learner resumes the latest checkpoint")
         p.set_defaults(fn=fn)
 
     p = sub.add_parser("obs", help="summarize a telemetry run dir")

@@ -594,6 +594,85 @@ class DistribConfig:
     weight_poll_s: float = 2.0
     # Device steps per actor rollout chunk (0 = runtime.chunk_steps).
     actor_chunk_steps: int = 0
+    # Run the learner-side feed ingest WITHOUT an ActorPool: the fleet
+    # flywheel's learner (``cli fleet --learner``) tails journals that
+    # SERVED SESSIONS write under ``actor_dir`` (fleet/flywheel.py) —
+    # same format, same per-writer cursors, no subprocesses to
+    # supervise. Off by default so plain ``cli train`` runs never pay a
+    # pipeline-drain boundary just to glob an empty actors dir (the
+    # num_actors > 0 gate this flag bypasses).
+    ingest_without_pool: bool = False
+
+
+@dataclass
+class FleetConfig:
+    """Horizontal serving fleet (fleet/) — ROADMAP item 2's scale-out
+    tier: ``num_engines`` whole serve-engine WORKER PROCESSES
+    (``cli serve --listen``, each one PR-10 overload-safe engine behind
+    its own stdlib HTTP front-end) supervised by an :class:`~sharetrade_
+    tpu.fleet.pool.EnginePool` (the distrib/ladder.py supervision
+    contract at engine granularity), behind ONE telemetry-driven router
+    (fleet/router.py) that balances on the signals every engine already
+    exports — ``serve_overload``, queue depth, windowed p99 from
+    bucket-wise-merged histograms — with session affinity and
+    cold-restart-through-prefill as the migration story when an engine
+    drains, dies, or deploys."""
+
+    # Engine worker processes behind the router. The router degrades
+    # gracefully onto survivors as engines fail; ALL engines terminally
+    # failed = the router answers 503 loudly instead of wedging.
+    num_engines: int = 2
+    # Router bind address. Port 0 = ephemeral (the chosen port is printed
+    # in the machine-readable ``fleet_ready`` line). Engines always bind
+    # ephemeral ports on host; the pool discovers them from each worker's
+    # ``engine_listening`` ready line.
+    host: str = "127.0.0.1"
+    port: int = 0
+    # Fleet state root: per-engine logs + worker config, the atomically
+    # rewritten ``fleet_status.json`` (what ``cli obs`` summarizes), and
+    # the journals served sessions write when the flywheel is on.
+    dir: str = "fleet"
+    # Pin each engine worker to a dedicated CPU slice of this many cores
+    # (``sched_setaffinity``, inherited by the worker's XLA threads) — the
+    # one-host stand-in for one-engine-per-machine, and what makes the
+    # scale-out bench honest (without it every engine contends for every
+    # core and N engines measure scheduler noise). 0 = no pinning.
+    engine_cpus: int = 0
+    # Router telemetry cadence: scrape every engine's /healthz +
+    # /metrics, merge the ``serve_request_ms`` bucket expositions
+    # bucket-wise (EXACT — obs/hist.py), publish fleet p50/p99 + SLO
+    # burn, refresh routing scores, rewrite fleet_status.json.
+    telemetry_poll_s: float = 0.5
+    # Session-affinity table bound (LRU): a session sticks to the engine
+    # holding its slot-pool carry; past the bound the stalest mapping is
+    # forgotten (that session re-routes — and re-prefills — like any
+    # migrated one).
+    affinity_max_sessions: int = 65536
+    # Engine-process supervision ladder (shared with distrib actors —
+    # distrib/ladder.py): consecutive-crash streak past
+    # ``max_engine_restarts`` = terminal FAILED, degrade onto survivors.
+    max_engine_restarts: int = 5
+    engine_backoff_initial_s: float = 0.5
+    engine_backoff_max_s: float = 10.0
+    engine_backoff_jitter: float = 0.2
+    supervise_interval_s: float = 0.25
+    # Bring-up budget: a worker that has not printed its
+    # ``engine_listening`` line within this window is presumed wedged
+    # during startup and killed (counts as a crash → ladder).
+    startup_timeout_s: float = 120.0
+    # Health heartbeat: a LISTENING engine whose /healthz has not
+    # answered for this long is presumed wedged and killed (crash →
+    # ladder). 0 = observe-only (ages still exported).
+    health_timeout_s: float = 10.0
+    # Per-scrape HTTP timeout for healthz/metrics polls.
+    scrape_timeout_s: float = 2.0
+    # Front-end wait bound for requests WITHOUT a deadline (a deadline'd
+    # request waits its own deadline plus slack). Bounds a handler
+    # thread's life, never the engine's queueing semantics.
+    request_timeout_s: float = 30.0
+    # Drain budget on SIGTERM: in-flight requests finish, engines drain
+    # (their own SIGTERM → 75 contract), stragglers are killed past it.
+    drain_grace_s: float = 15.0
 
 
 @dataclass
@@ -738,6 +817,7 @@ class FrameworkConfig:
     precision: PrecisionConfig = field(default_factory=PrecisionConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     distrib: DistribConfig = field(default_factory=DistribConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     tuning: TuningConfig = field(default_factory=TuningConfig)
     seed: int = 0
@@ -831,6 +911,7 @@ _NESTED = {
     "precision": PrecisionConfig,
     "serve": ServeConfig,
     "distrib": DistribConfig,
+    "fleet": FleetConfig,
     "obs": ObsConfig,
     "tuning": TuningConfig,
 }

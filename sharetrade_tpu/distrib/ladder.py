@@ -1,0 +1,74 @@
+"""The process-supervision LADDER shared by every pool of child
+processes — one definition of the PR-5/PR-10 contract at process
+granularity.
+
+Two supervisors apply it today: :class:`~sharetrade_tpu.distrib.pool.
+ActorPool` (rollout-actor subprocesses under a live learner, PR 12) and
+:class:`~sharetrade_tpu.fleet.pool.EnginePool` (whole serve-engine
+worker processes behind the fleet router). Both classify every child
+exit the same way — a retiring/quiesced child retires quietly, anything
+else is a CRASH feeding seeded exponential backoff, and a consecutive-
+crash streak past the restart budget is a TERMINAL failure the pool
+degrades around instead of respawning forever. Factoring the ladder here
+(ISSUE 15 satellite) means a contract fix lands in both pools instead of
+drifting between copies; everything pool-SPECIFIC — what "healthy"
+means (heartbeat file vs HTTP healthz), how a child spawns, what state
+file gets written — stays with the pool that owns it.
+
+The states and the crash arithmetic are EXACTLY the ActorPool's
+pre-factor behavior (its kill-test and unit suite pin them): the jitter
+draw is one ``rng.uniform(-jitter, +jitter)`` per crash, so a seeded
+pool replays the same backoff schedule it always did.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+#: Child lifecycle states (the status.json vocabulary, shared verbatim).
+STARTING, ALIVE, BACKOFF, FAILED, RETIRING, RETIRED = (
+    "starting", "alive", "backoff", "failed", "retiring", "retired")
+
+#: States that count as LIVE membership (toward a pool's scale target).
+LIVE_STATES = (STARTING, ALIVE, BACKOFF)
+
+
+@dataclass(frozen=True)
+class LadderPolicy:
+    """The supervision knobs, pool-agnostic: how many consecutive crashes
+    a child may burn before it is terminally FAILED, and the seeded
+    exponential-backoff schedule between respawns."""
+
+    max_restarts: int
+    backoff_initial_s: float
+    backoff_max_s: float
+    backoff_jitter: float
+
+    def validate(self, *, section: str) -> None:
+        from sharetrade_tpu.config import ConfigError
+        if self.max_restarts < 0:
+            raise ConfigError(
+                f"{section} max restarts must be >= 0, got "
+                f"{self.max_restarts}")
+        if self.backoff_initial_s <= 0 or self.backoff_max_s <= 0:
+            raise ConfigError(
+                f"{section} backoff seconds must be > 0, got "
+                f"{self.backoff_initial_s}/{self.backoff_max_s}")
+
+
+def crash_step(streak: int, policy: LadderPolicy,
+               rng: random.Random) -> tuple[str, float]:
+    """One rung of the ladder, applied AFTER a crash bumped the child's
+    consecutive-crash ``streak``: returns ``(next_state, respawn_delay_s)``
+    — :data:`FAILED` (delay 0, the pool degrades onto survivors) once the
+    streak exceeds the budget, else :data:`BACKOFF` with the seeded
+    jittered exponential delay. Draws exactly one jitter sample from
+    ``rng`` on the BACKOFF arm (the replayable-schedule contract)."""
+    if streak > policy.max_restarts:
+        return FAILED, 0.0
+    delay = min(policy.backoff_initial_s * 2 ** (streak - 1),
+                policy.backoff_max_s)
+    delay *= 1.0 + rng.uniform(-policy.backoff_jitter,
+                               policy.backoff_jitter)
+    return BACKOFF, max(delay, 0.0)

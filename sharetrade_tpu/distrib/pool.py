@@ -57,6 +57,16 @@ from typing import Any, Callable
 
 from sharetrade_tpu.config import ConfigError, FrameworkConfig
 from sharetrade_tpu.distrib.actor import HEARTBEAT_FILE, read_heartbeat
+from sharetrade_tpu.distrib.ladder import (
+    ALIVE,
+    BACKOFF,
+    FAILED,
+    RETIRED,
+    RETIRING,
+    STARTING,
+    LadderPolicy,
+    crash_step,
+)
 from sharetrade_tpu.utils.logging import get_logger
 
 log = get_logger("distrib.pool")
@@ -64,10 +74,6 @@ log = get_logger("distrib.pool")
 STATUS_FILE = "status.json"
 SCALE_FILE = "scale"
 CONFIG_FILE = "actor_config.json"
-
-#: Actor lifecycle states (status.json vocabulary).
-STARTING, ALIVE, BACKOFF, FAILED, RETIRING, RETIRED = (
-    "starting", "alive", "backoff", "failed", "retiring", "retired")
 
 
 def read_status(pool_dir: str) -> dict | None:
@@ -296,20 +302,25 @@ class ActorPool:
             self.restarts_total += 1
             if self.registry is not None:
                 self.registry.inc("actor_restarts_total")
-            if h.streak > dc.max_actor_restarts:
-                h.state = FAILED
+            # The shared supervision ladder (distrib/ladder.py): one
+            # definition of terminal-vs-backoff and the seeded jittered
+            # exponential schedule, shared with the fleet's EnginePool.
+            state, delay = crash_step(
+                h.streak,
+                LadderPolicy(max_restarts=dc.max_actor_restarts,
+                             backoff_initial_s=dc.actor_backoff_initial_s,
+                             backoff_max_s=dc.actor_backoff_max_s,
+                             backoff_jitter=dc.actor_backoff_jitter),
+                self._rng)
+            h.state = state
+            if state == FAILED:
                 log.error(
                     "actor %s FAILED terminally: %d consecutive crashes "
                     "past distrib.max_actor_restarts=%d (last rc=%s); "
                     "pool degrades onto the survivors",
                     h.actor_id, h.streak, dc.max_actor_restarts, rc)
                 continue
-            delay = min(dc.actor_backoff_initial_s * 2 ** (h.streak - 1),
-                        dc.actor_backoff_max_s)
-            delay *= 1.0 + self._rng.uniform(-dc.actor_backoff_jitter,
-                                             dc.actor_backoff_jitter)
-            h.state = BACKOFF
-            h.respawn_at = time.monotonic() + max(delay, 0.0)
+            h.respawn_at = time.monotonic() + delay
             log.warning("actor %s crashed (rc=%s); restart %d "
                         "(streak %d/%d) in %.2fs", h.actor_id, rc,
                         h.restarts, h.streak, dc.max_actor_restarts, delay)

@@ -1,0 +1,39 @@
+"""Fleet serving tier (ISSUE 15, ROADMAP item 2's scale-out half).
+
+``fleet/`` turns the single-process :class:`~sharetrade_tpu.serve.
+engine.ServeEngine` into a horizontally-scaled service:
+
+- **wire.py** — the HTTP/1.1 protocol every hop speaks (deadline
+  header, distinct statuses per serving outcome, persistent-connection
+  client);
+- **frontend.py** — the stdlib threaded network front-end serving any
+  ``serve_request`` backend (a local engine, or the router);
+- **pool.py** — :class:`EnginePool`: whole ``cli serve --listen`` worker
+  processes under the shared supervision ladder (distrib/ladder.py);
+- **router.py** — :class:`FleetRouter`: telemetry-driven balancing on
+  the engines' own exported signals, session affinity with
+  cold-restart-through-prefill migration, EXACT fleet quantiles from
+  bucket-wise histogram merges, loud degrade when nothing is left;
+- **flywheel.py / loadgen.py** — served sessions journaling their
+  observed transitions into the learner's ingest path, and the wire
+  adapters that let serve/driver.py's harnesses drive a fleet.
+
+Kill-tested end to end by ``tools/fleet_soak.py``; ``cli fleet`` boots
+the whole tier.
+"""
+
+from sharetrade_tpu.fleet.frontend import EngineBackend, ServeFrontend
+from sharetrade_tpu.fleet.loadgen import WireEngine
+from sharetrade_tpu.fleet.pool import EnginePool
+from sharetrade_tpu.fleet.router import FleetRouter, StaticEndpoints
+from sharetrade_tpu.fleet.wire import FleetClient
+
+__all__ = [
+    "EngineBackend",
+    "EnginePool",
+    "FleetClient",
+    "FleetRouter",
+    "ServeFrontend",
+    "StaticEndpoints",
+    "WireEngine",
+]

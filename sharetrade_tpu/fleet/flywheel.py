@@ -1,0 +1,166 @@
+"""The train→serve→train flywheel's client half: served sessions
+journal their observed transitions.
+
+Production traffic becomes training data through the SAME data plane
+the disaggregated actors use (PR 9's framed journal + PR 12's
+feed-driven ingest): a load source (the fleet soak, ``cli fleet``'s
+driver, a real client integration) wraps its sessions in
+:class:`JournalingSession`, whose every served action lands one
+``(obs, action, reward, next_obs)`` row — reward is the session's own
+observed portfolio-value change, exactly the env's reward definition
+(env/trading.py: ``reward = new_portfolio - current_portfolio``) — in a
+:class:`SessionTransitionJournal`: a per-writer CRC-framed, segment-
+rotated journal under ``distrib.actor_dir/<writer_id>/``, stamped with
+a monotone per-writer row counter recovered from the journal tail at
+boot (restarts never reuse a stamp — the ingest-cursor contract).
+
+The learner half already exists: ``Orchestrator.ingest_actor_feeds``
+re-discovers the journal set from the filesystem each tick, so a
+session journal IS an actor journal as far as the learner is concerned
+(``distrib.ingest_without_pool`` opens the gate when no ActorPool runs
+in the learner process). The loop closes through the existing weight
+path: the learner republishes ``tag_best``, every engine's
+``WeightSwapWatcher`` hot-swaps it in, and every response's
+``params_step`` names the checkpoint that produced it — the soak's
+propagation proof.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from sharetrade_tpu.serve.driver import SessionSim
+from sharetrade_tpu.utils.logging import get_logger
+
+log = get_logger("fleet.flywheel")
+
+TRANSITIONS_FILE = "transitions.journal"    # the distrib/actor layout
+
+
+class SessionTransitionJournal:
+    """One writer's transitions journal under the learner's ingest root.
+
+    Thread-safe: many session callbacks append concurrently (the wire
+    driver completes requests on worker threads); rows buffer in memory
+    and commit as one framed record per ``flush_rows`` (the group-commit
+    shape ingest reads back whole). Stamps are a monotone cumulative row
+    counter per writer, recovered from the journal tail at construction
+    — the same contract ``distrib/actor.py`` keeps, so the learner's
+    per-writer cursor survives client restarts."""
+
+    def __init__(self, root: str, writer_id: str, *, obs_dim: int,
+                 flush_rows: int = 64, segment_records: int = 256,
+                 fsync_every_records: int = 64,
+                 fsync_interval_s: float = 0.5):
+        from sharetrade_tpu.data.journal import Journal
+        from sharetrade_tpu.data.transitions import read_tail_transitions
+        self.workdir = os.path.join(root, writer_id)
+        os.makedirs(self.workdir, exist_ok=True)
+        self.path = os.path.join(self.workdir, TRANSITIONS_FILE)
+        self._journal = Journal(
+            self.path,
+            fsync_every_records=fsync_every_records,
+            fsync_interval_s=fsync_interval_s,
+            segment_records=segment_records)
+        self.obs_dim = int(obs_dim)
+        self.flush_rows = max(1, int(flush_rows))
+        tail = read_tail_transitions(self.path, 1, journal=self._journal)
+        self._stamp = int(tail[4]) if tail is not None else 0
+        self.rows_journaled = 0
+        self._buf: list[tuple] = []
+        self._lock = threading.Lock()
+
+    def record(self, obs, action: int, reward: float, next_obs) -> None:
+        obs = np.asarray(obs, np.float32)
+        next_obs = np.asarray(next_obs, np.float32)
+        if obs.shape != (self.obs_dim,) or next_obs.shape != obs.shape:
+            # Fail HERE, at the writer, not two processes later when the
+            # learner's ingest refuses the whole journal.
+            raise ValueError(
+                f"transition obs shape {obs.shape}/{next_obs.shape} != "
+                f"the journal's obs_dim ({self.obs_dim},) — is the "
+                "session's window the learner's env window?")
+        with self._lock:
+            self._buf.append((obs, int(action), float(reward), next_obs))
+            if len(self._buf) >= self.flush_rows:
+                self._flush_locked()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+            self._journal.flush()
+
+    def _flush_locked(self) -> None:
+        if not self._buf:
+            return
+        from sharetrade_tpu.data.transitions import append_transitions
+        rows = self._buf
+        self._buf = []
+        obs = np.stack([r[0] for r in rows])
+        action = np.asarray([r[1] for r in rows], np.int32)
+        reward = np.asarray([r[2] for r in rows], np.float32)
+        next_obs = np.stack([r[3] for r in rows])
+        self._stamp += len(rows)
+        append_transitions(self._journal, obs, action, reward, next_obs,
+                           env_steps=self._stamp)
+        self.rows_journaled += len(rows)
+
+    def close(self) -> None:
+        self.flush()
+        self._journal.close()
+
+
+class JournalingSession(SessionSim):
+    """A served session that journals what it observes: each
+    :meth:`advance` computes the portfolio-value reward of the action it
+    was served, captures the before/after observations, and records the
+    transition. Obs shape matches the learner env exactly (window prices
+    + [budget, shares]) — the ingest path refuses mismatched dims
+    loudly, so a misconfigured fleet cannot silently poison replay."""
+
+    def __init__(self, *args, journal: SessionTransitionJournal
+                 | None = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.journal = journal
+
+    def advance(self, action: int) -> None:
+        if self.journal is None:
+            super().advance(action)
+            return
+        obs_t = self.observation()
+        price = float(self.prices[self.start + self.t + self.window])
+        value_before = self.budget + self.shares * price
+        gen = self.generation
+        super().advance(action)
+        if self.generation != gen:
+            # Episode wrapped: the fresh episode's first observation is
+            # not this transition's successor — skip the boundary row
+            # (the integrated trainer's journal has no done flag either;
+            # at the serving tier's gamma the bootstrap cost is nil, and
+            # a wrong-successor row is worse than a missing one).
+            return
+        price_next = float(
+            self.prices[self.start + self.t + self.window])
+        value_after = self.budget + self.shares * price_next
+        self.journal.record(obs_t, action, value_after - value_before,
+                            self.observation())
+
+
+def make_journaling_sessions(prices, window: int, n: int, *,
+                             journal: SessionTransitionJournal,
+                             seed: int = 0,
+                             prefix: str = "fs") -> list[JournalingSession]:
+    """``n`` journaling sessions with staggered starts (the
+    ``make_sessions`` shape, flywheel-wired)."""
+    prices = np.asarray(prices, np.float32)
+    horizon = len(prices) - window - 1
+    if horizon < 1:
+        raise ValueError(f"price series too short for window={window}")
+    rng = np.random.default_rng(seed)
+    starts = rng.integers(0, max(horizon - 1, 1), size=n)
+    return [JournalingSession(f"{prefix}{i}", prices, window, starts[i],
+                              journal=journal)
+            for i in range(n)]

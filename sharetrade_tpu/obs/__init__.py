@@ -383,6 +383,50 @@ def summarize_run_dir(run_dir: str) -> dict:
                 "ingest_adjustments_total": counters.get(
                     "ingest_adjustments_total", 0.0),
             }
+    fleet_path = os.path.join(run_dir, "fleet_status.json")
+    if os.path.isfile(fleet_path):
+        # Fleet serving tier (``cli fleet`` / fleet/router.py): the
+        # router's atomically-rewritten status — per-engine membership +
+        # routing telemetry, merged-histogram fleet quantiles, affinity
+        # table size, swap-propagation lag — condensed the same way the
+        # other sections are (no registry-key spelunking required).
+        try:
+            with open(fleet_path, encoding="utf-8") as f:
+                fs = json.load(f)
+        except (OSError, ValueError):
+            fs = None
+        if fs:
+            pool = fs.get("pool") or {}
+            telemetry = fs.get("telemetry") or {}
+            fgauges = fs.get("gauges") or {}
+            engines = {}
+            for eid, e in (pool.get("engines") or {}).items():
+                t = telemetry.get(eid) or {}
+                engines[eid] = {
+                    "state": e.get("state"), "pid": e.get("pid"),
+                    "port": e.get("port"),
+                    "restarts": e.get("restarts"),
+                    "params_step": e.get("params_step"),
+                    "queue_depth": e.get("queue_depth"),
+                    "window_p99_ms": t.get("window_p99_ms"),
+                }
+            out["fleet"] = {
+                "engines": engines,
+                "alive": pool.get("alive"),
+                "failed": pool.get("failed"),
+                "restarts_total": pool.get("restarts_total"),
+                "engines_live": (fs.get("router") or {}).get(
+                    "engines_live"),
+                "merged_p50_ms": fgauges.get("fleet_p50_ms"),
+                "merged_p99_ms": fgauges.get("fleet_p99_ms"),
+                "merged_request_ms": fs.get("fleet_request_ms"),
+                "affinity_sessions": (fs.get("router") or {}).get(
+                    "affinity_sessions"),
+                "swap_lag_steps": fgauges.get("fleet_swap_lag_steps"),
+                "slo_availability_burn": fgauges.get(
+                    "fleet_slo_availability_burn"),
+                "counters": fs.get("counters"),
+            }
     exemplars_path = os.path.join(run_dir, "serve_exemplars.json")
     if os.path.isfile(exemplars_path):
         with open(exemplars_path, encoding="utf-8") as f:

@@ -51,6 +51,39 @@ def _le(bound: float) -> str:
     return f"{bound:.12g}"
 
 
+def render_prom_text(gauges: dict, counters: dict,
+                     hists: dict | None = None, *,
+                     prefix: str = "sharetrade") -> str:
+    """ONE definition of the Prometheus exposition this repo emits —
+    the textfile the background exporter atomically rewrites AND the
+    live ``/metrics`` body the fleet front-end serves over the wire
+    (fleet/frontend.py). ``hists`` maps name → :meth:`~sharetrade_tpu.
+    obs.hist.Histogram.snapshot` dicts; buckets export CUMULATIVE with
+    ``le`` labels ending in ``+Inf`` (the merge contract
+    :func:`parse_prom_text` validates on the scrape side)."""
+    lines = []
+    for name, value in sorted(gauges.items()):
+        pname = _prom_name(name, prefix)
+        lines.append(f"# TYPE {pname} gauge")
+        lines.append(f"{pname} {value}")
+    for name, value in sorted(counters.items()):
+        pname = _prom_name(name, prefix)
+        lines.append(f"# TYPE {pname} counter")
+        lines.append(f"{pname} {value}")
+    for name, snap in sorted((hists or {}).items()):
+        pname = _prom_name(name, prefix)
+        lines.append(f"# TYPE {pname} histogram")
+        cum = 0
+        for bound, c in zip(snap["bounds"], snap["counts"]):
+            cum += c
+            lines.append(f'{pname}_bucket{{le="{_le(bound)}"}} {cum}')
+        cum += snap["counts"][len(snap["bounds"])]
+        lines.append(f'{pname}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{pname}_sum {snap['sum']}")
+        lines.append(f"{pname}_count {snap['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 class MetricsExporter:
     def __init__(self, registry: MetricsRegistry, run_dir: str, *,
                  interval_s: float = 2.0, prefix: str = "sharetrade"):
@@ -98,30 +131,11 @@ class MetricsExporter:
 
     def _write_prom(self, gauges: dict, counters: dict,
                     hists: dict | None = None) -> None:
-        lines = []
-        for name, value in sorted(gauges.items()):
-            pname = _prom_name(name, self._prefix)
-            lines.append(f"# TYPE {pname} gauge")
-            lines.append(f"{pname} {value}")
-        for name, value in sorted(counters.items()):
-            pname = _prom_name(name, self._prefix)
-            lines.append(f"# TYPE {pname} counter")
-            lines.append(f"{pname} {value}")
-        for name, snap in sorted((hists or {}).items()):
-            pname = _prom_name(name, self._prefix)
-            lines.append(f"# TYPE {pname} histogram")
-            cum = 0
-            for bound, c in zip(snap["bounds"], snap["counts"]):
-                cum += c
-                lines.append(
-                    f'{pname}_bucket{{le="{_le(bound)}"}} {cum}')
-            cum += snap["counts"][len(snap["bounds"])]
-            lines.append(f'{pname}_bucket{{le="+Inf"}} {cum}')
-            lines.append(f"{pname}_sum {snap['sum']}")
-            lines.append(f"{pname}_count {snap['count']}")
+        text = render_prom_text(gauges, counters, hists,
+                                prefix=self._prefix)
         tmp = f"{self._prom_path}.tmp-{os.getpid()}"
         with open(tmp, "w", encoding="utf-8") as f:
-            f.write("\n".join(lines) + ("\n" if lines else ""))
+            f.write(text)
         os.replace(tmp, self._prom_path)
 
     def stop(self) -> None:

@@ -40,6 +40,7 @@ __all__ = [
     "DEFAULT_MS_BOUNDS",
     "SECONDS_BOUNDS",
     "Histogram",
+    "from_prom_buckets",
     "log_bounds",
     "merge",
     "quantile_from_counts",
@@ -185,3 +186,55 @@ def merge(histograms) -> Histogram:
     for h in hs:
         out.merge(h)
     return out
+
+
+def from_prom_buckets(buckets, total_sum: float, count: int) -> Histogram:
+    """Rebuild a :class:`Histogram` from a scraped Prometheus exposition —
+    ``buckets`` is the ``[(le, cumulative)]`` list :func:`~sharetrade_tpu.
+    obs.exporter.parse_prom_text` returns (``le`` = ``+inf`` for the
+    overflow terminal). The reconstruction is EXACT: cumulative counts
+    diff back to the per-bucket integers the engine observed, so the
+    fleet router's bucket-wise merge of scraped engines equals the merge
+    of the engines' in-process histograms bit for bit (the precondition
+    for exact fleet-level p50/p99 — the aggregation contract README
+    "Request tracing" documents and the fleet extends over the wire).
+
+    Raises ``ValueError`` on a non-monotone cumulative series, a missing
+    ``+Inf`` terminal, or a ``+Inf``/count mismatch — a corrupt scrape
+    must never silently fold garbage into fleet quantiles."""
+    # parse_prom_text hands le through as label TEXT ("+Inf" included);
+    # float() accepts both spellings, so scraped and in-process sources
+    # meet here.
+    buckets = [(float(le), cum) for le, cum in buckets]
+    if not buckets or not math.isinf(buckets[-1][0]):
+        raise ValueError("prom histogram must end in a +Inf bucket")
+    bounds = tuple(le for le, _ in buckets[:-1])
+    # The exporter's %.12g labels drop the last ~4 bits of a double, so
+    # a parsed bound can differ from its source by ~1e-13 relative —
+    # enough for Histogram.merge's layout check to refuse a scraped
+    # shard against an in-process histogram. Snap to the canonical
+    # framework layouts when the LABEL TEXT matches (the actual merge
+    # key two processes share); a foreign layout passes through as
+    # parsed and still merges exactly with other scrapes of itself.
+    for canon in (DEFAULT_MS_BOUNDS, SECONDS_BOUNDS):
+        if len(canon) == len(bounds) and all(
+                f"{c:.12g}" == f"{b:.12g}"
+                for c, b in zip(canon, bounds)):
+            bounds = canon
+            break
+    hist = Histogram(bounds=bounds)
+    counts = []
+    prev = 0.0
+    for le, cum in buckets:
+        if cum < prev:
+            raise ValueError(
+                f"non-monotone cumulative bucket counts at le={le}")
+        counts.append(int(cum - prev))
+        prev = cum
+    if int(buckets[-1][1]) != int(count):
+        raise ValueError(
+            f"+Inf bucket {buckets[-1][1]} != _count {count}")
+    hist.counts = counts
+    hist.sum = float(total_sum)
+    hist.count = int(count)
+    return hist

@@ -273,7 +273,11 @@ class Orchestrator:
         # num_actors gates too: with no pool (plain ``cli train``) the
         # cadence must not force pipeline-drain boundaries every
         # ingest_every_updates just to glob an empty actors dir.
-        self._ingest_enabled = (cfg.distrib.num_actors > 0
+        # ingest_without_pool bypasses that gate for the fleet flywheel:
+        # SERVED SESSIONS write the journals there (fleet/flywheel.py),
+        # so there is data to tail with no ActorPool in this process.
+        self._ingest_enabled = ((cfg.distrib.num_actors > 0
+                                 or cfg.distrib.ingest_without_pool)
                                 and cfg.distrib.ingest_every_updates > 0
                                 and cfg.learner.algo == "dqn")
         # Adaptive ingest cadence (tuning.adaptive_ingest — the online

@@ -1,0 +1,57 @@
+"""Fleet kill-test (tools/fleet_soak.py) — REAL router + engine worker
+subprocesses + live learner, real SIGKILLs, driven in-process.
+
+The quick profile (2 engines, 1 whole-engine SIGKILL under closed-loop
+journaling load) is the tier-1 guard for the fleet contract: the router
+never wedges (a post-kill probe answers immediately and ZERO client
+requests fail — migration absorbs the corpse's in-flight work), the
+pool's restart counter reconciles exactly with the injected kills, the
+flywheel closes (journaled session transitions ingested by the live
+learner, a fresh ``tag_best`` hot-swapped into EVERY engine — healthz
+``params_step`` advances fleet-wide), the merged-histogram fleet SLO
+gauges are live, router counters balance exactly, and SIGTERM drains
+the whole tier with exit 75. The full soak — >=3 engines, >=3 kills —
+is the ``slow``-marked variant (also ``make fleet-soak``).
+"""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import fleet_soak  # noqa: E402
+
+
+class TestQuickSoak:
+    def test_one_kill_flywheel_and_reconciliation(self, tmp_path):
+        summary = fleet_soak.run_soak(
+            engines=2, kills=1, ramp_s=3.0, sessions=32, concurrency=8,
+            workdir=str(tmp_path))
+        assert summary["ok"] is True
+        assert summary["kills_injected"] == 1
+        # Migration absorbed the kill: the closed loop dropped nothing.
+        assert summary["traffic"]["failed"] == 0
+        assert summary["traffic"]["completed"] > 0
+        # Flywheel: sessions' journals fed the learner and the republished
+        # tag_best reached every live engine.
+        fw = summary["flywheel"]
+        assert fw["rows_ingested"] > 0
+        assert all(s > fw["boot_params_step"]
+                   for s in fw["post_swap_params_steps"])
+        # Live merged-histogram SLO gauges.
+        assert summary["fleet_slo"]["merged"]["count"] > 0
+        assert summary["drain_rc"] == 75
+
+
+@pytest.mark.slow
+class TestFullSoak:
+    def test_multi_engine_multi_kill(self, tmp_path):
+        summary = fleet_soak.run_soak(
+            engines=3, kills=3, ramp_s=6.0, sessions=64, concurrency=12,
+            workdir=str(tmp_path))
+        assert summary["ok"] is True
+        assert summary["kills_injected"] >= 3
+        assert summary["traffic"]["failed"] == 0

@@ -1,0 +1,453 @@
+#!/usr/bin/env python
+"""Fleet kill-test: offered-load ramp, whole-engine SIGKILL chaos, and
+the train→serve→train flywheel — end to end through the public surface.
+
+The system under test is ONE ``cli fleet --learner`` subprocess: the
+telemetry-driven router on its public port, N supervised ``cli serve
+--listen`` engine workers, and the live in-process learner. This soak is
+the CLIENT: it drives a closed-loop ramp over the wire with JOURNALING
+sessions (every served action becomes a transition row in the learner's
+ingest path — fleet/flywheel.py), SIGKILLs whole engines mid-ramp, and
+asserts after EVERY kill and at the end:
+
+- **router never wedges** — a probe request on a fresh session completes
+  within its budget immediately after each kill, and the ramp's sessions
+  keep completing (the router's transport-retry migration path absorbs
+  requests in flight on the corpse);
+- **supervised recovery** — the pool's restart counter reconciles
+  EXACTLY with the injected kill count (no spurious restarts), and
+  ``fleet_engines_live`` returns to N within the recovery budget;
+- **migration through prefill** — sessions stuck to a killed engine
+  keep completing on survivors (their slot carries re-enter cold; the
+  bitwise prefill contract itself is pinned by tests/test_fleet.py —
+  here it must hold under real process death and load);
+- **flywheel** — ``distrib_rows_ingested_total`` moves (the learner is
+  eating the sessions' journals), a fresh ``tag_best`` is published, and
+  EVERY surviving engine hot-swaps it in (healthz ``params_step``
+  advances from the boot step on all of them, swap counters move) while
+  a settle window of requests completes with zero failures;
+- **fleet SLO gauges** — merged-histogram ``fleet_p50/p99_ms`` are
+  present and finite in ``fleet_status.json`` (the exact bucket-wise
+  merge is pinned by tests; here it must be LIVE);
+- **counter reconciliation** — router counters balance exactly:
+  ``fleet_requests_total == fleet_completed_total + fleet_refused_total
+  + fleet_unrouted_total``, and the client's completed+failed matches
+  its submissions;
+- **drain** — SIGTERM ends the whole tier with exit 75, engine journals
+  stay CRC-clean through the segmented reader.
+
+Usage:
+    python tools/fleet_soak.py                     # full (~3 engines, >=3 kills)
+    python tools/fleet_soak.py --quick             # tier-1 profile (2 engines, 1 kill)
+    python tools/fleet_soak.py --engines 4 --kills 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from soak_common import (  # noqa: E402
+    REPO,
+    SoakError,
+    launch_cli,
+    log_tail,
+    prom_value,
+    read_json,
+    wait_until,
+)
+
+WINDOW = 16
+OBS_DIM = WINDOW + 2
+
+
+def eprint(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def build_config(workdir: str, engines: int) -> str:
+    """The soak's config: tiny MLP serve workload, journaled-DQN
+    learner with session-feed ingest, fast swap/telemetry cadences.
+    All paths ABSOLUTE into the scratch dir (children run from the
+    repo root)."""
+    from sharetrade_tpu.config import FrameworkConfig
+    cfg = FrameworkConfig()
+    cfg.seed = 7
+    cfg.env.window = WINDOW
+    cfg.model.kind = "mlp"
+    cfg.model.hidden_dim = 32
+    cfg.data.csv_path = None
+    cfg.data.synthetic_length = 900
+    cfg.data.journal_dir = os.path.join(workdir, "journal")
+    cfg.data.journal_segment_records = 64
+    cfg.learner.algo = "dqn"
+    cfg.learner.replay_capacity = 4096
+    cfg.learner.replay_batch = 32
+    cfg.learner.journal_replay = False
+    cfg.parallel.num_workers = 4
+    cfg.runtime.chunk_steps = 50
+    cfg.runtime.episodes = 200            # keep the learner LIVE all soak
+    cfg.runtime.eval_every_updates = 8    # republish tag_best early+often
+    cfg.runtime.checkpoint_every_updates = 50
+    cfg.runtime.checkpoint_dir = os.path.join(workdir, "checkpoints")
+    cfg.serve.max_batch = 8
+    cfg.serve.slots = 64
+    cfg.serve.batch_timeout_ms = 2.0
+    cfg.serve.swap_poll_s = 0.5           # fast flywheel propagation
+    cfg.serve.stats_interval_s = 0.5
+    cfg.distrib.actor_dir = os.path.join(workdir, "actors")
+    cfg.distrib.ingest_every_updates = 4
+    cfg.fleet.num_engines = engines
+    cfg.fleet.dir = os.path.join(workdir, "fleet")
+    cfg.fleet.telemetry_poll_s = 0.3
+    cfg.fleet.health_timeout_s = 5.0
+    cfg.fleet.supervise_interval_s = 0.2
+    cfg.fleet.engine_backoff_initial_s = 0.2
+    cfg.fleet.engine_backoff_max_s = 1.0
+    cfg.obs.enabled = True
+    cfg.obs.dir = os.path.join(workdir, "obs")
+    cfg.obs.slo_availability = 0.999
+    path = os.path.join(workdir, "fleet_soak_config.json")
+    cfg.save(path)
+    return path
+
+
+def wait_ready(proc, log_path: str, timeout_s: float) -> dict:
+    ready: dict = {}
+
+    def probe() -> bool:
+        if proc.poll() is not None:
+            raise SoakError(
+                f"fleet process died during bring-up (rc={proc.returncode})"
+                f": {log_tail(proc)}")
+        try:
+            with open(log_path) as f:
+                for line in f:
+                    if '"fleet_ready"' in line:
+                        ready.update(json.loads(line))
+                        return True
+        except OSError:
+            pass
+        return False
+
+    wait_until(probe, timeout_s, desc="fleet_ready line")
+    return ready
+
+
+class Load:
+    """Closed-loop journaling load over the wire, runnable across the
+    whole chaos phase. Counts every terminal outcome client-side."""
+
+    def __init__(self, host: str, port: int, workdir: str,
+                 sessions: int, concurrency: int):
+        import numpy as np
+        from sharetrade_tpu.data.synthetic import synthetic_price_series
+        from sharetrade_tpu.fleet.flywheel import (
+            SessionTransitionJournal, make_journaling_sessions)
+        from sharetrade_tpu.fleet.loadgen import WireEngine
+        prices = np.asarray(
+            synthetic_price_series(length=900, seed=7).prices, np.float32)
+        self.journal = SessionTransitionJournal(
+            os.path.join(workdir, "actors"), "fleet-client",
+            obs_dim=OBS_DIM, flush_rows=32)
+        self.sessions = make_journaling_sessions(
+            prices, WINDOW, sessions, journal=self.journal, seed=7)
+        self.engine = WireEngine(host, port, workers=concurrency,
+                                 timeout_s=20.0)
+        self.concurrency = concurrency
+        self.completed = 0
+        self.failed = 0
+        self.submitted = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> "Load":
+        per = max(1, len(self.sessions) // self.concurrency)
+        for i in range(self.concurrency):
+            chunk = self.sessions[i * per:(i + 1) * per] or \
+                [self.sessions[i % len(self.sessions)]]
+            t = threading.Thread(target=self._loop, args=(chunk,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _loop(self, sessions) -> None:
+        # One request in flight per worker thread, round-robin over its
+        # session slice — a closed loop that survives engine kills (a
+        # failure counts and the loop moves on).
+        idx = 0
+        while not self._stop.is_set():
+            sess = sessions[idx % len(sessions)]
+            idx += 1
+            with self._lock:
+                self.submitted += 1
+            handle = self.engine.submit(sess.sid, sess.observation())
+            result = handle.wait(25.0)
+            if result is not None:
+                sess.advance(result.action)
+                with self._lock:
+                    self.completed += 1
+            else:
+                with self._lock:
+                    self.failed += 1
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30.0)
+        self.engine.stop()
+        self.journal.close()
+
+
+def probe_request(host: str, port: int, sid: str,
+                  timeout_s: float = 15.0) -> dict:
+    import numpy as np
+    from sharetrade_tpu.fleet.wire import FleetClient
+    client = FleetClient(host, port, timeout_s=timeout_s)
+    try:
+        rng = np.random.default_rng(abs(hash(sid)) % 2**32)
+        return client.submit(sid, rng.uniform(1, 2, OBS_DIM))
+    finally:
+        client.close()
+
+
+def live_engine_pids(status_path: str) -> dict[str, int]:
+    status = read_json(status_path) or {}
+    engines = ((status.get("pool") or {}).get("engines")) or {}
+    return {eid: e["pid"] for eid, e in engines.items()
+            if e.get("state") == "alive" and e.get("pid")}
+
+
+def run_soak(*, engines: int, kills: int, ramp_s: float,
+             sessions: int, concurrency: int,
+             workdir: str | None = None, keep: bool = False) -> dict:
+    own_dir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="fleet_soak_")
+    cfg_path = build_config(workdir, engines)
+    status_path = os.path.join(workdir, "fleet", "fleet_status.json")
+    learner_prom = os.path.join(workdir, "obs", "learner", "metrics.prom")
+    log_path = os.path.join(workdir, "fleet.log")
+    result: dict = {"engines": engines, "kills_planned": kills,
+                    "workdir": workdir}
+    proc = launch_cli("fleet", cfg_path, log_path, symbol="MSFT",
+                      extra_args=["--learner", "--engines", str(engines),
+                                  "--duration", "0"])
+    load = None
+    try:
+        ready = wait_ready(proc, log_path, timeout_s=240.0)
+        host, port = ready["host"], ready["port"]
+        eprint(f"fleet ready on {host}:{port} with "
+               f"{ready['engines']}/{engines} engines (pid {proc.pid})")
+        if ready["engines"] != engines:
+            raise SoakError(
+                f"only {ready['engines']}/{engines} engines came up")
+        boot_step = probe_request(host, port, "boot-probe")["params_step"]
+        eprint(f"boot params_step = {boot_step}")
+
+        load = Load(host, port, workdir, sessions=sessions,
+                    concurrency=concurrency).start()
+        # Let the ramp establish warm sessions + journal rows.
+        time.sleep(ramp_s)
+
+        # ---- chaos: whole-engine SIGKILLs mid-load ------------------
+        injected = 0
+        for k in range(kills):
+            pids = live_engine_pids(status_path)
+            if len(pids) < 2:
+                wait_until(lambda: len(live_engine_pids(status_path)) >= 2,
+                           60.0, desc="two live engines before a kill")
+                pids = live_engine_pids(status_path)
+            victim_id, victim_pid = sorted(pids.items())[k % len(pids)]
+            eprint(f"kill {k + 1}/{kills}: SIGKILL engine {victim_id} "
+                   f"(pid {victim_pid})")
+            os.kill(victim_pid, signal.SIGKILL)
+            injected += 1
+            # Router must answer IMMEDIATELY (survivors absorb).
+            out = probe_request(host, port, f"post-kill-{k}")
+            if out.get("action") is None:
+                raise SoakError(f"post-kill probe returned {out}")
+            # Supervised recovery: restart counter reconciles exactly,
+            # membership returns to N.
+            wait_until(
+                lambda: ((read_json(status_path) or {}).get("pool") or {})
+                .get("restarts_total", -1) == injected,
+                60.0, desc=f"restarts_total == {injected}")
+            wait_until(
+                lambda: len(live_engine_pids(status_path)) == engines,
+                120.0, desc="membership back to N after the kill")
+            pool = (read_json(status_path) or {}).get("pool") or {}
+            if pool.get("restarts_total") != injected:
+                raise SoakError(
+                    f"spurious restarts: {pool.get('restarts_total')} "
+                    f"!= injected {injected}")
+            time.sleep(1.0)
+        result["kills_injected"] = injected
+
+        # ---- flywheel: production traffic retrains the policy -------
+        eprint("waiting for the flywheel: ingest -> tag_best -> swap")
+        load.journal.flush()
+        wait_until(
+            lambda: (prom_value(learner_prom,
+                                "distrib_rows_ingested_total") or 0) > 0,
+            120.0, desc="learner ingested journaled session rows")
+        rows_ingested = prom_value(learner_prom,
+                                   "distrib_rows_ingested_total")
+
+        def all_swapped() -> bool:
+            status = read_json(status_path) or {}
+            engines_st = ((status.get("pool") or {})
+                          .get("engines")) or {}
+            live = [e for e in engines_st.values()
+                    if e.get("state") == "alive"]
+            return (len(live) == engines
+                    and all((e.get("params_step") or 0) > boot_step
+                            and (e.get("swaps_total") or 0) >= 1
+                            for e in live))
+        wait_until(all_swapped, 180.0,
+                   desc="every live engine swapped past the boot step")
+        status = read_json(status_path) or {}
+        steps = sorted({e.get("params_step") for e in
+                        ((status.get("pool") or {}).get("engines") or {})
+                        .values() if e.get("state") == "alive"})
+        result["flywheel"] = {
+            "boot_params_step": boot_step,
+            "rows_ingested": rows_ingested,
+            "post_swap_params_steps": steps,
+        }
+        eprint(f"flywheel closed: ingested {rows_ingested:.0f} rows, "
+               f"live params_steps {steps}")
+
+        # Swap-settle window: traffic through the freshly-swapped fleet
+        # drops nothing.
+        settle_fail_before = load.failed
+        time.sleep(3.0)
+        settled = load.failed - settle_fail_before
+        if settled:
+            raise SoakError(
+                f"{settled} requests failed in the post-swap settle "
+                "window (swap must drop nothing)")
+
+        # ---- fleet SLO gauges from the merged histograms ------------
+        gauges = (read_json(status_path) or {}).get("gauges") or {}
+        merged = (read_json(status_path) or {}).get(
+            "fleet_request_ms") or {}
+        if not merged.get("count"):
+            raise SoakError("merged fleet histogram is empty")
+        for key in ("p50_ms", "p99_ms"):
+            v = merged.get(key)
+            if v is None or not (0 < v < 1e5):
+                raise SoakError(f"merged {key} not live/finite: {v}")
+        result["fleet_slo"] = {"merged": merged,
+                               "window_p50_ms": gauges.get("fleet_p50_ms"),
+                               "window_p99_ms": gauges.get("fleet_p99_ms")}
+
+        # ---- stop load, reconcile counters --------------------------
+        load.stop()
+        rows_journaled = load.journal.rows_journaled
+        time.sleep(1.5)     # let the router's poller publish a last pass
+        status = read_json(status_path) or {}
+        counters = status.get("counters") or {}
+        req = counters.get("fleet_requests_total", 0)
+        done = counters.get("fleet_completed_total", 0)
+        refused = counters.get("fleet_refused_total", 0)
+        unrouted = counters.get("fleet_unrouted_total", 0)
+        if req != done + refused + unrouted:
+            raise SoakError(
+                f"router counters do not reconcile: requests {req} != "
+                f"completed {done} + refused {refused} + unrouted "
+                f"{unrouted}")
+        client_total = load.completed + load.failed
+        if client_total != load.submitted:
+            raise SoakError(
+                f"client accounting leak: {load.completed}+{load.failed}"
+                f" != submitted {load.submitted}")
+        result["traffic"] = {
+            "submitted": load.submitted, "completed": load.completed,
+            "failed": load.failed, "rows_journaled": rows_journaled,
+            "router": {"requests": req, "completed": done,
+                       "refused": refused, "unrouted": unrouted,
+                       "migrations": counters.get(
+                           "fleet_migrations_total", 0)},
+        }
+        eprint(f"traffic: {load.completed} completed / {load.failed} "
+               f"failed of {load.submitted}; router saw {req} "
+               f"({counters.get('fleet_migrations_total', 0)} migrations)")
+        load = None
+
+        # ---- drain: SIGTERM ends the whole tier with 75 -------------
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        if rc != 75:
+            raise SoakError(
+                f"fleet drain exited {rc}, want 75: {log_tail(proc)}")
+        result["drain_rc"] = rc
+
+        # Session journal stays CRC-clean through the segmented reader.
+        from soak_common import journal_high_water
+        hw = journal_high_water(os.path.join(
+            workdir, "actors", "fleet-client", "transitions.journal"))
+        if hw != rows_journaled:
+            raise SoakError(
+                f"session journal high-water {hw} != rows journaled "
+                f"{rows_journaled}")
+        result["ok"] = True
+        return result
+    finally:
+        if load is not None:
+            try:
+                load.stop()
+            except Exception:   # noqa: BLE001
+                pass
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        if own_dir and not keep:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--engines", type=int, default=3)
+    parser.add_argument("--kills", type=int, default=3)
+    parser.add_argument("--ramp", type=float, default=6.0)
+    parser.add_argument("--sessions", type=int, default=64)
+    parser.add_argument("--concurrency", type=int, default=12)
+    parser.add_argument("--quick", action="store_true",
+                        help="tier-1 profile: 2 engines, 1 kill, short "
+                             "ramp")
+    parser.add_argument("--keep", action="store_true",
+                        help="keep the scratch dir for forensics")
+    args = parser.parse_args()
+    if args.quick:
+        args.engines = min(args.engines, 2)
+        args.kills = min(args.kills, 1)
+        args.ramp = min(args.ramp, 3.0)
+        args.sessions = min(args.sessions, 32)
+        args.concurrency = min(args.concurrency, 8)
+    t0 = time.monotonic()
+    try:
+        result = run_soak(engines=args.engines, kills=args.kills,
+                          ramp_s=args.ramp, sessions=args.sessions,
+                          concurrency=args.concurrency, keep=args.keep)
+    except SoakError as exc:
+        print(json.dumps({"ok": False, "error": str(exc)}), flush=True)
+        eprint(f"FLEET SOAK FAILED: {exc}")
+        return 1
+    result["elapsed_s"] = round(time.monotonic() - t0, 1)
+    print(json.dumps(result), flush=True)
+    eprint(f"fleet soak OK in {result['elapsed_s']}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -442,6 +442,26 @@ TUNED_KNOB_DIRS = ("serve", "runtime")
 #: correct (construction line or the two preceding lines).
 TUNED_KNOB_MARKER = "tuned-knob-ok"
 
+#: Check 14 (the fleet PR): network LISTENERS live in fleet/ and nowhere
+#: else inside sharetrade_tpu/ — a socket server in the serve/obs/data
+#: layers would be an unsupervised second front door around the fleet's
+#: drain/status-code/telemetry contract. Matches listener construction
+#: (socket.socket / socketserver.* / http.server / *HTTPServer), never
+#: clients (urlopen, HTTPConnection — data/service.py's price fetch is
+#: legal); fleet/ itself is exempt wholesale.
+FLEET_NET_DIR = "fleet"
+FLEET_NET_PATTERN = re.compile(
+    r"socket\.socket\s*\(|\bsocketserver\.\w|\bhttp\.server\b|"
+    r"\w*HTTPServer\s*\(")
+#: ...and the serve engine's dispatch closures must not grow BLOCKING
+#: network I/O either: a wire call on the batch-collection path stalls
+#: every queued session behind one peer's RTT (the check-8 inversion,
+#: network edition). Scans SERVE_DISPATCH_FUNCS for client calls too.
+SERVE_NET_PATTERN = re.compile(
+    r"urlopen\s*\(|HTTPConnection\s*\(|FleetClient\s*\(|"
+    r"\.recv\s*\(|\.sendall\s*\(|\.accept\s*\(|\.connect\s*\(")
+FLEET_NET_MARKER = "fleet-net-ok"
+
 
 def lint_hot_loop_syncs() -> tuple[list[tuple[str, int, str]], set[str]]:
     return _scan_named_funcs(HOT_FUNCS, PATTERN, MARKER)
@@ -649,6 +669,34 @@ def lint_tuned_knob_shadows(
                 bad.append((f"{pathlib.Path(root).name}/{path.name}",
                             node.lineno, lines[node.lineno - 1].strip()))
     return bad, found
+
+
+def lint_fleet_net(
+        root: pathlib.Path | None = None) -> tuple[
+            list[tuple[str, int, str]], list[tuple[str, int, str]]]:
+    """Check 14: (a) no network-listener construction anywhere in
+    ``sharetrade_tpu/`` outside ``fleet/`` without ``fleet-net-ok`` on
+    the line; (b) no blocking network I/O (client calls included) in the
+    serve engine's dispatch closures. Returns ``(listener_hits,
+    dispatch_hits)``. ``root`` overrides the scanned package (tests
+    exercise the semantics on fixtures)."""
+    root = root or TARGET.parent.parent     # sharetrade_tpu/
+    listener_bad: list[tuple[str, int, str]] = []
+    for path in sorted(pathlib.Path(root).rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if rel.split("/")[0] == FLEET_NET_DIR:
+            continue
+        for ln, text in enumerate(path.read_text().splitlines(), 1):
+            if text.lstrip().startswith("#"):
+                continue
+            if (FLEET_NET_PATTERN.search(text)
+                    and FLEET_NET_MARKER not in text):
+                listener_bad.append((rel, ln, text.strip()))
+    dispatch_bad, _ = _scan_named_funcs(
+        SERVE_DISPATCH_FUNCS, SERVE_NET_PATTERN, FLEET_NET_MARKER,
+        target=SERVE_TARGET)
+    return listener_bad, [(SERVE_TARGET.name, ln, text)
+                          for _, ln, text in dispatch_bad]
 
 
 def lint_dispatcher_blocking() -> tuple[list[tuple[str, int, str]], set[str]]:
@@ -904,6 +952,27 @@ def main() -> int:
               f"tag the line '# {TUNED_KNOB_MARKER}: <why a literal is "
               "correct here>'")
         return 1
+    net_listener_bad, net_dispatch_bad = lint_fleet_net()
+    if net_listener_bad:
+        print("fleet net-listener lint FAILED:")
+        for rel, ln, text in net_listener_bad:
+            print(f"  sharetrade_tpu/{rel}:{ln}: {text}")
+        print("a socket/HTTP listener outside fleet/ is an unsupervised "
+              "second front door around the fleet's drain/status-code/"
+              "telemetry contract; serve it through fleet/frontend.py, "
+              f"or tag the line '# {FLEET_NET_MARKER}: <why this "
+              "listener lives here>'")
+        return 1
+    if net_dispatch_bad:
+        print("serve dispatch network-I/O lint FAILED:")
+        for rel, ln, text in net_dispatch_bad:
+            print(f"  {rel}:{ln}: {text}")
+        print("a blocking network call in the serve dispatch closure "
+              "stalls every queued session behind one peer's RTT; wire "
+              "work belongs to the fleet front-end/router threads, or "
+              f"tag the line '# {FLEET_NET_MARKER}: <why the dispatch "
+              "path blocks on the network on purpose>'")
+        return 1
     dur_bad = lint_durable_replace()
     if dur_bad:
         print("durable-rename fsync lint FAILED:")
@@ -929,6 +998,8 @@ def main() -> int:
           f"actor-spawn lint OK ({ACTOR_SPAWN_MODULE}); "
           f"tuned-knob shadow lint OK ({len(TUNED_KNOB_PATHS)} knobs, "
           f"{', '.join(TUNED_KNOB_DIRS)}); "
+          f"fleet net-listener lint OK (listeners confined to "
+          f"sharetrade_tpu/{FLEET_NET_DIR}/); "
           f"durable-rename fsync lint OK ({', '.join(DURABLE_WRITE_FILES)})")
     return 0
 
