@@ -8,7 +8,7 @@
 
 PYTHON ?= python
 
-.PHONY: check test slow native bench autotune autotune-quick bench-actor bench-async bench-autotune bench-ckpt bench-dispatch bench-fleet bench-obs bench-precision bench-replay bench-reshard bench-roofline bench-serve bench-serve-overload actor-soak crash-soak fleet-soak obs-demo lint perf-gate serve-chaos serve-soak shard-audit clean
+.PHONY: check test slow native bench autotune autotune-quick bench-actor bench-async bench-autotune bench-ckpt bench-dispatch bench-fleet bench-obs bench-router bench-precision bench-replay bench-reshard bench-roofline bench-serve bench-serve-overload actor-soak crash-soak fleet-soak obs-demo lint perf-gate serve-chaos serve-soak shard-audit clean
 
 check: native lint
 	$(PYTHON) -m pytest tests/ -q -m "not slow" -x
@@ -189,6 +189,12 @@ fleet-soak:
 bench-fleet:
 	$(PYTHON) -c "import json, bench; \
 	print(json.dumps(bench.bench_fleet(), indent=2))"
+
+# Router-ONLY relay throughput: threaded oracle vs the evloop wire
+# path against loopback echo engines (ISSUE 16's >=10x acceptance).
+bench-router:
+	$(PYTHON) -c "import json, bench; \
+	print(json.dumps(bench.bench_router_relay(), indent=2))"
 
 # Process-kill chaos soak: >= 20 seeded SIGKILL/SIGTERM injections into real
 # training subprocesses (journaled DQN config), each followed by --resume,

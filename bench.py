@@ -1541,6 +1541,250 @@ def bench_fleet(*, engine_counts: tuple[int, ...] = (1, 2, 4),
     }
 
 
+def bench_router_relay(*, duration_s: float = 2.0,
+                       scan_connections: tuple = (64, 512, 2048),
+                       pipeline: int = 4,
+                       loadgen_threads: int = 4,
+                       echo_engines: int = 2) -> dict:
+    """Router-ONLY relay throughput (ISSUE 16): the two wire backends
+    (threaded oracle vs the evloop data path) relaying the same
+    pipelined keep-alive load to loopback ECHO engines
+    (tools/wire_echo.py — canned replies, zero model compute, separate
+    subprocesses), so the number is pure relay cost: downstream parse,
+    route, proxy hop, engine-id splice, reply render. bench_fleet keeps
+    the end-to-end number; this row isolates the layer ISSUE 16
+    rebuilt.
+
+    Load shape: PERSISTENT keep-alive connections each pipelining
+    ``pipeline`` requests per round — the fleet's real shape
+    (thousands of long-lived sessions, modest per-session rate) — and
+    the bench SCANS the connection count (``scan_connections``),
+    because connection scaling is exactly where thread-per-connection
+    breaks: the threaded arm must hold one OS thread per connection
+    (GIL convoy + scheduler thrash that worsens with every conn), while
+    the evloop arm multiplexes every connection on one thread and its
+    throughput stays flat. The loadgen multiplexes many sockets per
+    thread (``loadgen_threads`` total) so the CLIENT'S thread count
+    stays identical — and out of the measurement — across both arms
+    and all scan points.
+
+    Readings per arm: qps at each scan point, plus
+    ``conns_at_90pct`` — the largest scanned connection count the arm
+    sustains at >= 90% of its small-scan (first point) throughput. The
+    headline ``speedup`` is the qps ratio at the LARGEST scan point.
+    Caveat the note records: on a single-vCPU host both arms are
+    bounded by total interpreter work per request (loadgen + router +
+    echo share one core), so the qps ratio understates the structural
+    gap — the scaling slope (flat vs degrading) is the honest signal
+    there.
+
+    Gate row (tools/perf_gate.py): ``router_relay_qps`` — the evloop
+    arm's relay throughput at the largest scan point. Acceptance
+    (ISSUE 16): evloop >= 10x the threaded arm in the same run
+    (``accepted_10x``; reported as measured, never asserted).
+    """
+    import json as _json
+    import os
+    import signal
+    import socket as socketlib
+    import subprocess
+    import sys
+    import threading
+
+    from sharetrade_tpu.fleet import (
+        FleetRouter,
+        ServeFrontend,
+        StaticEndpoints,
+    )
+    from sharetrade_tpu.fleet import proto, wire
+    from sharetrade_tpu.utils.metrics import MetricsRegistry
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    echo_script = os.path.join(repo, "tools", "wire_echo.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"        # the echo never computes
+
+    procs: list = []
+    endpoints: dict[str, tuple[str, int]] = {}
+    try:
+        for i in range(echo_engines):
+            proc = subprocess.Popen(
+                [sys.executable, echo_script, "--name", f"echo{i}"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                env=env, cwd=repo, text=True)
+            procs.append(proc)
+        for i, proc in enumerate(procs):
+            line = proc.stdout.readline()
+            ready = _json.loads(line)
+            if ready.get("event") != "engine_listening":
+                raise RuntimeError(f"echo {i} bad ready line: {line!r}")
+            endpoints[f"echo{i}"] = (ready["host"], ready["port"])
+
+        def run_arm(backend_name: str, connections: int) -> dict:
+            registry = MetricsRegistry()
+            cfg = FrameworkConfig().fleet
+            router = FleetRouter(StaticEndpoints(endpoints), cfg,
+                                 registry, workdir="")
+            router.poll_once()          # one scrape: views go live
+            frontend = ServeFrontend(
+                router, registry,
+                wire_backend=backend_name).start()
+            host, port = frontend.host, frontend.port
+            n_threads = max(1, min(loadgen_threads, connections))
+            per_thread = [connections // n_threads
+                          + (1 if i < connections % n_threads else 0)
+                          for i in range(n_threads)]
+            barrier = threading.Barrier(n_threads)
+            results: dict = {}
+
+            def worker(idx: int, n_socks: int) -> None:
+                socks: list = []
+                failed = 0
+                try:
+                    for j in range(n_socks):
+                        for _attempt in range(40):
+                            try:
+                                s = socketlib.create_connection(
+                                    (host, port), timeout=10.0)
+                                break
+                            except OSError:
+                                time.sleep(0.05)
+                        else:
+                            raise ConnectionError(
+                                "router refused the connection storm")
+                        s.setsockopt(socketlib.IPPROTO_TCP,
+                                     socketlib.TCP_NODELAY, 1)
+                        s.settimeout(60.0)
+                        body = _json.dumps(
+                            {"session": f"relay-{idx}-{j}",
+                             "obs": [1.0, 2.0, 3.0]}).encode()
+                        batch = proto.render_request(
+                            "POST", wire.SUBMIT_PATH,
+                            f"{host}:{port}", body) * pipeline
+                        socks.append((s, batch,
+                                      proto.ResponseParser()))
+
+                    def do_round() -> None:
+                        nonlocal failed
+                        for s, batch, _parser in socks:
+                            s.sendall(batch)
+                        for s, _batch, parser in socks:
+                            got = 0
+                            while got < pipeline:
+                                chunk = s.recv(1 << 16)
+                                if not chunk:
+                                    raise ConnectionError(
+                                        "router closed mid-pipeline")
+                                for resp in parser.feed(chunk):
+                                    got += 1
+                                    if resp.status != 200:
+                                        failed += 1
+
+                    do_round()          # warmup: every conn served once
+                    barrier.wait(timeout=300.0)
+                    counted = 0
+                    t0 = time.monotonic()
+                    while time.monotonic() - t0 < duration_s:
+                        do_round()
+                        counted += n_socks * pipeline
+                    elapsed = time.monotonic() - t0
+                    results[idx] = (counted, failed, elapsed)
+                except Exception as exc:    # noqa: BLE001
+                    barrier.abort()
+                    results[idx] = ("error", repr(exc))
+                finally:
+                    for s, _batch, _parser in socks:
+                        try:
+                            s.close()
+                        except OSError:
+                            pass
+
+            threads = [threading.Thread(target=worker,
+                                        args=(i, per_thread[i]),
+                                        daemon=True)
+                       for i in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600.0)
+            frontend.stop()
+            router.stop()
+            errors = [r[1] for r in results.values()
+                      if r and r[0] == "error"]
+            good = [r for r in results.values()
+                    if r and r[0] != "error"]
+            # Sum of per-thread steady-state rates: each thread times
+            # its own window, so a long final round cannot skew it.
+            qps = sum(c / e for c, _f, e in good if e > 0)
+            return {
+                "wire_backend": backend_name,
+                "qps": round(qps, 1),
+                "failed": sum(f for _c, f, _e in good),
+                "errors": errors[:4],
+                "connections": connections,
+            }
+
+        scan = []
+        arms: dict = {"threaded": [], "evloop": []}
+        for conns in scan_connections:
+            point: dict = {"connections": conns}
+            for name in ("threaded", "evloop"):
+                arm = run_arm(name, conns)
+                arms[name].append(arm)
+                point[f"{name}_qps"] = arm["qps"]
+                point[f"{name}_failed"] = (arm["failed"]
+                                           + len(arm["errors"]))
+            point["ratio"] = round(
+                point["evloop_qps"]
+                / max(point["threaded_qps"], 1e-9), 2)
+            scan.append(point)
+
+        def at_90pct(points: list) -> int:
+            base = points[0]["qps"]
+            held = points[0]["connections"]
+            for p in points:
+                if p["qps"] >= 0.9 * base and not p["errors"]:
+                    held = p["connections"]
+            return held
+
+        threaded = dict(arms["threaded"][-1],
+                        conns_at_90pct=at_90pct(arms["threaded"]))
+        evloop = dict(arms["evloop"][-1],
+                      conns_at_90pct=at_90pct(arms["evloop"]))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except Exception:   # noqa: BLE001
+                proc.kill()
+
+    speedup = evloop["qps"] / max(threaded["qps"], 1e-9)
+    return {
+        **_result_envelope(),
+        "metric": "router_relay_qps",
+        "value": evloop["qps"],
+        "unit": "requests/s",
+        "pipeline": pipeline,
+        "echo_engines": echo_engines,
+        "threaded": threaded,
+        "evloop": evloop,
+        "scan": scan,
+        "speedup": round(speedup, 1),
+        "accepted_10x": speedup >= 10.0,
+        "note": (f"pure relay cost through one router process "
+                 f"(keep-alive conns scanned over {list(scan_connections)}, "
+                 f"{pipeline}-deep pipelines, loopback echo subprocesses; "
+                 "engine compute subtracted by construction). On a "
+                 "single-vCPU host loadgen+router+echo share one core, "
+                 "so the qps ratio understates the structural gap; the "
+                 "scaling slope (threaded degrades per conn, evloop "
+                 "flat) is the load-bearing reading there"),
+    }
+
+
 def bench_replay(*, chunks: int = 24, trials: int = 2,
                  sample_iters: int = 100,
                  eff_max_chunks: int = 150) -> dict:
@@ -2320,6 +2564,7 @@ def _await_devices(attempts: int = 3, timeout_s: float = 180.0,
                  "r['replay'] = bench.bench_replay(); "
                  "r['actor_scaling'] = bench.bench_actor_scaling(); "
                  "r['fleet'] = bench.bench_fleet(); "
+                 "r['router_relay'] = bench.bench_router_relay(); "
                  "print(json.dumps(r))"],
                 env=scrub, cwd=repo,
                 # Sized for the fallback workloads (reference_shape, the
@@ -2387,6 +2632,7 @@ def main() -> None:
     result["replay"] = bench_replay()
     result["actor_scaling"] = bench_actor_scaling()
     result["fleet"] = bench_fleet()
+    result["router_relay"] = bench_router_relay()
     print(json.dumps(result), flush=True)
 
 

@@ -337,7 +337,8 @@ def cmd_serve(args) -> int:
                     engine,
                     request_timeout_s=cfg.fleet.request_timeout_s),
                 registry, host=host or "127.0.0.1",
-                port=int(port_s or 0)).start()
+                port=int(port_s or 0),
+                wire_backend=cfg.fleet.wire_backend).start()
             # The pool tails the worker's log for this line to learn the
             # ephemeral port (fleet/pool.py LISTENING_EVENT).
             print(json.dumps({"event": "engine_listening",
@@ -737,8 +738,9 @@ def cmd_fleet(args) -> int:
         router = FleetRouter(pool, cfg.fleet, registry,
                              workdir=cfg.fleet.dir, obs_cfg=cfg.obs,
                              obs=obs_bundle).start()
-        frontend = ServeFrontend(router, registry, host=cfg.fleet.host,
-                                 port=cfg.fleet.port).start()
+        frontend = ServeFrontend(
+            router, registry, host=cfg.fleet.host, port=cfg.fleet.port,
+            wire_backend=cfg.fleet.wire_backend).start()
 
         if args.learner:
             from sharetrade_tpu.config import FrameworkConfig
@@ -774,6 +776,7 @@ def cmd_fleet(args) -> int:
                           "engines": len(pool.endpoints()),
                           "target_engines": cfg.fleet.num_engines,
                           "dir": cfg.fleet.dir,
+                          "wire_backend": cfg.fleet.wire_backend,
                           "learner": bool(args.learner),
                           "pid": os.getpid()}), flush=True)
 

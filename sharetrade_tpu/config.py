@@ -673,6 +673,14 @@ class FleetConfig:
     # Drain budget on SIGTERM: in-flight requests finish, engines drain
     # (their own SIGTERM → 75 contract), stragglers are killed past it.
     drain_grace_s: float = 15.0
+    # Wire data path for every front-end in the fleet (the router's
+    # public port and each engine worker's listener). "evloop" (default)
+    # = the sans-IO selector event loop (fleet/evloop.py): one thread,
+    # no thread per connection or in-flight request — the path that
+    # scales past the thread-per-request GIL convoy. "threaded" = the
+    # stdlib ThreadingHTTPServer path, retained as the differential-
+    # testing oracle (identical wire contract, byte-identical replies).
+    wire_backend: str = "evloop"
 
 
 @dataclass

@@ -6,8 +6,13 @@ engine.ServeEngine` into a horizontally-scaled service:
 - **wire.py** — the HTTP/1.1 protocol every hop speaks (deadline
   header, distinct statuses per serving outcome, persistent-connection
   client);
-- **frontend.py** — the stdlib threaded network front-end serving any
-  ``serve_request`` backend (a local engine, or the router);
+- **proto.py** — the sans-IO HTTP/1.1 parser/renderer (bytes in,
+  events out, zero I/O) every party on the wire frames through;
+- **frontend.py / evloop.py** — the network front-end serving any
+  ``serve_request`` backend (a local engine, or the router) on either
+  wire backend: the selector event loop (``fleet.wire_backend =
+  "evloop"``, default — no thread per connection) or the threaded
+  differential oracle (``"threaded"``);
 - **pool.py** — :class:`EnginePool`: whole ``cli serve --listen`` worker
   processes under the shared supervision ladder (distrib/ladder.py);
 - **router.py** — :class:`FleetRouter`: telemetry-driven balancing on
@@ -22,7 +27,12 @@ Kill-tested end to end by ``tools/fleet_soak.py``; ``cli fleet`` boots
 the whole tier.
 """
 
-from sharetrade_tpu.fleet.frontend import EngineBackend, ServeFrontend
+from sharetrade_tpu.fleet.evloop import EvloopFrontend
+from sharetrade_tpu.fleet.frontend import (
+    EngineBackend,
+    ServeFrontend,
+    ThreadedServeFrontend,
+)
 from sharetrade_tpu.fleet.loadgen import WireEngine
 from sharetrade_tpu.fleet.pool import EnginePool
 from sharetrade_tpu.fleet.router import FleetRouter, StaticEndpoints
@@ -31,9 +41,11 @@ from sharetrade_tpu.fleet.wire import FleetClient
 __all__ = [
     "EngineBackend",
     "EnginePool",
+    "EvloopFrontend",
     "FleetClient",
     "FleetRouter",
     "ServeFrontend",
     "StaticEndpoints",
+    "ThreadedServeFrontend",
     "WireEngine",
 ]

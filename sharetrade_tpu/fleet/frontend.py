@@ -1,8 +1,7 @@
 """Network front-end: ``ServeEngine.submit`` exposed over a wire.
 
-A stdlib-only threaded HTTP/1.1 server (keep-alive, one handler thread
-per connection) speaking the fleet/wire.py protocol over any BACKEND
-object with the two-method surface
+Two interchangeable wire backends serve the same protocol (fleet/
+wire.py) over any BACKEND object with the two-method surface
 
 - ``serve_request(session, obs, deadline_ms) -> dict`` — blocking; raises
   the serving exceptions (mapped to distinct wire statuses), and
@@ -14,16 +13,28 @@ metrics.MetricsRegistry`. Two backends exist: :class:`EngineBackend`
 router's proxy (fleet/router.py) — the fleet's public port is literally
 this same server over a different backend.
 
+The wire backends (``fleet.wire_backend``):
+
+- ``"evloop"`` (default) — fleet/evloop.py: one selector thread, no
+  thread per connection or in-flight request — the scalable data path.
+- ``"threaded"`` — :class:`ThreadedServeFrontend` below: a stdlib
+  ThreadingHTTPServer, one handler thread per connection. Retained as
+  the differential-testing ORACLE: both backends render replies through
+  fleet/proto.py, so for the same request stream their response bytes
+  are identical (tests/test_fleet_wire.py holds them to it).
+
+:func:`ServeFrontend` is the factory both spellings go through.
+
 Deadline propagation: the client's ``X-Deadline-Ms`` header flows into
 ``submit(deadline_ms=)`` — the ENGINE's batch-collection gate expires it
 (``ServeDeadlineExceeded`` → 504), never this layer's clock; the
-front-end's own ``request_timeout_s`` bounds only a handler thread's
-life against a wedged engine (and maps to 503, the "engine gone" truth).
+front-end's own ``request_timeout_s`` bounds only a request's life
+against a wedged engine (and maps to 503, the "engine gone" truth).
 
 Drain contract (the ``cli serve`` SIGTERM contract over a wire): `drain()`
 stops the listener — new connections are refused at the TCP layer, the
 OS-visible "draining" signal a fleet router reacts to — then waits for
-every in-flight handler to finish; the process then exits 75.
+every in-flight request to finish; the process then exits 75.
 
 fleet-net-ok: this module IS the fleet's network layer — the one place
 lint check 14 allows listeners inside sharetrade_tpu/.
@@ -32,14 +43,13 @@ lint check 14 allows listeners inside sharetrade_tpu/.
 from __future__ import annotations
 
 import json
-import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
-from sharetrade_tpu.fleet import wire
+from sharetrade_tpu.fleet import proto, wire
 from sharetrade_tpu.obs.exporter import render_prom_text
 from sharetrade_tpu.serve.engine import ServeEngineFailed
 from sharetrade_tpu.utils.logging import get_logger
@@ -49,14 +59,16 @@ log = get_logger("fleet.frontend")
 
 class EngineBackend:
     """The local-engine backend: one blocking wire request ↔ one
-    ``engine.submit`` + ``handle.wait``."""
+    ``engine.submit`` + ``handle.wait`` (threaded backend), or one
+    ``submit_async`` parked on the engine's completion callback
+    (evloop backend) — identical validation and result payloads."""
 
     def __init__(self, engine, *, request_timeout_s: float = 30.0):
         self.engine = engine
         self.request_timeout_s = float(request_timeout_s)
 
-    def serve_request(self, session: str, obs,
-                      deadline_ms: float | None) -> dict:
+    @staticmethod
+    def validate_obs(obs) -> np.ndarray:
         obs = np.asarray(obs, np.float32)
         if obs.ndim != 1 or obs.size < 3:
             raise ValueError(
@@ -64,6 +76,23 @@ class EngineBackend:
                 f"shape {obs.shape}")
         if not np.all(np.isfinite(obs)):
             raise ValueError("obs contains non-finite values")
+        return obs
+
+    @staticmethod
+    def result_dict(result) -> dict:
+        return {
+            "session": result.session_id,
+            "action": int(result.action),
+            "logits": [float(x) for x in np.asarray(result.logits)],
+            "value": float(result.value),
+            "params_step": int(result.params_step),
+            "latency_ms": float(result.latency_ms),
+            "stages": result.stages,
+        }
+
+    def serve_request(self, session: str, obs,
+                      deadline_ms: float | None) -> dict:
+        obs = self.validate_obs(obs)
         handle = self.engine.submit(session, obs,
                                     deadline_ms=deadline_ms or 0.0)
         # A deadline'd request resolves engine-side well inside
@@ -79,15 +108,18 @@ class EngineBackend:
             raise ServeEngineFailed(
                 f"request did not complete within the front-end budget "
                 f"({timeout:.1f}s)")
-        return {
-            "session": result.session_id,
-            "action": int(result.action),
-            "logits": [float(x) for x in np.asarray(result.logits)],
-            "value": float(result.value),
-            "params_step": int(result.params_step),
-            "latency_ms": float(result.latency_ms),
-            "stages": result.stages,
-        }
+        return self.result_dict(result)
+
+    def submit_async(self, session: str, obs, deadline_ms: float | None,
+                     signal_done):
+        """The evloop front-end's dispatch: validate and enqueue, then
+        return the request handle WITHOUT waiting — ``signal_done()``
+        fires (from the engine's consumer thread) once the handle
+        completes; read ``handle.result`` / ``handle.error`` after."""
+        obs = self.validate_obs(obs)
+        return self.engine.submit(session, obs,
+                                  callback=lambda _result: signal_done(),
+                                  deadline_ms=deadline_ms or 0.0)
 
     def health(self) -> dict:
         engine = self.engine
@@ -103,19 +135,15 @@ class EngineBackend:
         }
 
 
-#: Fast-path session extraction for the router's byte-level relay: the
-#: submit body leads with a plain-string session id in every client this
-#: repo ships; anything fancier (escapes, non-string ids) falls back to
-#: a real JSON parse.
-_SESSION_RE = re.compile(rb'"session"\s*:\s*"([^"\\]*)"')
-
-
 class _FrontendServer(ThreadingHTTPServer):
-    # fleet-net-ok: the fleet's one listener implementation.
+    # fleet-net-ok: the fleet's threaded listener implementation.
     daemon_threads = True
     allow_reuse_address = True
+    # Match the evloop listener's backlog so a connection-storm bench
+    # measures the thread-per-connection cost, not accept-queue drops.
+    request_queue_size = 1024
 
-    def __init__(self, addr, handler, frontend: "ServeFrontend"):
+    def __init__(self, addr, handler, frontend: "ThreadedServeFrontend"):
         super().__init__(addr, handler)
         self.frontend = frontend
 
@@ -134,11 +162,11 @@ class _Handler(BaseHTTPRequestHandler):
         payload = (body if isinstance(body, bytes)
                    else json.dumps(body).encode())
         try:
-            self.send_response(status)
-            self.send_header("Content-Type", content_type)
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
+            # Rendered by the shared sans-IO builder — byte-identical
+            # to the evloop backend's replies (the differential-oracle
+            # contract), not send_response's Server/Date decoration.
+            self.wfile.write(proto.render_response(status, payload,
+                                                   content_type))
         except (BrokenPipeError, ConnectionResetError):
             # The client hung up mid-reply (teardown, a canceled
             # request): its socket is the only casualty — never the
@@ -152,8 +180,16 @@ class _Handler(BaseHTTPRequestHandler):
         # Consume the body UNCONDITIONALLY before any reply: an early
         # 404/503 that leaves it unread poisons the next keep-alive
         # request on this connection (the leftover bytes parse as a
-        # garbage request line).
-        length = int(self.headers.get("Content-Length", 0))
+        # garbage request line). The length check itself is proto's —
+        # one definition of a well-formed Content-Length on the wire.
+        try:
+            length = proto.content_length(
+                self.headers.get("Content-Length"))
+        except proto.ProtocolError as exc:
+            self._reply(exc.status, {"error": "bad_request",
+                                     "detail": exc.detail})
+            self.close_connection = True
+            return
         raw = self.rfile.read(length)
         if self.path != wire.SUBMIT_PATH:
             self._reply(404, {"error": "not_found"})
@@ -180,16 +216,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # is extracted — the body is forwarded and the reply
                 # relayed as BYTES, so the proxy hop never pays a JSON
                 # round-trip (the router-thinner-than-an-engine premise).
-                m = _SESSION_RE.search(raw)
-                if m is not None:
-                    session = m.group(1).decode("utf-8", "replace")
-                else:
-                    try:
-                        session = str(json.loads(raw)["session"])
-                    except (ValueError, KeyError, TypeError) as exc:
-                        self._reply(*wire.error_to_status(ValueError(
-                            f"malformed submit body: {exc!r}")))
-                        return
+                try:
+                    session = wire.extract_session(raw)
+                except ValueError as exc:
+                    self._reply(*wire.error_to_status(exc))
+                    return
                 try:
                     status, reply = proxy(session, raw, deadline_raw)
                 except Exception as exc:    # noqa: BLE001
@@ -254,9 +285,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": "not_found"})
 
 
-class ServeFrontend:
-    """See the module docstring. ``port=0`` binds an ephemeral port;
-    read :attr:`port` after construction for the actual one."""
+class ThreadedServeFrontend:
+    """The thread-per-connection wire backend (module docstring).
+    ``port=0`` binds an ephemeral port; read :attr:`port` after
+    construction for the actual one."""
 
     def __init__(self, backend, registry, *, host: str = "127.0.0.1",
                  port: int = 0):
@@ -269,7 +301,7 @@ class ServeFrontend:
         self.host, self.port = self._server.server_address[:2]
         self._thread: threading.Thread | None = None
 
-    def start(self) -> "ServeFrontend":
+    def start(self) -> "ThreadedServeFrontend":
         self._thread = threading.Thread(
             target=self._server.serve_forever,
             kwargs={"poll_interval": 0.1},
@@ -298,3 +330,20 @@ class ServeFrontend:
         self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout_s)
+
+
+def ServeFrontend(backend, registry, *, host: str = "127.0.0.1",
+                  port: int = 0, wire_backend: str | None = None):
+    """Build a wire front-end — the one construction surface both
+    backends share (``FleetConfig.wire_backend`` plumbs through here).
+    ``None`` means the default backend (evloop)."""
+    wire_backend = wire_backend or "evloop"
+    if wire_backend == "evloop":
+        from sharetrade_tpu.fleet.evloop import EvloopFrontend
+        return EvloopFrontend(backend, registry, host=host, port=port)
+    if wire_backend == "threaded":
+        return ThreadedServeFrontend(backend, registry, host=host,
+                                     port=port)
+    raise ValueError(
+        f"unknown fleet.wire_backend {wire_backend!r} "
+        f"(expected 'evloop' or 'threaded')")

@@ -1,0 +1,280 @@
+"""Sans-IO HTTP/1.1 protocol core for the fleet wire — bytes in,
+events out, ZERO I/O.
+
+This module is the ONE definition of the fleet's HTTP/1.1 framing,
+shared by every party on the wire: the blocking :class:`~sharetrade_tpu.
+fleet.wire.FleetClient`, the threaded front-end, and the evloop
+connection engine (fleet/evloop.py) all feed raw socket bytes into the
+same parsers and render replies through the same builders. It never
+touches a socket, a file, a thread, or a clock — a parser is a pure
+state machine, so every framing rule (torn reads at ANY byte boundary,
+pipelined requests, the Content-Length contract, header-size limits)
+is testable byte-by-byte without a network (tests/test_fleet_wire.py
+replays the whole wire corpus split at every offset).
+
+Framing rules (the fleet dialect, deliberately smaller than RFC 9112):
+
+- Requests and responses are framed by ``Content-Length`` only — no
+  chunked transfer, no multipart. A request without the header has an
+  empty body (GETs); a RESPONSE without it is a protocol error, because
+  on a keep-alive connection "read until close" framing is indistinct
+  from a torn response (the lesson fleet/wire.py's hand parse encoded,
+  now encoded once here).
+- A header block larger than :data:`MAX_HEAD_BYTES` or a body larger
+  than :data:`MAX_BODY_BYTES` is refused before buffering unboundedly.
+- ``feed()`` may be handed ANY split of the byte stream — one byte at a
+  time, a half request, three pipelined requests in one chunk — and
+  returns the complete messages in arrival order; partial tail bytes
+  stay buffered for the next feed.
+
+The "body consumed before early reply" keep-alive lesson is structural
+here: a parser only emits a :class:`Request` once its full body has
+arrived, so a server replying 404/503 early can never leave body bytes
+behind to poison the next request on the connection.
+"""
+
+from __future__ import annotations
+
+#: Refuse a request/status line + header block larger than this — a
+#: peer streaming an unbounded head is attacking the buffer, not
+#: speaking the fleet protocol.
+MAX_HEAD_BYTES = 16384
+
+#: Refuse a declared body larger than this (submit bodies are a few KB;
+#: the largest legitimate payload on the wire is a /metrics scrape).
+MAX_BODY_BYTES = 1 << 26
+
+_CRLF2 = b"\r\n\r\n"
+
+#: Reason phrases for the statuses the fleet actually speaks (see the
+#: wire.py status table) — anything else renders its bare code.
+REASONS = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    429: "Too Many Requests",
+    500: "Internal Server Error",
+    503: "Service Unavailable",
+    504: "Gateway Timeout",
+}
+
+
+class ProtocolError(Exception):
+    """A framing violation. ``status`` is what a SERVER should answer
+    (400 for everything a client can cause); a CLIENT treats any
+    ProtocolError from a ResponseParser as transport-class — the
+    keep-alive byte stream is unrecoverable either way."""
+
+    def __init__(self, detail: str, *, status: int = 400):
+        super().__init__(detail)
+        self.status = int(status)
+        self.detail = detail
+
+
+class Request:
+    """One complete parsed request: ``headers`` is a last-wins dict of
+    lower-cased names; ``keep_alive`` already folds the HTTP-version /
+    Connection-header rules."""
+
+    __slots__ = ("method", "target", "headers", "body", "keep_alive")
+
+    def __init__(self, method: str, target: str, headers: dict,
+                 body: bytes, keep_alive: bool):
+        self.method = method
+        self.target = target
+        self.headers = headers
+        self.body = body
+        self.keep_alive = keep_alive
+
+    def __repr__(self) -> str:
+        return (f"Request({self.method} {self.target}, "
+                f"{len(self.body)}B, keep_alive={self.keep_alive})")
+
+
+class Response:
+    """One complete parsed response."""
+
+    __slots__ = ("status", "headers", "body")
+
+    def __init__(self, status: int, headers: dict, body: bytes):
+        self.status = status
+        self.headers = headers
+        self.body = body
+
+    def __repr__(self) -> str:
+        return f"Response({self.status}, {len(self.body)}B)"
+
+
+def content_length(value) -> int:
+    """THE Content-Length validation — the parsers and the threaded
+    front-end's body read both go through here, so 'what counts as a
+    well-formed length' has exactly one definition."""
+    if value is None:
+        return 0
+    try:
+        n = int(str(value).strip())
+    except ValueError:
+        raise ProtocolError(f"malformed Content-Length {value!r}") \
+            from None
+    if n < 0:
+        raise ProtocolError(f"negative Content-Length {value!r}")
+    if n > MAX_BODY_BYTES:
+        raise ProtocolError(
+            f"declared body of {n} bytes exceeds the "
+            f"{MAX_BODY_BYTES}-byte limit")
+    return n
+
+
+def _parse_headers(lines: list[bytes]) -> dict:
+    headers: dict[str, str] = {}
+    for line in lines:
+        name, sep, value = line.partition(b":")
+        if not sep or not name.strip():
+            raise ProtocolError(f"malformed header line {line!r}")
+        headers[name.strip().decode("latin-1").lower()] = \
+            value.strip().decode("latin-1")
+    return headers
+
+
+class _Parser:
+    """Shared incremental framing: buffer → head block → exactly
+    Content-Length body bytes → one event; repeat (pipelining)."""
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+        self._head = None           # parsed head awaiting its body
+        self._need = 0              # body bytes still owed
+
+    def pending_bytes(self) -> bool:
+        """True if the parser holds buffered bytes of an incomplete (or
+        not-yet-consumed) message — a reused connection handing these
+        back to a pool must NOT, the stream is mid-message."""
+        return bool(self._buf) or self._head is not None
+
+    def feed(self, data: bytes) -> list:
+        """Feed any slice of the byte stream; returns every message
+        COMPLETED by it, in order. Raises :class:`ProtocolError` on a
+        framing violation (the connection is then unrecoverable)."""
+        self._buf += data
+        out = []
+        while True:
+            event = self._next()
+            if event is None:
+                return out
+            out.append(event)
+
+    def _next(self):
+        if self._head is None:
+            idx = self._buf.find(_CRLF2)
+            if idx < 0:
+                if len(self._buf) > MAX_HEAD_BYTES:
+                    raise ProtocolError(
+                        f"header block exceeds {MAX_HEAD_BYTES} bytes")
+                return None
+            if idx > MAX_HEAD_BYTES:
+                raise ProtocolError(
+                    f"header block exceeds {MAX_HEAD_BYTES} bytes")
+            head = bytes(self._buf[:idx])
+            del self._buf[:idx + 4]
+            self._head, self._need = self._parse_head(head)
+        if len(self._buf) < self._need:
+            return None
+        body = bytes(self._buf[:self._need])
+        del self._buf[:self._need]
+        head, self._head = self._head, None
+        return self._finish(head, body)
+
+    # subclass surface ------------------------------------------------
+
+    def _parse_head(self, head: bytes):
+        raise NotImplementedError
+
+    def _finish(self, head, body: bytes):
+        raise NotImplementedError
+
+
+class RequestParser(_Parser):
+    """Server side: bytes from a client connection → :class:`Request`
+    events."""
+
+    def _parse_head(self, head: bytes):
+        lines = head.split(b"\r\n")
+        parts = lines[0].split()
+        if len(parts) != 3:
+            raise ProtocolError(f"malformed request line {lines[0]!r}")
+        method, target, version = parts
+        if not version.startswith(b"HTTP/1."):
+            raise ProtocolError(f"unsupported version {version!r}")
+        headers = _parse_headers(lines[1:])
+        connection = headers.get("connection", "").lower()
+        if version == b"HTTP/1.0":
+            keep_alive = connection == "keep-alive"
+        else:
+            keep_alive = connection != "close"
+        meta = (method.decode("latin-1"), target.decode("latin-1"),
+                headers, keep_alive)
+        return meta, content_length(headers.get("content-length"))
+
+    def _finish(self, head, body: bytes) -> Request:
+        method, target, headers, keep_alive = head
+        return Request(method, target, headers, body, keep_alive)
+
+
+class ResponseParser(_Parser):
+    """Client side: bytes from a server connection → :class:`Response`
+    events. A response MUST carry Content-Length (module docstring)."""
+
+    def _parse_head(self, head: bytes):
+        lines = head.split(b"\r\n")
+        parts = lines[0].split(None, 2)
+        if len(parts) < 2 or not parts[0].startswith(b"HTTP/1."):
+            raise ProtocolError(f"malformed status line {lines[0]!r}")
+        try:
+            status = int(parts[1])
+        except ValueError:
+            raise ProtocolError(
+                f"malformed status line {lines[0]!r}") from None
+        headers = _parse_headers(lines[1:])
+        if "content-length" not in headers:
+            raise ProtocolError(
+                "response without Content-Length on a keep-alive "
+                "connection")
+        return (status, headers), content_length(headers["content-length"])
+
+    def _finish(self, head, body: bytes) -> Response:
+        status, headers = head
+        return Response(status, headers, body)
+
+
+# ---- rendering ------------------------------------------------------
+
+
+def render_request(method: str, target: str, host: str,
+                   body: bytes = b"",
+                   headers: dict | None = None) -> bytes:
+    """Build one request's wire bytes — the exact frame FleetClient has
+    always sent (Host + Content-Length + extras, one buffer, ready for
+    a single send)."""
+    head = [f"{method} {target} HTTP/1.1",
+            f"Host: {host}",
+            f"Content-Length: {len(body)}"]
+    for k, v in (headers or {}).items():
+        head.append(f"{k}: {v}")
+    return ("\r\n".join(head) + "\r\n\r\n").encode("latin-1") + body
+
+
+def render_response(status: int, body: bytes,
+                    content_type: str = "application/json", *,
+                    keep_alive: bool = True,
+                    extra_headers: dict | None = None) -> bytes:
+    """Build one response's wire bytes. Both wire backends (threaded
+    and evloop) render through here, which is what makes their reply
+    streams byte-identical — the differential test's precondition."""
+    head = [f"HTTP/1.1 {status} {REASONS.get(status, 'Unknown')}",
+            f"Content-Type: {content_type}",
+            f"Content-Length: {len(body)}"]
+    if not keep_alive:
+        head.append("Connection: close")
+    for k, v in (extra_headers or {}).items():
+        head.append(f"{k}: {v}")
+    return ("\r\n".join(head) + "\r\n\r\n").encode("latin-1") + body

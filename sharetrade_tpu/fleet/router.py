@@ -56,6 +56,10 @@ log = get_logger("fleet.router")
 
 STATUS_FILE = "fleet_status.json"
 
+#: The total-outage refusal, word-for-word on both wire backends.
+UNROUTED_DETAIL = ("no live engines: the whole fleet is failed, "
+                   "draining, or unreachable")
+
 #: Engine-side counters whose window deltas feed the fleet availability
 #: burn (bad outcomes) and its denominator (all terminal outcomes).
 _BAD_COUNTERS = ("serve_shed_total", "serve_queue_rejected_total",
@@ -172,31 +176,27 @@ class FleetRouter:
         drops the engine from the live view and retries the request on a
         survivor; 429/504/4xx are a LIVE engine's true outcome and pass
         through untouched. The deadline header is forwarded VERBATIM —
-        expiry belongs to the engine's collection gate."""
+        expiry belongs to the engine's collection gate.
+
+        The routing/migration bookkeeping lives in the ``relay_*`` /
+        ``note_*`` helpers below so the evloop relay (fleet/evloop.py)
+        and this blocking loop share ONE definition of the semantics —
+        what keeps the threaded backend an honest differential oracle
+        for the event-loop one."""
         self.registry.inc("fleet_requests_total")
         headers = ({wire.DEADLINE_HEADER: deadline_raw}
                    if deadline_raw is not None else None)
-        if deadline_raw is not None:
-            try:
-                timeout_s = max(float(deadline_raw) / 1e3 * 4, 5.0)
-            except ValueError:
-                timeout_s = self.cfg.request_timeout_s
-        else:
-            timeout_s = self.cfg.request_timeout_s
+        timeout_s = self.relay_timeout_s(deadline_raw)
         tried: set[str] = set()
         migrated = False
         while True:
             choice = self._route(session, exclude=tried)
             if choice is None:
-                self.registry.inc("fleet_unrouted_total")
-                raise ServeEngineFailed(
-                    "no live engines: the whole fleet is failed, "
-                    "draining, or unreachable")
+                self.note_unrouted()
+                raise ServeEngineFailed(UNROUTED_DETAIL)
             engine_id, endpoint = choice
             client = self._client_for(endpoint)
-            with self._views_lock:
-                self._outstanding[engine_id] = \
-                    self._outstanding.get(engine_id, 0) + 1
+            self.note_sent(engine_id)
             try:
                 status, reply = client.raw_request(
                     wire.SUBMIT_PATH, body, extra_headers=headers,
@@ -204,12 +204,7 @@ class FleetRouter:
             except wire.TRANSPORT_ERRORS as exc:
                 status, reply, exc_repr = None, b"", repr(exc)
             finally:
-                with self._views_lock:
-                    n = self._outstanding.get(engine_id, 1) - 1
-                    if n > 0:
-                        self._outstanding[engine_id] = n
-                    else:
-                        self._outstanding.pop(engine_id, None)
+                self.note_done(engine_id)
             if status is None or status == wire.STATUS_UNAVAILABLE:
                 # The engine died/hung mid-request (SIGKILL chaos, a
                 # deploy) — or answered 503 over a still-open keep-alive
@@ -220,33 +215,12 @@ class FleetRouter:
                 # retry on a survivor — the migration path.
                 tried.add(engine_id)
                 migrated = True
-                self._mark_unreachable(engine_id)
-                self._drop_affinity(session)
-                self.registry.inc("fleet_engine_errors_total")
-                log.warning(
-                    "engine %s gone mid-request (%s); re-routing "
-                    "session %s", engine_id,
-                    exc_repr if status is None else f"status {status}",
-                    session)
+                self.note_engine_gone(
+                    session, engine_id,
+                    exc_repr if status is None else f"status {status}")
                 continue
-            if migrated:
-                self.registry.inc("fleet_migrations_total")
-            self._note_affinity(session, engine_id)
-            if status == wire.STATUS_OK:
-                self.registry.inc("fleet_completed_total")
-                # Name the serving engine without a JSON round-trip:
-                # splice the id before the object's closing brace.
-                cut = reply.rfind(b"}")
-                if cut >= 0:
-                    reply = (reply[:cut]
-                             + f',"engine":"{engine_id}"'.encode()
-                             + reply[cut:])
-            else:
-                # A live engine's protocol outcome (rejected / deadline
-                # / bad request): the request's true terminal state,
-                # relayed untouched, never retried by the router.
-                self._count_outcome_error()
-            return status, reply
+            return self.finish_relay(session, engine_id, migrated,
+                                     status, reply)
 
     def serve_request(self, session: str, obs,
                       deadline_ms: float | None) -> dict:
@@ -285,6 +259,75 @@ class FleetRouter:
 
     def _count_outcome_error(self) -> None:
         self.registry.inc("fleet_refused_total")
+
+    # ---- relay semantics (shared by both wire backends) --------------
+    #
+    # One hop of the data path, decomposed so the blocking loop above
+    # and the evloop relay drive IDENTICAL bookkeeping: note_sent /
+    # note_done bracket the hop (live outstanding), note_engine_gone is
+    # the migration step, finish_relay the terminal accounting.
+
+    def relay_timeout_s(self, deadline_raw: str | None) -> float:
+        """Per-attempt transport timeout: the deadline plus slack when
+        the client set one (expiry still belongs to the ENGINE — this
+        is only the wedged-peer backstop), the configured front-end
+        budget otherwise."""
+        if deadline_raw is not None:
+            try:
+                return max(float(deadline_raw) / 1e3 * 4, 5.0)
+            except ValueError:
+                pass
+        return self.cfg.request_timeout_s
+
+    def note_sent(self, engine_id: str) -> None:
+        with self._views_lock:
+            self._outstanding[engine_id] = \
+                self._outstanding.get(engine_id, 0) + 1
+
+    def note_done(self, engine_id: str) -> None:
+        with self._views_lock:
+            n = self._outstanding.get(engine_id, 1) - 1
+            if n > 0:
+                self._outstanding[engine_id] = n
+            else:
+                self._outstanding.pop(engine_id, None)
+
+    def note_engine_gone(self, session: str, engine_id: str,
+                         why: str) -> None:
+        """This ENGINE is gone, not the request: drop it from the live
+        view (the poller re-adds it when its respawn answers), forget
+        the session's affinity, and let the caller retry a survivor."""
+        self._mark_unreachable(engine_id)
+        self._drop_affinity(session)
+        self.registry.inc("fleet_engine_errors_total")
+        log.warning("engine %s gone mid-request (%s); re-routing "
+                    "session %s", engine_id, why, session)
+
+    def note_unrouted(self) -> None:
+        self.registry.inc("fleet_unrouted_total")
+
+    def finish_relay(self, session: str, engine_id: str, migrated: bool,
+                     status: int, reply: bytes) -> tuple[int, bytes]:
+        """Terminal accounting for a relayed reply: migration counter,
+        affinity, completion/refusal counters, and the engine-id splice
+        into a 200's bytes (before the object's closing brace — naming
+        the serving engine without a JSON round-trip)."""
+        if migrated:
+            self.registry.inc("fleet_migrations_total")
+        self._note_affinity(session, engine_id)
+        if status == wire.STATUS_OK:
+            self.registry.inc("fleet_completed_total")
+            cut = reply.rfind(b"}")
+            if cut >= 0:
+                reply = (reply[:cut]
+                         + f',"engine":"{engine_id}"'.encode()
+                         + reply[cut:])
+        else:
+            # A live engine's protocol outcome (rejected / deadline /
+            # bad request): the request's true terminal state, relayed
+            # untouched, never retried by the router.
+            self._count_outcome_error()
+        return status, reply
 
     # ---- routing ----------------------------------------------------
 

@@ -49,9 +49,11 @@ code  exception                   meaning
 from __future__ import annotations
 
 import json
+import re
 import socket
 from http.client import HTTPException
 
+from sharetrade_tpu.fleet import proto
 from sharetrade_tpu.serve.engine import (
     ServeDeadlineExceeded,
     ServeEngineFailed,
@@ -111,6 +113,26 @@ def status_to_error(status: int, body: dict) -> BaseException:
     return RuntimeError(f"unexpected wire status {status}: {detail}")
 
 
+#: Fast-path session extraction for the router's byte-level relay: the
+#: submit body leads with a plain-string session id in every client this
+#: repo ships; anything fancier (escapes, non-string ids) falls back to
+#: a real JSON parse.
+_SESSION_RE = re.compile(rb'"session"\s*:\s*"([^"\\]*)"')
+
+
+def extract_session(raw: bytes) -> str:
+    """Pull the session id out of a submit body without a full JSON
+    round-trip (both wire backends' relay paths use this); raises the
+    400-mapped ``ValueError`` on a body with no recoverable session."""
+    m = _SESSION_RE.search(raw)
+    if m is not None:
+        return m.group(1).decode("utf-8", "replace")
+    try:
+        return str(json.loads(raw)["session"])
+    except (ValueError, KeyError, TypeError) as exc:
+        raise ValueError(f"malformed submit body: {exc!r}") from exc
+
+
 class _WireConnError(ConnectionError):
     """A malformed/torn HTTP response on a persistent connection —
     transport-class (the keep-alive is unusable), never protocol-class."""
@@ -128,8 +150,8 @@ class FleetClient:
     decision.
 
     Implementation note: this speaks HTTP/1.1 over a RAW socket — one
-    ``sendall`` of a prebuilt request, a minimal status-line +
-    Content-Length response parse — instead of ``http.client``. Same
+    ``sendall`` of a prebuilt request, responses framed by the shared
+    sans-IO parser (fleet/proto.py) — instead of ``http.client``. Same
     protocol on the wire; ~4-5x less per-request Python, which is the
     difference between the router being thinner than an engine and the
     router being the fleet's bottleneck (bench_fleet's framing)."""
@@ -140,7 +162,7 @@ class FleetClient:
         self.port = int(port)
         self.timeout_s = float(timeout_s)
         self._sock: socket.socket | None = None
-        self._buf = b""
+        self._parser = proto.ResponseParser()
 
     def close(self) -> None:
         if self._sock is not None:
@@ -148,7 +170,7 @@ class FleetClient:
                 self._sock.close()
             finally:
                 self._sock = None
-                self._buf = b""
+                self._parser = proto.ResponseParser()
 
     def _connect(self, timeout_s: float) -> socket.socket:
         # fleet-net-ok: CLIENT socket (outbound connect, no listener).
@@ -160,52 +182,31 @@ class FleetClient:
         return sock
 
     def _read_response(self, sock: socket.socket) -> tuple[int, bytes]:
-        """Minimal HTTP/1.1 response read: status line + headers up to
-        CRLFCRLF, then exactly Content-Length body bytes."""
-        buf = self._buf
-        while b"\r\n\r\n" not in buf:
+        """One HTTP/1.1 response off the socket, framed by the shared
+        sans-IO parser (torn reads, missing/malformed Content-Length
+        and oversized heads all handled in ONE place — fleet/proto.py);
+        any framing violation is transport-class, the keep-alive is
+        unrecoverable."""
+        while True:
             chunk = sock.recv(65536)
             if not chunk:
                 raise _WireConnError("connection closed mid-response")
-            buf += chunk
-        head, _, buf = buf.partition(b"\r\n\r\n")
-        status_line, _, header_blob = head.partition(b"\r\n")
-        try:
-            status = int(status_line.split(None, 2)[1])
-        except (IndexError, ValueError) as exc:
-            raise _WireConnError(
-                f"malformed status line {status_line!r}") from exc
-        length = None
-        for line in header_blob.split(b"\r\n"):
-            if line[:15].lower() == b"content-length:":
-                try:
-                    length = int(line[15:].strip())
-                except ValueError as exc:
-                    raise _WireConnError(
-                        f"malformed Content-Length {line!r}") from exc
-        if length is None:
-            raise _WireConnError(
-                "response without Content-Length on a keep-alive "
-                "connection")
-        while len(buf) < length:
-            chunk = sock.recv(65536)
-            if not chunk:
-                raise _WireConnError("connection closed mid-body")
-            buf += chunk
-        body, self._buf = buf[:length], buf[length:]
-        return status, body
+            try:
+                events = self._parser.feed(chunk)
+            except proto.ProtocolError as exc:
+                raise _WireConnError(exc.detail) from exc
+            if events:
+                response = events[0]
+                return response.status, response.body
 
     def _request(self, method: str, path: str,
                  body: bytes | None = None,
                  headers: dict | None = None,
                  timeout_s: float | None = None) -> tuple[int, bytes]:
         body = body or b""
-        head = [f"{method} {path} HTTP/1.1",
-                f"Host: {self.host}:{self.port}",
-                f"Content-Length: {len(body)}"]
-        for k, v in (headers or {}).items():
-            head.append(f"{k}: {v}")
-        request = ("\r\n".join(head) + "\r\n\r\n").encode() + body
+        request = proto.render_request(method, path,
+                                       f"{self.host}:{self.port}",
+                                       body, headers=headers)
         timeout = timeout_s or self.timeout_s
         attempts = 2            # fresh-connection retry for torn keep-alive
         for attempt in range(attempts):

@@ -1,0 +1,349 @@
+"""Sans-IO wire core + evloop backend (fleet/proto.py, fleet/evloop.py
+— ISSUE 16).
+
+The load-bearing contracts:
+
+- **Torn reads are invisible**: a parser fed the SAME byte stream split
+  at EVERY offset (including one byte at a time) emits the same message
+  sequence — framing is a pure state machine, never "hope recv returned
+  a whole request".
+- **Pipelining**: N messages in one chunk come back as N events in
+  order; partial tails stay buffered across feeds.
+- **Bounded buffering**: an oversized head or declared body raises
+  :class:`ProtocolError` (status 400) instead of buffering unboundedly;
+  malformed framing is refused with the same class.
+- **Differential oracle**: the threaded and evloop wire backends answer
+  the SAME request stream with BYTE-IDENTICAL response streams — the
+  blocking stdlib path is retained exactly so the event-loop rewrite
+  can be diffed against it.
+- **Non-blocking discipline is linted**: check 15 keeps blocking socket
+  idioms and per-connection threads out of the evloop path, and keeps
+  fleet/proto.py free of I/O imports entirely.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from sharetrade_tpu.fleet import ServeFrontend
+from sharetrade_tpu.fleet import proto, wire
+from sharetrade_tpu.fleet.evloop import EvloopFrontend
+from sharetrade_tpu.fleet.frontend import ThreadedServeFrontend
+from sharetrade_tpu.utils.metrics import MetricsRegistry
+
+
+# ---- corpus ---------------------------------------------------------
+
+def _request_corpus() -> list[bytes]:
+    submit = json.dumps({"session": "s-1", "obs": [1.0, 2.0, 3.0]})
+    return [
+        proto.render_request("GET", wire.HEALTH_PATH, "h:1"),
+        proto.render_request("POST", wire.SUBMIT_PATH, "h:1",
+                             submit.encode(),
+                             headers={wire.DEADLINE_HEADER: "250"}),
+        proto.render_request("POST", wire.SUBMIT_PATH, "h:1",
+                             b"\x00binary body\xff",
+                             headers={"Connection": "close"}),
+        proto.render_request("GET", wire.METRICS_PATH, "h:1"),
+    ]
+
+
+def _response_corpus() -> list[bytes]:
+    return [
+        proto.render_response(200, b'{"ok": true}'),
+        proto.render_response(503, b'{"error": "engine_failed"}',
+                              keep_alive=False),
+        proto.render_response(200, b"metrics text",
+                              content_type="text/plain; version=0.0.4",
+                              extra_headers={"X-Probe": "1"}),
+        proto.render_response(400, b""),
+    ]
+
+
+def _req_key(r: proto.Request) -> tuple:
+    return (r.method, r.target, sorted(r.headers.items()), r.body,
+            r.keep_alive)
+
+
+def _resp_key(r: proto.Response) -> tuple:
+    return (r.status, sorted(r.headers.items()), r.body)
+
+
+class TestSansIOParsers:
+    def test_request_stream_torn_at_every_offset(self):
+        blob = b"".join(_request_corpus())
+        reference = [_req_key(r)
+                     for r in proto.RequestParser().feed(blob)]
+        assert len(reference) == len(_request_corpus())
+        for split in range(1, len(blob)):
+            p = proto.RequestParser()
+            got = p.feed(blob[:split]) + p.feed(blob[split:])
+            assert [_req_key(r) for r in got] == reference, split
+            assert not p.pending_bytes()
+
+    def test_response_stream_torn_at_every_offset(self):
+        blob = b"".join(_response_corpus())
+        reference = [_resp_key(r)
+                     for r in proto.ResponseParser().feed(blob)]
+        assert len(reference) == len(_response_corpus())
+        for split in range(1, len(blob)):
+            p = proto.ResponseParser()
+            got = p.feed(blob[:split]) + p.feed(blob[split:])
+            assert [_resp_key(r) for r in got] == reference, split
+
+    def test_one_byte_at_a_time(self):
+        blob = b"".join(_request_corpus())
+        reference = [_req_key(r)
+                     for r in proto.RequestParser().feed(blob)]
+        p = proto.RequestParser()
+        got = []
+        for i in range(len(blob)):
+            got.extend(p.feed(blob[i:i + 1]))
+        assert [_req_key(r) for r in got] == reference
+        assert not p.pending_bytes()
+
+    def test_pending_bytes_mid_message(self):
+        blob = _request_corpus()[1]
+        p = proto.RequestParser()
+        assert not p.pending_bytes()
+        assert p.feed(blob[:len(blob) - 1]) == []
+        assert p.pending_bytes()        # mid-body: not pool-reusable
+        assert len(p.feed(blob[len(blob) - 1:])) == 1
+        assert not p.pending_bytes()
+
+    def test_keep_alive_folding(self):
+        def parse(version, connection=None):
+            head = [f"GET / {version}", "Host: h"]
+            if connection:
+                head.append(f"Connection: {connection}")
+            raw = ("\r\n".join(head) + "\r\n\r\n").encode()
+            return proto.RequestParser().feed(raw)[0].keep_alive
+
+        assert parse("HTTP/1.1") is True
+        assert parse("HTTP/1.1", "close") is False
+        assert parse("HTTP/1.0") is False
+        assert parse("HTTP/1.0", "keep-alive") is True
+
+    @pytest.mark.parametrize("raw", [
+        b"GARBAGE\r\n\r\n",                       # no 3-part line
+        b"GET /x HTTP/2\r\n\r\n",                 # unsupported version
+        b"GET /x HTTP/1.1\r\nNoColonHere\r\n\r\n",
+        b"GET /x HTTP/1.1\r\nContent-Length: xyz\r\n\r\n",
+        b"GET /x HTTP/1.1\r\nContent-Length: -5\r\n\r\n",
+    ])
+    def test_malformed_requests_raise_400(self, raw):
+        with pytest.raises(proto.ProtocolError) as exc:
+            proto.RequestParser().feed(raw)
+        assert exc.value.status == 400
+
+    def test_oversized_head_refused_before_terminator(self):
+        p = proto.RequestParser()
+        with pytest.raises(proto.ProtocolError):
+            p.feed(b"GET /x HTTP/1.1\r\nX: "
+                   + b"a" * (proto.MAX_HEAD_BYTES + 8))
+
+    def test_oversized_declared_body_refused(self):
+        raw = (f"POST /x HTTP/1.1\r\nContent-Length: "
+               f"{proto.MAX_BODY_BYTES + 1}\r\n\r\n").encode()
+        with pytest.raises(proto.ProtocolError):
+            proto.RequestParser().feed(raw)
+
+    def test_response_requires_content_length(self):
+        with pytest.raises(proto.ProtocolError) as exc:
+            proto.ResponseParser().feed(b"HTTP/1.1 200 OK\r\n\r\n")
+        assert "Content-Length" in exc.value.detail
+
+    def test_render_request_is_the_fleet_client_frame(self):
+        raw = proto.render_request("POST", "/v1/submit", "10.0.0.1:80",
+                                   b"{}", headers={"X-Deadline-Ms": "9"})
+        assert raw == (b"POST /v1/submit HTTP/1.1\r\n"
+                       b"Host: 10.0.0.1:80\r\n"
+                       b"Content-Length: 2\r\n"
+                       b"X-Deadline-Ms: 9\r\n\r\n{}")
+
+
+# ---- the differential oracle ---------------------------------------
+
+
+class StubBackend:
+    """Deterministic inline backend: replies are a pure function of the
+    request, so the two wire backends' response streams must be
+    byte-identical."""
+
+    def serve_request(self, session, obs, deadline_ms):
+        vals = [float(x) for x in obs]
+        return {"session": session, "action": len(vals) % 3,
+                "logits": vals[:3], "value": sum(vals),
+                "params_step": 7, "latency_ms": 0.25,
+                "stages": {"queue_ms": 0.1}}
+
+    def health(self):
+        return {"ok": True, "failed": False, "queue_depth": 0,
+                "overload": 0.0, "params_step": 7, "swaps_total": 0}
+
+
+def _scripted_stream() -> tuple[bytes, int]:
+    """One connection's worth of requests covering every front-end
+    reply path that is deterministic across backends; returns
+    ``(payload, expected_response_count)``."""
+    ok = json.dumps({"session": "d-1", "obs": [1.0, 2.0, 3.0]}).encode()
+    reqs = [
+        proto.render_request("GET", wire.HEALTH_PATH, "h:1"),
+        proto.render_request("POST", wire.SUBMIT_PATH, "h:1", ok),
+        proto.render_request("POST", wire.SUBMIT_PATH, "h:1",
+                             b"not json at all"),
+        proto.render_request("POST", wire.SUBMIT_PATH, "h:1",
+                             b'{"obs": [1.0]}'),      # missing session
+        proto.render_request("POST", wire.SUBMIT_PATH, "h:1", ok,
+                             headers={wire.DEADLINE_HEADER: "soon"}),
+        proto.render_request("GET", "/nope", "h:1"),
+        proto.render_request("POST", "/nope", "h:1", b"ignored body"),
+        # pipelined burst: three submits in one segment
+        proto.render_request("POST", wire.SUBMIT_PATH, "h:1", ok) * 3,
+    ]
+    return b"".join(reqs), 10
+
+
+def _drive(host: str, port: int, payload: bytes, n_responses: int,
+           chunk: int | None = None) -> bytes:
+    sock = socket.create_connection((host, port), timeout=30.0)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.settimeout(30.0)
+    if chunk is None:
+        sock.sendall(payload)
+    else:
+        for i in range(0, len(payload), chunk):
+            sock.sendall(payload[i:i + chunk])
+    parser = proto.ResponseParser()
+    raw = bytearray()
+    got = 0
+    while got < n_responses:
+        data = sock.recv(1 << 16)
+        if not data:
+            break
+        raw += data
+        got += len(parser.feed(data))
+    sock.close()
+    assert got == n_responses
+    return bytes(raw)
+
+
+class TestDifferentialOracle:
+    def test_threaded_and_evloop_answer_byte_identically(self):
+        payload, n = _scripted_stream()
+        streams = {}
+        for backend in ("threaded", "evloop"):
+            fe = ServeFrontend(StubBackend(), MetricsRegistry(),
+                               wire_backend=backend).start()
+            try:
+                streams[backend] = _drive(fe.host, fe.port, payload, n)
+                # ...and torn delivery must not change a byte either.
+                torn = _drive(fe.host, fe.port, payload, n, chunk=7)
+                assert torn == streams[backend]
+            finally:
+                fe.stop()
+        assert streams["threaded"] == streams["evloop"]
+
+    def test_wire_backend_knob(self):
+        reg = MetricsRegistry()
+        fe = ServeFrontend(StubBackend(), reg, wire_backend="threaded")
+        assert isinstance(fe, ThreadedServeFrontend)
+        fe2 = ServeFrontend(StubBackend(), reg)     # default: evloop
+        assert isinstance(fe2, EvloopFrontend)
+        with pytest.raises(ValueError):
+            ServeFrontend(StubBackend(), reg, wire_backend="carrier")
+
+
+class TestEvloopSocketEdges:
+    def test_oversized_head_gets_400_and_close(self):
+        fe = ServeFrontend(StubBackend(), MetricsRegistry(),
+                           wire_backend="evloop").start()
+        try:
+            sock = socket.create_connection((fe.host, fe.port),
+                                            timeout=30.0)
+            sock.settimeout(30.0)
+            sock.sendall(b"GET /healthz HTTP/1.1\r\nX-Pad: "
+                         + b"a" * (proto.MAX_HEAD_BYTES + 64))
+            raw = bytearray()
+            while True:
+                data = sock.recv(1 << 16)
+                if not data:        # server closed after the refusal
+                    break
+                raw += data
+            sock.close()
+            resp = proto.ResponseParser().feed(bytes(raw))[0]
+            assert resp.status == 400
+            assert resp.headers.get("connection") == "close"
+        finally:
+            fe.stop()
+
+    def test_draining_refusal_matches_threaded_wording(self):
+        payload = proto.render_request(
+            "POST", wire.SUBMIT_PATH, "h:1",
+            json.dumps({"session": "x", "obs": [1.0, 2.0]}).encode())
+        bodies = {}
+        for backend in ("threaded", "evloop"):
+            fe = ServeFrontend(StubBackend(), MetricsRegistry(),
+                               wire_backend=backend).start()
+            try:
+                sock = socket.create_connection((fe.host, fe.port),
+                                                timeout=30.0)
+                sock.settimeout(30.0)
+                parser = proto.ResponseParser()
+
+                def roundtrip() -> proto.Response:
+                    sock.sendall(payload)
+                    resps: list = []
+                    while not resps:
+                        data = sock.recv(1 << 16)
+                        if not data:
+                            break
+                        resps.extend(parser.feed(data))
+                    return resps[0]
+
+                # One served request FIRST: the connection is then
+                # accepted and keep-alive before the listener closes.
+                assert roundtrip().status == wire.STATUS_OK
+                assert fe.drain(timeout_s=5.0)
+                resp = roundtrip()
+                sock.close()
+                bodies[backend] = (resp.status, resp.body)
+            finally:
+                fe.stop()
+        assert bodies["threaded"] == bodies["evloop"]
+        assert bodies["evloop"][0] == wire.STATUS_UNAVAILABLE
+
+
+class TestEvloopLint:
+    def test_lint_evloop_sansio_semantics(self, tmp_path):
+        import lint_hot_loop
+        pkg = tmp_path / "pkg"
+        (pkg / "fleet").mkdir(parents=True)
+        (pkg / "fleet" / "evloop.py").write_text(
+            "import socket, threading, time\n"
+            "def bad(s):\n"
+            "    s.sendall(b'x')\n"
+            "    time.sleep(1)\n"
+            "def ok(s):\n"
+            "    # evloop-block-ok: test probe\n"
+            "    s.sendall(b'x')\n"
+            "    t = threading.Thread()  # evloop-block-ok: runner\n")
+        (pkg / "fleet" / "proto.py").write_text(
+            "import socket\n"
+            "from selectors import DefaultSelector\n")
+        block, imports = lint_hot_loop.lint_evloop_sansio(root=pkg)
+        assert [(r, ln) for r, ln, _ in block] \
+            == [("fleet/evloop.py", 3), ("fleet/evloop.py", 4)]
+        assert [(r, ln) for r, ln, _ in imports] \
+            == [("fleet/proto.py", 1), ("fleet/proto.py", 2)]
+        # The real tree is clean (the repo-level invariant).
+        real_block, real_imports = lint_hot_loop.lint_evloop_sansio()
+        assert real_block == [] and real_imports == []

@@ -73,11 +73,13 @@ def eprint(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
-def build_config(workdir: str, engines: int) -> str:
+def build_config(workdir: str, engines: int,
+                 wire_backend: str = "evloop") -> str:
     """The soak's config: tiny MLP serve workload, journaled-DQN
     learner with session-feed ingest, fast swap/telemetry cadences.
     All paths ABSOLUTE into the scratch dir (children run from the
-    repo root)."""
+    repo root). ``wire_backend`` picks the front-end/router data path
+    (the default soaks the evloop; ``threaded`` soaks the oracle)."""
     from sharetrade_tpu.config import FrameworkConfig
     cfg = FrameworkConfig()
     cfg.seed = 7
@@ -106,6 +108,7 @@ def build_config(workdir: str, engines: int) -> str:
     cfg.distrib.actor_dir = os.path.join(workdir, "actors")
     cfg.distrib.ingest_every_updates = 4
     cfg.fleet.num_engines = engines
+    cfg.fleet.wire_backend = wire_backend
     cfg.fleet.dir = os.path.join(workdir, "fleet")
     cfg.fleet.telemetry_poll_s = 0.3
     cfg.fleet.health_timeout_s = 5.0
@@ -230,15 +233,16 @@ def live_engine_pids(status_path: str) -> dict[str, int]:
 
 def run_soak(*, engines: int, kills: int, ramp_s: float,
              sessions: int, concurrency: int,
-             workdir: str | None = None, keep: bool = False) -> dict:
+             workdir: str | None = None, keep: bool = False,
+             wire_backend: str = "evloop") -> dict:
     own_dir = workdir is None
     workdir = workdir or tempfile.mkdtemp(prefix="fleet_soak_")
-    cfg_path = build_config(workdir, engines)
+    cfg_path = build_config(workdir, engines, wire_backend)
     status_path = os.path.join(workdir, "fleet", "fleet_status.json")
     learner_prom = os.path.join(workdir, "obs", "learner", "metrics.prom")
     log_path = os.path.join(workdir, "fleet.log")
     result: dict = {"engines": engines, "kills_planned": kills,
-                    "workdir": workdir}
+                    "wire_backend": wire_backend, "workdir": workdir}
     proc = launch_cli("fleet", cfg_path, log_path, symbol="MSFT",
                       extra_args=["--learner", "--engines", str(engines),
                                   "--duration", "0"])
@@ -422,6 +426,10 @@ def main() -> int:
     parser.add_argument("--ramp", type=float, default=6.0)
     parser.add_argument("--sessions", type=int, default=64)
     parser.add_argument("--concurrency", type=int, default=12)
+    parser.add_argument("--wire-backend", default="evloop",
+                        choices=("evloop", "threaded"),
+                        help="front-end/router data path to soak "
+                             "(threaded = the differential oracle)")
     parser.add_argument("--quick", action="store_true",
                         help="tier-1 profile: 2 engines, 1 kill, short "
                              "ramp")
@@ -438,7 +446,8 @@ def main() -> int:
     try:
         result = run_soak(engines=args.engines, kills=args.kills,
                           ramp_s=args.ramp, sessions=args.sessions,
-                          concurrency=args.concurrency, keep=args.keep)
+                          concurrency=args.concurrency, keep=args.keep,
+                          wire_backend=args.wire_backend)
     except SoakError as exc:
         print(json.dumps({"ok": False, "error": str(exc)}), flush=True)
         eprint(f"FLEET SOAK FAILED: {exc}")

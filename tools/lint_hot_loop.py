@@ -462,6 +462,30 @@ SERVE_NET_PATTERN = re.compile(
     r"\.recv\s*\(|\.sendall\s*\(|\.accept\s*\(|\.connect\s*\(")
 FLEET_NET_MARKER = "fleet-net-ok"
 
+#: Check 15 (the evloop PR): the event-loop wire path stays
+#: non-blocking and the protocol core stays sans-IO. One blocking call
+#: on the loop thread stalls EVERY connection the process is proxying —
+#: so fleet/evloop.py + fleet/proto.py must not grow blocking socket
+#: idioms (sendall / settimeout / create_connection /
+#: setblocking(True) / time.sleep) or per-connection threads
+#: (threading.Thread — the single loop-runner thread carries the
+#: marker). And proto.py must not import I/O modules AT ALL: the
+#: parser's whole value is that the same state machine frames bytes
+#: for the client, the front-end, and the router without touching a
+#: socket (that is what makes torn-read/pipelining tests exhaustive).
+EVLOOP_FILES = ("fleet/evloop.py", "fleet/proto.py")
+EVLOOP_BLOCK_PATTERN = re.compile(
+    r"\.sendall\s*\(|time\.sleep\s*\(|socket\.create_connection\s*\(|"
+    r"\.settimeout\s*\(|\.setblocking\s*\(\s*True|"
+    r"threading\.Thread\s*\(|\.makefile\s*\(")
+#: Escape hatch naming why a blocking idiom is correct (on the line or
+#: the two preceding lines) — e.g. the one loop-runner thread.
+EVLOOP_BLOCK_MARKER = "evloop-block-ok"
+#: Modules the sans-IO core must never import.
+SANSIO_FORBIDDEN_IMPORTS = ("socket", "select", "selectors", "ssl",
+                            "http", "socketserver", "asyncio")
+SANSIO_FILE = "fleet/proto.py"
+
 
 def lint_hot_loop_syncs() -> tuple[list[tuple[str, int, str]], set[str]]:
     return _scan_named_funcs(HOT_FUNCS, PATTERN, MARKER)
@@ -697,6 +721,51 @@ def lint_fleet_net(
         target=SERVE_TARGET)
     return listener_bad, [(SERVE_TARGET.name, ln, text)
                           for _, ln, text in dispatch_bad]
+
+
+def lint_evloop_sansio(
+        root: pathlib.Path | None = None) -> tuple[
+            list[tuple[str, int, str]], list[tuple[str, int, str]]]:
+    """Check 15: (a) no blocking socket idioms or per-connection
+    threads in the event-loop wire path (EVLOOP_FILES), marker-exempt
+    on the line or the two above (``evloop-block-ok`` — the one
+    loop-runner thread); (b) the sans-IO core (SANSIO_FILE) imports no
+    I/O module at all. Returns ``(blocking_hits, import_hits)``.
+    ``root`` overrides the scanned package root (tests exercise the
+    semantics on fixtures)."""
+    root = root or TARGET.parent.parent     # sharetrade_tpu/
+    blocking_bad: list[tuple[str, int, str]] = []
+    for rel in EVLOOP_FILES:
+        path = pathlib.Path(root) / rel
+        if not path.exists():
+            continue
+        lines = path.read_text().splitlines()
+        for ln, text in enumerate(lines, 1):
+            if text.lstrip().startswith("#"):
+                continue
+            if not EVLOOP_BLOCK_PATTERN.search(text):
+                continue
+            window = lines[max(0, ln - 3):ln]
+            if any(EVLOOP_BLOCK_MARKER in w for w in window):
+                continue
+            blocking_bad.append((rel, ln, text.strip()))
+    import_bad: list[tuple[str, int, str]] = []
+    sansio = pathlib.Path(root) / SANSIO_FILE
+    if sansio.exists():
+        src = sansio.read_text()
+        for node in ast.walk(ast.parse(src)):
+            if isinstance(node, ast.Import):
+                mods = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                mods = [node.module or ""]
+            else:
+                continue
+            for mod in mods:
+                if mod.split(".")[0] in SANSIO_FORBIDDEN_IMPORTS:
+                    import_bad.append(
+                        (SANSIO_FILE, node.lineno,
+                         src.splitlines()[node.lineno - 1].strip()))
+    return blocking_bad, import_bad
 
 
 def lint_dispatcher_blocking() -> tuple[list[tuple[str, int, str]], set[str]]:
@@ -973,6 +1042,27 @@ def main() -> int:
               f"tag the line '# {FLEET_NET_MARKER}: <why the dispatch "
               "path blocks on the network on purpose>'")
         return 1
+    ev_block_bad, ev_import_bad = lint_evloop_sansio()
+    if ev_block_bad:
+        print("evloop blocking-idiom lint FAILED:")
+        for rel, ln, text in ev_block_bad:
+            print(f"  sharetrade_tpu/{rel}:{ln}: {text}")
+        print("one blocking call on the event-loop thread stalls every "
+              "connection the process is proxying; use the loop's "
+              "non-blocking write/timer paths, or tag the line (or a "
+              f"comment just above) '# {EVLOOP_BLOCK_MARKER}: <why "
+              "this may block>'")
+        return 1
+    if ev_import_bad:
+        print("sans-IO protocol-core import lint FAILED:")
+        for rel, ln, text in ev_import_bad:
+            print(f"  sharetrade_tpu/{rel}:{ln}: {text}")
+        print("fleet/proto.py is the SANS-IO core: bytes in, events "
+              "out — an I/O import there couples the parser to a "
+              "transport and breaks the exhaustive torn-read/"
+              "pipelining tests; keep I/O in fleet/evloop.py and "
+              "fleet/wire.py")
+        return 1
     dur_bad = lint_durable_replace()
     if dur_bad:
         print("durable-rename fsync lint FAILED:")
@@ -1000,6 +1090,8 @@ def main() -> int:
           f"{', '.join(TUNED_KNOB_DIRS)}); "
           f"fleet net-listener lint OK (listeners confined to "
           f"sharetrade_tpu/{FLEET_NET_DIR}/); "
+          f"evloop non-blocking lint OK ({', '.join(EVLOOP_FILES)}); "
+          f"sans-IO import lint OK ({SANSIO_FILE}); "
           f"durable-rename fsync lint OK ({', '.join(DURABLE_WRITE_FILES)})")
     return 0
 

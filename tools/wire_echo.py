@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Loopback echo engine: the fleet wire surface with ZERO model compute.
+
+Serves ``POST /v1/submit`` / ``GET /healthz`` / ``GET /metrics`` on the
+evloop wire backend with a canned, constant-shape reply — the upstream
+stand-in ``bench.bench_router_relay`` points the router at, so the
+router arm measures pure RELAY cost (parse, route, proxy hop, splice)
+with engine compute subtracted. Runs as a subprocess so the echo's own
+CPU/GIL time never shares the router process's interpreter.
+
+Prints the standard machine-readable ``engine_listening`` line (the
+same contract as ``cli serve --listen``), then serves until SIGTERM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from sharetrade_tpu.fleet import ServeFrontend             # noqa: E402
+from sharetrade_tpu.utils.metrics import MetricsRegistry   # noqa: E402
+
+
+class EchoBackend:
+    """The cheapest possible ``serve_request`` backend: a canned reply
+    shaped like a real engine's (so the router's engine-id splice and
+    the loadgen's parse both exercise the true payload path). Runs
+    INLINE on the evloop — microseconds per request, by construction."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._reply = {
+            "session": "",
+            "action": 1,
+            "logits": [0.1, 0.7, 0.2],
+            "value": 0.0,
+            "params_step": 0,
+            "latency_ms": 0.0,
+            "stages": {},
+        }
+
+    def serve_request(self, session: str, obs, deadline_ms) -> dict:
+        reply = dict(self._reply)
+        reply["session"] = session
+        return reply
+
+    def health(self) -> dict:
+        return {"ok": True, "failed": False, "queue_depth": 0,
+                "overload": 0.0, "params_step": 0, "swaps_total": 0,
+                "echo": self.name}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--name", default="echo")
+    args = parser.parse_args()
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+
+    frontend = ServeFrontend(EchoBackend(args.name), MetricsRegistry(),
+                             host=args.host, port=args.port,
+                             wire_backend="evloop").start()
+    print(json.dumps({"event": "engine_listening",
+                      "host": frontend.host, "port": frontend.port,
+                      "pid": os.getpid(), "params_step": 0}),
+          flush=True)
+    stop.wait()
+    frontend.drain(timeout_s=2.0)
+    frontend.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
