@@ -1582,14 +1582,26 @@ def bench_router_relay(*, duration_s: float = 2.0,
     arm's relay throughput at the largest scan point. Acceptance
     (ISSUE 16): evloop >= 10x the threaded arm in the same run
     (``accepted_10x``; reported as measured, never asserted).
+
+    Tracing A/B (ISSUE 17): after the scan, three extra evloop runs at
+    the FIRST scan point — two tracing-off (the A/A control that bounds
+    run-to-run noise) and one tracing-on (frontend mints trace ids,
+    relay journals per-attempt spans). ``tracing_ab.trace_overhead_pct``
+    is the qps cost of tracing; acceptance is < 2% (or within the A/A
+    spread when noise exceeds that). The gate series stays tracing-off
+    at the LARGEST scan point, so this arm cannot shift
+    ``router_relay_qps`` history.
     """
     import json as _json
     import os
+    import shutil as _shutil
     import signal
     import socket as socketlib
     import subprocess
     import sys
+    import tempfile
     import threading
+    import types
 
     from sharetrade_tpu.fleet import (
         FleetRouter,
@@ -1620,15 +1632,23 @@ def bench_router_relay(*, duration_s: float = 2.0,
                 raise RuntimeError(f"echo {i} bad ready line: {line!r}")
             endpoints[f"echo{i}"] = (ready["host"], ready["port"])
 
-        def run_arm(backend_name: str, connections: int) -> dict:
+        def run_arm(backend_name: str, connections: int,
+                    traced: bool = False) -> dict:
             registry = MetricsRegistry()
             cfg = FrameworkConfig().fleet
+            span_dir, sink, tracer, obs_shim = None, None, None, None
+            if traced:
+                from sharetrade_tpu.obs.trace import SpanJournal, SpanSink
+                span_dir = tempfile.mkdtemp(prefix="relay_spans_")
+                sink = SpanSink(SpanJournal(span_dir, "bench-router"))
+                tracer = wire.WireTracer(sink, mint=True)
+                obs_shim = types.SimpleNamespace(spans=sink)
             router = FleetRouter(StaticEndpoints(endpoints), cfg,
-                                 registry, workdir="")
+                                 registry, workdir="", obs=obs_shim)
             router.poll_once()          # one scrape: views go live
             frontend = ServeFrontend(
                 router, registry,
-                wire_backend=backend_name).start()
+                wire_backend=backend_name, tracer=tracer).start()
             host, port = frontend.host, frontend.port
             n_threads = max(1, min(loadgen_threads, connections))
             per_thread = [connections // n_threads
@@ -1709,6 +1729,10 @@ def bench_router_relay(*, duration_s: float = 2.0,
                 t.join(timeout=600.0)
             frontend.stop()
             router.stop()
+            if sink is not None:
+                sink.close()
+            if span_dir is not None:
+                _shutil.rmtree(span_dir, ignore_errors=True)
             errors = [r[1] for r in results.values()
                       if r and r[0] == "error"]
             good = [r for r in results.values()
@@ -1751,6 +1775,27 @@ def bench_router_relay(*, duration_s: float = 2.0,
                         conns_at_90pct=at_90pct(arms["threaded"]))
         evloop = dict(arms["evloop"][-1],
                       conns_at_90pct=at_90pct(arms["evloop"]))
+
+        # Tracing A/B (see docstring): runs AFTER the scan so the gate
+        # series above is untouched.
+        ab_conns = scan_connections[0]
+        aa1 = run_arm("evloop", ab_conns)
+        aa2 = run_arm("evloop", ab_conns)
+        traced_arm = run_arm("evloop", ab_conns, traced=True)
+        off_qps = (aa1["qps"] + aa2["qps"]) / 2.0
+        aa_spread_pct = (abs(aa1["qps"] - aa2["qps"])
+                         / max(off_qps, 1e-9) * 100.0)
+        trace_overhead_pct = ((off_qps - traced_arm["qps"])
+                              / max(off_qps, 1e-9) * 100.0)
+        tracing_ab = {
+            "connections": ab_conns,
+            "off_qps": [aa1["qps"], aa2["qps"]],
+            "on_qps": traced_arm["qps"],
+            "aa_spread_pct": round(aa_spread_pct, 2),
+            "trace_overhead_pct": round(trace_overhead_pct, 2),
+            "accepted_lt2pct": (trace_overhead_pct
+                                <= max(2.0, aa_spread_pct)),
+        }
     finally:
         for proc in procs:
             if proc.poll() is None:
@@ -1774,6 +1819,7 @@ def bench_router_relay(*, duration_s: float = 2.0,
         "scan": scan,
         "speedup": round(speedup, 1),
         "accepted_10x": speedup >= 10.0,
+        "tracing_ab": tracing_ab,
         "note": (f"pure relay cost through one router process "
                  f"(keep-alive conns scanned over {list(scan_connections)}, "
                  f"{pipeline}-deep pipelines, loopback echo subprocesses; "

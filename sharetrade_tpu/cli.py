@@ -331,14 +331,23 @@ def cmd_serve(args) -> int:
             # SIGTERM drains in-flight requests and exits 75 — the same
             # contract as the synthetic-driver mode, over a socket.
             from sharetrade_tpu.fleet import EngineBackend, ServeFrontend
+            from sharetrade_tpu.fleet.wire import WireTracer
             host, _, port_s = args.listen.rpartition(":")
+            # Span journaling (ISSUE 17): a worker spawned by a tracing
+            # fleet carries obs.span_dir/span_proc (fleet/pool.py) and
+            # journals its engine spans there even with obs.enabled
+            # false; the sink-less tracer parses inbound headers so
+            # those spans parent under the router's attempt span.
             frontend = ServeFrontend(
                 EngineBackend(
                     engine,
-                    request_timeout_s=cfg.fleet.request_timeout_s),
+                    request_timeout_s=cfg.fleet.request_timeout_s,
+                    spans=obs_bundle.spans),
                 registry, host=host or "127.0.0.1",
                 port=int(port_s or 0),
-                wire_backend=cfg.fleet.wire_backend).start()
+                wire_backend=cfg.fleet.wire_backend,
+                tracer=(WireTracer() if obs_bundle.spans is not None
+                        else None)).start()
             # The pool tails the worker's log for this line to learn the
             # ephemeral port (fleet/pool.py LISTENING_EVENT).
             print(json.dumps({"event": "engine_listening",
@@ -730,6 +739,12 @@ def cmd_fleet(args) -> int:
     try:
         registry = MetricsRegistry(
             max_points=cfg.obs.max_metric_points or None)
+        if cfg.obs.enabled and cfg.obs.trace and not cfg.obs.span_dir:
+            # Fleet-wide distributed tracing (ISSUE 17): one shared
+            # spans dir; this process journals as "fleet", each worker
+            # as "engine-<id>" (fleet/pool.py injects the same dir).
+            cfg.obs.span_dir = os.path.join(cfg.obs.dir, "spans")
+            cfg.obs.span_proc = cfg.obs.span_proc or "fleet"
         obs_bundle = build_obs(cfg, registry)
         pool = EnginePool(cfg, registry=registry, symbol=args.symbol,
                           start=args.start, end=args.end).start()
@@ -738,9 +753,12 @@ def cmd_fleet(args) -> int:
         router = FleetRouter(pool, cfg.fleet, registry,
                              workdir=cfg.fleet.dir, obs_cfg=cfg.obs,
                              obs=obs_bundle).start()
+        from sharetrade_tpu.fleet.wire import WireTracer
         frontend = ServeFrontend(
             router, registry, host=cfg.fleet.host, port=cfg.fleet.port,
-            wire_backend=cfg.fleet.wire_backend).start()
+            wire_backend=cfg.fleet.wire_backend,
+            tracer=(WireTracer(obs_bundle.spans, mint=True)
+                    if obs_bundle.spans is not None else None)).start()
 
         if args.learner:
             from sharetrade_tpu.config import FrameworkConfig
@@ -835,11 +853,60 @@ def cmd_fleet(args) -> int:
 def cmd_obs(args) -> int:
     """Summarize a telemetry run dir (obs.enabled=true output): manifest
     identity, span aggregates from the Chrome trace, metrics tail, and the
-    flight-recorder verdict when a bundle was dumped."""
+    flight-recorder verdict when a bundle was dumped.
+
+    ``--trace <id>`` (or ``--trace list``) switches to the ISSUE-17
+    cross-process collector: stitch the span journals under
+    ``<dir>/spans`` into one trace (``--out`` renders it for Perfetto).
+    ``--history N`` reads the fleet router's per-poll gauge ring
+    (``fleet_history.jsonl`` under ``--dir``, the fleet WORKDIR for this
+    flag) and prints the last-N-windows summary."""
     import os
 
     from sharetrade_tpu.obs import summarize_run_dir
 
+    if args.trace:
+        from sharetrade_tpu.obs import collect
+        spans_dir = os.path.join(args.dir, "spans")
+        if not os.path.isdir(spans_dir):
+            log.error("no span journals under %s (run `cli fleet` with "
+                      "obs.enabled=true)", spans_dir)
+            return 1
+        if args.trace == "list":
+            ids = collect.trace_ids(collect.read_span_dir(spans_dir))
+            print(json.dumps({"spans_dir": spans_dir, "traces": ids},
+                             indent=2))
+            return 0
+        stitched = collect.collect_trace(spans_dir, args.trace,
+                                         out=args.out)
+        if not stitched["spans"]:
+            log.error("trace %s not found under %s (try --trace list)",
+                      args.trace, spans_dir)
+            return 1
+        view = {"trace_id": stitched["trace_id"],
+                "procs": stitched["procs"],
+                "errors": stitched["errors"],
+                "spans": [{k: s.get(k) for k in
+                           ("name", "proc", "span", "parent", "ts_us",
+                            "dur_us", "note") if k in s}
+                          for s in stitched["spans"]]}
+        if "perfetto" in stitched:
+            view["perfetto"] = stitched["perfetto"]
+        print(json.dumps(view, indent=2))
+        return 0 if not stitched["errors"] else 1
+    if args.history is not None:
+        from sharetrade_tpu.obs.tsdb import (FLEET_HISTORY_FILE,
+                                             read_history,
+                                             summarize_history)
+        path = os.path.join(args.dir, FLEET_HISTORY_FILE)
+        rows = read_history(path, last_n=max(0, args.history))
+        if not rows:
+            log.error("no telemetry history at %s (the fleet router "
+                      "writes it next to fleet_status.json)", path)
+            return 1
+        print(json.dumps({"path": path,
+                          **summarize_history(rows)}, indent=2))
+        return 0
     if not os.path.isdir(args.dir):
         log.error("no run dir at %s (train with --set obs.enabled=true "
                   "--set obs.dir=%s first)", args.dir, args.dir)
@@ -937,7 +1004,19 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("obs", help="summarize a telemetry run dir")
     p.add_argument("--dir", default="obs",
-                   help="run dir written by a train run with obs.enabled")
+                   help="run dir written by a train run with obs.enabled "
+                        "(for --history: the fleet workdir holding "
+                        "fleet_history.jsonl)")
+    p.add_argument("--trace", default=None, metavar="TRACE_ID",
+                   help="stitch one cross-process trace from the span "
+                        "journals under <dir>/spans ('list' enumerates "
+                        "trace ids)")
+    p.add_argument("--out", default=None,
+                   help="with --trace: write the stitched trace as "
+                        "Perfetto/Chrome trace-event JSON here")
+    p.add_argument("--history", type=int, default=None, metavar="N",
+                   help="summarize the newest N fleet telemetry-history "
+                        "rows (0 = all retained)")
     p.set_defaults(fn=cmd_obs)
 
     args = parser.parse_args(argv)

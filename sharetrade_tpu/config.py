@@ -811,6 +811,28 @@ class ObsConfig:
     # pre-cap behavior, growing without limit on long runs).
     max_metric_points: int = 65536     # per-series ring in MetricsRegistry
     max_timer_history: int = 65536     # StepTimer per-sample history ring
+    # --- Cross-process wire tracing (fleet/; obs/collect.py) ----------
+    # Per-process span journal directory. "" (default) = no span journal
+    # and no trace headers anywhere — the obs.enabled=false zero-artifact
+    # contract extends to the wire. ``cli fleet`` sets it to
+    # <obs.dir>/spans when obs is enabled with the span trace on, and
+    # the EnginePool injects the SAME path into every worker via --set
+    # (workers run with obs.enabled=false so telemetry stays with the
+    # fleet process — the span journal is the one deliberate exception,
+    # keyed per (proc,pid) so writers never contend).
+    span_dir: str = ""
+    # This process's label in span journals and stitched traces
+    # ("client", "fleet", "engine-0", ...; "" = pid-derived fallback).
+    span_proc: str = ""
+    # Span-journal bounds: framed batches per segment before rotation,
+    # and sealed segments retained per process (oldest pruned).
+    span_journal_records: int = 4096
+    span_journal_segments: int = 8
+    # Fleet telemetry history ring (obs/tsdb.py): router poll rows
+    # retained in <obs.dir>/fleet_history.jsonl for ``cli obs
+    # --history`` — the last-N-windows substrate the fleet autoscaler
+    # (ROADMAP item 3) will read.
+    history_rows: int = 2048
 
 
 @dataclass

@@ -164,6 +164,15 @@ def segment_paths(path: str) -> list[str]:
     return [os.path.join(directory, n) for n in names]
 
 
+def frame_record(payload: bytes) -> bytes:
+    """One CRC-framed record (``[u32 length][u32 crc32][payload]``) as
+    bytes — the single write-side definition of the frame, shared by the
+    full-file writers here, the :class:`Journal` appender, and lightweight
+    append-only logs elsewhere (the obs/ span journals) so every framed
+    file in the tree replays through :func:`iter_framed_records`."""
+    return _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+
+
 def write_framed_bytes(path: str, payloads: list[bytes]) -> None:
     """Write raw payloads as a complete framed log at ``path`` (fsynced).
 
@@ -172,7 +181,7 @@ def write_framed_bytes(path: str, payloads: list[bytes]) -> None:
     between the Python and C++ implementations."""
     with open(path, "wb") as f:
         for payload in payloads:
-            f.write(_HEADER.pack(len(payload), zlib.crc32(payload)) + payload)
+            f.write(frame_record(payload))
         f.flush()
         os.fsync(f.fileno())
 

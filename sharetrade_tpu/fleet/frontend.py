@@ -61,11 +61,27 @@ class EngineBackend:
     """The local-engine backend: one blocking wire request ↔ one
     ``engine.submit`` + ``handle.wait`` (threaded backend), or one
     ``submit_async`` parked on the engine's completion callback
-    (evloop backend) — identical validation and result payloads."""
+    (evloop backend) — identical validation and result payloads.
 
-    def __init__(self, engine, *, request_timeout_s: float = 30.0):
+    When a span sink is wired (``spans=``), a traced request leaves two
+    kinds of evidence in THIS process's span journal, both parented
+    directly under the router's relay-attempt span from the wire headers
+    (never under an engine-local span — obs/collect.py's SIGKILL-orphan
+    rule): an ``engine_recv`` instant flushed EAGERLY at arrival (the
+    page cache survives a SIGKILL, so a killed engine still proves the
+    request reached it) and, at completion, an ``engine_request``
+    envelope with stage children cut from the request's lifecycle
+    stamps (queue_wait/batch_wait/device/readback)."""
+
+    #: Frontends pass the parsed wire trace context (``tctx``) only to
+    #: backends that declare it — test stubs never see the kwarg.
+    wire_traced = True
+
+    def __init__(self, engine, *, request_timeout_s: float = 30.0,
+                 spans=None):
         self.engine = engine
         self.request_timeout_s = float(request_timeout_s)
+        self.spans = spans
 
     @staticmethod
     def validate_obs(obs) -> np.ndarray:
@@ -90,11 +106,53 @@ class EngineBackend:
             "stages": result.stages,
         }
 
-    def serve_request(self, session: str, obs,
-                      deadline_ms: float | None) -> dict:
-        obs = self.validate_obs(obs)
-        handle = self.engine.submit(session, obs,
+    def trace_recv(self, tctx) -> None:
+        """Journal the eager ``engine_recv`` instant (class docstring);
+        a no-op without a sink or trace context."""
+        if tctx is None or self.spans is None:
+            return
+        trace_id, parent, own, _t0 = tctx
+        self.spans.instant(trace_id, self.spans.new_span_id(),
+                           own or parent, "engine_recv", flush=True)
+
+    def trace_complete(self, tctx, handle) -> None:
+        """Journal the ``engine_request`` envelope + stage children from
+        the handle's lifecycle stamps. The threaded path calls this from
+        :meth:`serve_request`; the evloop front-end calls it from its
+        completion handler (the async path has no blocking wait to hang
+        it on)."""
+        if tctx is None or self.spans is None:
+            return
+        trace_id, parent, own, _t0 = tctx
+        tr = handle.trace
+        t_end = tr.t_done or tr.t_device or time.perf_counter()
+        env = self.spans.new_span_id()
+        self.spans.span(trace_id, env, own or parent, "engine_request",
+                        tr.t_enq, t_end, note=tr.outcome or "")
+        for name, a, b in (("queue_wait", tr.t_enq, tr.t_collected),
+                           ("batch_wait", tr.t_collected, tr.t_dispatched),
+                           ("device", tr.t_dispatched, tr.t_device),
+                           ("readback", tr.t_device, tr.t_done)):
+            if a is not None and b is not None:
+                self.spans.span(trace_id, self.spans.new_span_id(), env,
+                                name, a, b)
+
+    def _submit(self, session: str, obs, deadline_ms, tctx, callback=None):
+        """Shared enqueue: recv span, submit, thread the trace identity
+        into the request's :class:`RequestTrace` (the ISSUE-17 stitch
+        key the engine's own chrome-trace spans carry)."""
+        self.trace_recv(tctx)
+        handle = self.engine.submit(session, obs, callback=callback,
                                     deadline_ms=deadline_ms or 0.0)
+        if tctx is not None:
+            handle.trace.trace_id = tctx[0]
+            handle.trace.parent_span = tctx[2] or tctx[1]
+        return handle
+
+    def serve_request(self, session: str, obs,
+                      deadline_ms: float | None, tctx=None) -> dict:
+        obs = self.validate_obs(obs)
+        handle = self._submit(session, obs, deadline_ms, tctx)
         # A deadline'd request resolves engine-side well inside
         # deadline + one batch; the no-deadline wait is bounded by the
         # configured front-end budget so a wedged engine surfaces as a
@@ -102,24 +160,26 @@ class EngineBackend:
         timeout = (max(float(deadline_ms) / 1e3 * 4, 5.0) if deadline_ms
                    else self.request_timeout_s)
         result = handle.wait(timeout)
-        if result is None:
-            if handle.error is not None:
-                raise handle.error
-            raise ServeEngineFailed(
-                f"request did not complete within the front-end budget "
-                f"({timeout:.1f}s)")
-        return self.result_dict(result)
+        try:
+            if result is None:
+                if handle.error is not None:
+                    raise handle.error
+                raise ServeEngineFailed(
+                    f"request did not complete within the front-end "
+                    f"budget ({timeout:.1f}s)")
+            return self.result_dict(result)
+        finally:
+            self.trace_complete(tctx, handle)
 
     def submit_async(self, session: str, obs, deadline_ms: float | None,
-                     signal_done):
+                     signal_done, tctx=None):
         """The evloop front-end's dispatch: validate and enqueue, then
         return the request handle WITHOUT waiting — ``signal_done()``
         fires (from the engine's consumer thread) once the handle
         completes; read ``handle.result`` / ``handle.error`` after."""
         obs = self.validate_obs(obs)
-        return self.engine.submit(session, obs,
-                                  callback=lambda _result: signal_done(),
-                                  deadline_ms=deadline_ms or 0.0)
+        return self._submit(session, obs, deadline_ms, tctx,
+                            callback=lambda _result: signal_done())
 
     def health(self) -> dict:
         engine = self.engine
@@ -161,6 +221,7 @@ class _Handler(BaseHTTPRequestHandler):
                content_type: str = "application/json") -> None:
         payload = (body if isinstance(body, bytes)
                    else json.dumps(body).encode())
+        self._last_status = status       # the hop span's outcome note
         try:
             # Rendered by the shared sans-IO builder — byte-identical
             # to the evloop backend's replies (the differential-oracle
@@ -208,6 +269,12 @@ class _Handler(BaseHTTPRequestHandler):
                         {"error": "engine_failed",
                          "detail": "front-end is draining"})
             return
+        # email.message.Message.get is case-insensitive, so the parsed
+        # proto header dict and this stdlib mapping read identically.
+        tracer = fe.tracer
+        tctx = tracer.begin(self.headers) if tracer is not None else None
+        traced = tctx is not None and getattr(fe.backend, "wire_traced",
+                                              False)
         try:
             deadline_raw = self.headers.get(wire.DEADLINE_HEADER)
             proxy = getattr(fe.backend, "proxy_request", None)
@@ -222,7 +289,10 @@ class _Handler(BaseHTTPRequestHandler):
                     self._reply(*wire.error_to_status(exc))
                     return
                 try:
-                    status, reply = proxy(session, raw, deadline_raw)
+                    status, reply = (proxy(session, raw, deadline_raw,
+                                           tctx=tctx) if traced
+                                     else proxy(session, raw,
+                                                deadline_raw))
                 except Exception as exc:    # noqa: BLE001
                     status, reply = wire.error_to_status(exc)
                     if status == 500:
@@ -248,8 +318,11 @@ class _Handler(BaseHTTPRequestHandler):
                     return
             fe.registry.inc("frontend_requests_total")
             try:
-                result = fe.backend.serve_request(session, obs,
-                                                  deadline_ms)
+                result = (fe.backend.serve_request(session, obs,
+                                                   deadline_ms, tctx=tctx)
+                          if traced else
+                          fe.backend.serve_request(session, obs,
+                                                   deadline_ms))
             except Exception as exc:    # noqa: BLE001 — every serving
                 # outcome maps to a wire status; the handler never dies.
                 status, body = wire.error_to_status(exc)
@@ -260,6 +333,9 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._reply(wire.STATUS_OK, result)
         finally:
+            if tctx is not None:
+                tracer.finish(tctx, "frontend",
+                              note=str(getattr(self, "_last_status", "")))
             with fe._inflight_cv:
                 fe._inflight -= 1
                 fe._inflight_cv.notify_all()
@@ -291,9 +367,12 @@ class ThreadedServeFrontend:
     construction for the actual one."""
 
     def __init__(self, backend, registry, *, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, tracer=None):
         self.backend = backend
         self.registry = registry
+        #: Optional :class:`~sharetrade_tpu.fleet.wire.WireTracer` —
+        #: None (the default) means zero trace parsing and zero spans.
+        self.tracer = tracer
         self.draining = False
         self._inflight = 0
         self._inflight_cv = threading.Condition()
@@ -333,17 +412,21 @@ class ThreadedServeFrontend:
 
 
 def ServeFrontend(backend, registry, *, host: str = "127.0.0.1",
-                  port: int = 0, wire_backend: str | None = None):
+                  port: int = 0, wire_backend: str | None = None,
+                  tracer=None):
     """Build a wire front-end — the one construction surface both
     backends share (``FleetConfig.wire_backend`` plumbs through here).
-    ``None`` means the default backend (evloop)."""
+    ``None`` means the default backend (evloop). ``tracer`` (a
+    :class:`~sharetrade_tpu.fleet.wire.WireTracer` or None) switches
+    ISSUE-17 trace propagation on for either backend identically."""
     wire_backend = wire_backend or "evloop"
     if wire_backend == "evloop":
         from sharetrade_tpu.fleet.evloop import EvloopFrontend
-        return EvloopFrontend(backend, registry, host=host, port=port)
+        return EvloopFrontend(backend, registry, host=host, port=port,
+                              tracer=tracer)
     if wire_backend == "threaded":
         return ThreadedServeFrontend(backend, registry, host=host,
-                                     port=port)
+                                     port=port, tracer=tracer)
     raise ValueError(
         f"unknown fleet.wire_backend {wire_backend!r} "
         f"(expected 'evloop' or 'threaded')")

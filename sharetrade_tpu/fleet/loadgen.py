@@ -49,10 +49,15 @@ class WireEngine:
     enforces."""
 
     def __init__(self, host: str, port: int, *, workers: int = 32,
-                 deadline_ms: float = 0.0, timeout_s: float = 30.0):
+                 deadline_ms: float = 0.0, timeout_s: float = 30.0,
+                 sink=None):
         self.host, self.port = host, int(port)
         self.deadline_ms = float(deadline_ms)
         self.timeout_s = float(timeout_s)
+        #: Optional span sink (obs/trace.py SpanSink): every worker's
+        #: FleetClient then mints a trace per request and journals the
+        #: client_submit root span — the soak's stitch anchor.
+        self.sink = sink
         self._q: queue.Queue = queue.Queue()
         #: Outstanding = submitted but not yet completed (queue depth
         #: alone misses items a worker has popped and is mid-request
@@ -81,7 +86,7 @@ class WireEngine:
 
     def _worker(self) -> None:
         client = FleetClient(self.host, self.port,
-                             timeout_s=self.timeout_s)
+                             timeout_s=self.timeout_s, sink=self.sink)
         try:
             while True:
                 item = self._q.get()

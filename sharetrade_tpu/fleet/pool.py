@@ -215,6 +215,16 @@ class EnginePool:
                    "data.journal_dir="
                    + os.path.join(self.dir, f"{handle.engine_id}-data"),
                    "--symbol", self._symbol]
+            span_dir = getattr(self.cfg.obs, "span_dir", "")
+            if span_dir:
+                # ISSUE-17 span journaling: each worker appends wire
+                # spans to its OWN journal in the fleet's shared spans
+                # dir, keyed by engine id (no writer contention — one
+                # file per process). The workers run obs.enabled=false;
+                # the span journal is the one obs artifact deliberately
+                # shared, switched by span_dir alone (obs/__init__.py).
+                cmd += ["--set", f"obs.span_dir={span_dir}",
+                        "--set", f"obs.span_proc=engine-{handle.engine_id}"]
             if self._start:
                 cmd += ["--start", self._start]
             if self._end:

@@ -46,6 +46,41 @@ MAX_BODY_BYTES = 1 << 26
 
 _CRLF2 = b"\r\n\r\n"
 
+#: Distributed-tracing context headers (ISSUE 17) — rendered and parsed
+#: through THIS module only, so both wire backends and the client carry
+#: them identically. ``X-Trace-Id`` names the request's whole journey;
+#: ``X-Parent-Span`` is the SENDING hop's span id, which the receiving
+#: hop parents its own spans under. Replies NEVER echo them (spans are
+#: journaled, not returned), which is what keeps the two backends'
+#: reply streams byte-identical with tracing on or off.
+TRACE_HEADER = "X-Trace-Id"
+PARENT_HEADER = "X-Parent-Span"
+
+#: Characters a trace/span id may contain (hex ids, pid-prefixed
+#: counter ids like ``1a2f.3c``). Anything else on the wire is ignored
+#: rather than propagated — a hop must never relay an id it could not
+#: have minted.
+_ID_CHARS = frozenset("0123456789abcdefABCDEF.-")
+_ID_MAX = 64
+
+
+def _valid_id(value: str) -> bool:
+    return 0 < len(value) <= _ID_MAX and not set(value) - _ID_CHARS
+
+
+def trace_context(headers: dict) -> tuple[str, str] | None:
+    """The inbound trace context of a PARSED message's header dict:
+    ``(trace_id, parent_span)`` — or None when absent/malformed (a bad
+    id is dropped, never relayed). ``parent_span`` may be ``""`` (a
+    trace id minted by a hop with no span of its own)."""
+    trace_id = headers.get("x-trace-id")
+    if not trace_id or not _valid_id(trace_id):
+        return None
+    parent = headers.get("x-parent-span", "")
+    if parent and not _valid_id(parent):
+        parent = ""
+    return trace_id, parent
+
 #: Reason phrases for the statuses the fleet actually speaks (see the
 #: wire.py status table) — anything else renders its bare code.
 REASONS = {

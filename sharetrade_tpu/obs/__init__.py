@@ -53,7 +53,13 @@ from sharetrade_tpu.obs.roofline import (  # noqa: F401
     read_roofline,
     summarize_roofline,
 )
-from sharetrade_tpu.obs.trace import SpanTracer, read_trace  # noqa: F401
+from sharetrade_tpu.obs.trace import (  # noqa: F401
+    SpanJournal,
+    SpanSink,
+    SpanTracer,
+    new_trace_id,
+    read_trace,
+)
 
 FLIGHT_BUNDLE = "flight_recorder.json"
 
@@ -84,10 +90,15 @@ class Obs:
                  exporter: MetricsExporter | None = None,
                  flight: FlightRecorder | None = None,
                  log_handler: RingLogHandler | None = None,
-                 roofline: RooflineCapture | None = None):
+                 roofline: RooflineCapture | None = None,
+                 spans: SpanSink | None = None):
         self.run_dir = run_dir
         self.enabled = run_dir is not None
         self.tracer = tracer if tracer is not None else SpanTracer(None)
+        #: Cross-process wire-span sink (obs.span_dir) — None when wire
+        #: tracing is off; may be live even when ``enabled`` is False
+        #: (fleet engine workers journal spans with the rest of obs off).
+        self.spans = spans
         self.exporter = exporter
         # obs.flight_recorder=false means NO ring feeding and NO bundle —
         # the attribute stays a (never-dumped) recorder so attribute access
@@ -126,6 +137,8 @@ class Obs:
     def flush(self) -> None:
         """Make everything durable without ending the run (terminal loop
         states flush; only Orchestrator.stop()/close() tear down)."""
+        if self.spans is not None:
+            self.spans.flush()
         if not self.enabled:
             return
         self.tracer.flush()
@@ -139,6 +152,8 @@ class Obs:
         if self._closed:
             return
         self._closed = True
+        if self.spans is not None:
+            self.spans.close()
         if self.exporter is not None:
             self.exporter.stop()
         self.tracer.close()
@@ -151,8 +166,25 @@ def build_obs(cfg: Any, registry: Any, *, mesh: Any = None) -> Obs:
     """Construct the run's telemetry from ``cfg.obs``; inert when disabled
     (no directory is created, nothing is opened)."""
     oc = cfg.obs
+
+    def _span_sink() -> SpanSink | None:
+        # Wire-span journal (ISSUE 17): created iff obs.span_dir names a
+        # directory — INDEPENDENT of oc.enabled, because fleet engine
+        # workers run with obs off (telemetry stays with the fleet
+        # process) yet must journal their half of every stitched trace.
+        span_dir = getattr(oc, "span_dir", "")
+        if not span_dir:
+            return None
+        proc = getattr(oc, "span_proc", "") or f"p{os.getpid()}"
+        journal = SpanJournal(
+            span_dir, proc,
+            max_records=getattr(oc, "span_journal_records", 4096),
+            max_segments=getattr(oc, "span_journal_segments", 8))
+        return SpanSink(journal)
+
     if not oc.enabled:
-        return Obs()
+        spans = _span_sink()
+        return Obs(spans=spans) if spans is not None else Obs()
     run_dir = oc.dir
     os.makedirs(run_dir, exist_ok=True)
     write_manifest(os.path.join(run_dir, "manifest.json"), cfg, mesh=mesh)
@@ -176,7 +208,8 @@ def build_obs(cfg: Any, registry: Any, *, mesh: Any = None) -> Obs:
             registry, run_dir,
             flight_record=flight.record if flight is not None else None)
     return Obs(run_dir=run_dir, tracer=tracer, exporter=exporter,
-               flight=flight, log_handler=log_handler, roofline=roofline)
+               flight=flight, log_handler=log_handler, roofline=roofline,
+               spans=_span_sink())
 
 
 def summarize_run_dir(run_dir: str) -> dict:

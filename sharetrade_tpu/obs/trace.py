@@ -23,10 +23,12 @@ contract — zero files, near-zero cost).
 from __future__ import annotations
 
 import contextlib
+import itertools
 import json
 import os
 import threading
 import time
+from collections import deque
 from typing import Any
 
 _NULL_CTX = contextlib.nullcontext()
@@ -144,6 +146,175 @@ class SpanTracer:
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None
+
+
+# ---------------------------------------------------------------------------
+# Cross-process wire spans (ISSUE 17): every fleet process journals its
+# finished spans to a bounded per-process CRC-framed file; obs/collect.py
+# stitches them by trace id into one Perfetto trace. Timestamps are raw
+# ``time.perf_counter()`` floats — processes do NOT share that clock, so
+# each journal records a monotonic→epoch anchor the collector aligns with.
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex-char trace id (random, collision-free in practice);
+    minted once per inbound request at whichever hop first finds no
+    ``X-Trace-Id`` header (the client when traced, else the frontend)."""
+    return os.urandom(8).hex()
+
+
+class SpanJournal:
+    """Bounded per-process span journal: CRC-framed batches, segment
+    rotation, oldest-first pruning — the data/journal.py frame (ONE
+    framing definition; every file replays through
+    ``iter_framed_records``) without its writer-lock/fsync weight: span
+    files are keyed by (process label, pid) so two writers can never
+    share one, and spans are telemetry — a torn tail loses at most the
+    last unflushed batch, never correctness.
+
+    Clock contract (the correctness core the collector leans on): at
+    open, ONE ``(epoch=time.time(), mono=time.perf_counter())`` pair is
+    captured — the tightest of several samples, so the pairing error is
+    bounded by the narrowest observed sampling window — and a clock line
+    carrying it leads EVERY flushed batch payload. Each record is
+    therefore self-describing: segment pruning or a torn tail can never
+    orphan spans from their alignment offset."""
+
+    def __init__(self, directory: str, proc: str, *,
+                 max_records: int = 4096, max_segments: int = 8):
+        self.dir = directory
+        self.proc = proc
+        self.pid = os.getpid()
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory,
+                                 f"spans-{proc}-{self.pid}.journal")
+        best = None
+        for _ in range(5):
+            a = time.perf_counter()
+            epoch = time.time()
+            b = time.perf_counter()
+            if best is None or (b - a) < best[2]:
+                best = (epoch, (a + b) / 2.0, b - a)
+        self.epoch, self.mono = best[0], best[1]
+        self._clock_line = json.dumps(
+            {"clock": 1, "proc": proc, "pid": self.pid,
+             "epoch": self.epoch, "mono": self.mono},
+            separators=(",", ":")).encode()
+        self._max_records = max(1, int(max_records))
+        self._max_segments = max(1, int(max_segments))
+        self._records = 0
+        self._lock = threading.Lock()
+        self._fh = open(self.path, "ab")
+
+    def append_batch(self, lines: list[bytes]) -> None:
+        """Append ONE framed record: the clock line plus ``lines``
+        (newline-joined pre-serialized span events). Flushed to the OS
+        immediately — the page cache survives a SIGKILLed writer, which
+        is what lets a dead engine's ingress spans reach the stitched
+        trace of a migrated request."""
+        from sharetrade_tpu.data.journal import frame_record
+        payload = b"\n".join([self._clock_line, *lines])
+        record = frame_record(payload)
+        with self._lock:
+            if self._fh is None:
+                return
+            self._fh.write(record)
+            self._fh.flush()
+            self._records += 1
+            if self._records >= self._max_records:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        from sharetrade_tpu.data.journal import segment_paths
+        self._fh.close()
+        existing = segment_paths(self.path)
+        last = int(existing[-1].rsplit(".seg", 1)[1]) if existing else 0
+        os.rename(self.path, f"{self.path}.seg{last + 1:08d}")
+        for stale in segment_paths(self.path)[:-self._max_segments]:
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+        self._fh = open(self.path, "ab")
+        self._records = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+class SpanSink:
+    """Hot-path wire-span buffer: one tuple append per finished span into
+    a BOUNDED ring, serialization deferred to the batched flush (one
+    ``json.dumps`` per span at flush cadence, one framed journal append
+    per batch) — the emission discipline tools/lint_hot_loop.py check 16
+    pins for the evloop runner and router relay closures. Overflow drops
+    the oldest spans (counted in ``dropped``) instead of growing."""
+
+    def __init__(self, journal: SpanJournal, *, capacity: int = 8192,
+                 flush_every: int = 128):
+        self._journal = journal
+        self._flush_every = max(1, int(flush_every))
+        # trace-buffer-ok: bounded ring (maxlen); overflow counted, not grown
+        self._buf: deque = deque(maxlen=max(self._flush_every,
+                                            int(capacity)))
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._id_prefix = f"{journal.pid:x}"
+        self.proc = journal.proc
+        self.dropped = 0
+
+    def new_span_id(self) -> str:
+        """Pid-prefixed counter hex — unique across processes without
+        per-span entropy syscalls."""
+        return f"{self._id_prefix}.{next(self._ids):x}"
+
+    def span(self, trace_id: str, span_id: str, parent: str, name: str,
+             t0: float, t1: float | None, note: str = "") -> None:
+        """Record one finished span (``t0``/``t1`` on this process's
+        ``perf_counter`` clock; ``t1=None`` = instant event)."""
+        with self._lock:
+            buf = self._buf
+            if len(buf) == buf.maxlen:
+                self.dropped += 1
+            buf.append((trace_id, span_id, parent, name, t0, t1, note))
+            if len(buf) >= self._flush_every:
+                self._flush_locked()
+
+    def instant(self, trace_id: str, span_id: str, parent: str, name: str,
+                note: str = "", *, flush: bool = False) -> None:
+        """Zero-duration marker at now; ``flush=True`` makes it DURABLE
+        before returning (the engine-ingress eager flush: a SIGKILLed
+        engine must still leave trace evidence for in-flight requests)."""
+        self.span(trace_id, span_id, parent, name,
+                  time.perf_counter(), None, note)
+        if flush:
+            self.flush()
+
+    def _flush_locked(self) -> None:
+        if not self._buf:
+            return
+        lines = []
+        for trace_id, span_id, parent, name, t0, t1, note in self._buf:
+            ev: dict = {"trace": trace_id, "span": span_id,
+                        "parent": parent, "name": name, "t0": t0}
+            if t1 is not None:
+                ev["t1"] = t1
+            if note:
+                ev["note"] = note
+            lines.append(json.dumps(ev, separators=(",", ":")).encode())
+        self._buf.clear()
+        self._journal.append_batch(lines)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def close(self) -> None:
+        self.flush()
+        self._journal.close()
 
 
 def read_trace(path: str) -> list[dict]:

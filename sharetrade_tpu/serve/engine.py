@@ -224,7 +224,8 @@ class RequestTrace:
     dispatched)."""
 
     __slots__ = ("rid", "t_enq", "t_collected", "t_dispatched", "t_device",
-                 "t_done", "deferrals", "cold", "batch", "outcome")
+                 "t_done", "deferrals", "cold", "batch", "outcome",
+                 "trace_id", "parent_span")
 
     def __init__(self, rid: int, t_enq: float):
         self.rid = rid
@@ -237,6 +238,12 @@ class RequestTrace:
         self.cold = False           # served through the batched prefill
         self.batch: int | None = None   # dispatch tick serial
         self.outcome: str | None = None
+        #: Fleet-wide trace identity (ISSUE 17): set by the wire backend
+        #: (fleet/frontend.py) when the request arrived with trace
+        #: headers, None for local/untraced submits — stitches this
+        #: engine's chrome-trace spans to the cross-process trace.
+        self.trace_id: str | None = None
+        self.parent_span: str | None = None
 
 
 class ServeResult(NamedTuple):
@@ -818,6 +825,11 @@ class ServeEngine:
         own = lines is None
         if own:
             lines = []
+        # The fleet trace id rides along when the wire set one, so a
+        # per-engine chrome trace cross-references the stitched
+        # cross-process trace (obs/collect.py) by id.
+        fleet = (f',"trace":"{tr.trace_id}"'
+                 if tr.trace_id is not None else "")
         lines.append(
             f'{{"name":"serve_request","cat":"serve","ph":"X",'
             f'"ts":{ts0:.3f},"dur":{to_us(t_end) - ts0:.3f},'
@@ -825,7 +837,7 @@ class ServeEngine:
             f'"session":{session},"outcome":"{outcome}",'
             f'"batch":{tr.batch if tr.batch is not None else 0},'
             f'"cold":{"true" if tr.cold else "false"},'
-            f'"deferrals":{tr.deferrals}}}}}')
+            f'"deferrals":{tr.deferrals}{fleet}}}}}')
         for name, t0, t1 in (("queue_wait", tr.t_enq, tr.t_collected),
                              ("batch_wait", tr.t_collected,
                               tr.t_dispatched),

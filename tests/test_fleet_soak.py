@@ -44,6 +44,15 @@ class TestQuickSoak:
         # Live merged-histogram SLO gauges.
         assert summary["fleet_slo"]["merged"]["count"] > 0
         assert summary["drain_rc"] == 75
+        # Stitched kill forensics: one CLEAN trace spans the killed
+        # engine (eagerly-flushed ingress marker), a survivor, the
+        # client's root span, and the router's migrate-annotated relay
+        # attempt (run_soak raises unless all of that held).
+        tr = summary["tracing"]
+        assert tr["migrated_traces"] >= 1
+        assert len(tr["witness"]["engines"]) >= 2
+        assert "client" in tr["witness"]["procs"]
+        assert "fleet" in tr["witness"]["procs"]
 
 
 @pytest.mark.slow

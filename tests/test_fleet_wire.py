@@ -19,6 +19,12 @@ The load-bearing contracts:
 - **Non-blocking discipline is linted**: check 15 keeps blocking socket
   idioms and per-connection threads out of the evloop path, and keeps
   fleet/proto.py free of I/O imports entirely.
+- **Trace headers ride the same frame** (ISSUE 17): ``X-Trace-Id``/
+  ``X-Parent-Span`` canonicalize identically through torn reads, a bad
+  id is dropped rather than relayed, replies NEVER echo trace headers —
+  so the differential oracle holds byte-identically with tracing on AND
+  off — and check 16 keeps span emission on the evloop/router hot path
+  a bounded buffered append.
 """
 
 from __future__ import annotations
@@ -52,6 +58,10 @@ def _request_corpus() -> list[bytes]:
         proto.render_request("POST", wire.SUBMIT_PATH, "h:1",
                              b"\x00binary body\xff",
                              headers={"Connection": "close"}),
+        proto.render_request("POST", wire.SUBMIT_PATH, "h:1",
+                             submit.encode(),
+                             headers={proto.TRACE_HEADER: "ab12cd34ef56ab78",
+                                      proto.PARENT_HEADER: "1f.2"}),
         proto.render_request("GET", wire.METRICS_PATH, "h:1"),
     ]
 
@@ -170,6 +180,61 @@ class TestSansIOParsers:
                        b"X-Deadline-Ms: 9\r\n\r\n{}")
 
 
+class TestTraceHeaders:
+    """X-Trace-Id / X-Parent-Span canonicalization (ISSUE 17): ONE
+    framing definition, bad ids dropped rather than relayed."""
+
+    def test_roundtrip_through_the_parser(self):
+        raw = proto.render_request(
+            "POST", wire.SUBMIT_PATH, "h:1", b"{}",
+            headers={proto.TRACE_HEADER: "DEADbeef00112233",
+                     proto.PARENT_HEADER: "abc.1f"})
+        req = proto.RequestParser().feed(raw)[0]
+        assert proto.trace_context(req.headers) == \
+            ("DEADbeef00112233", "abc.1f")
+        # Torn at every offset: the context survives identically.
+        for split in range(1, len(raw)):
+            p = proto.RequestParser()
+            got = p.feed(raw[:split]) + p.feed(raw[split:])
+            assert proto.trace_context(got[0].headers) == \
+                ("DEADbeef00112233", "abc.1f"), split
+
+    def test_absent_context_is_none(self):
+        req = proto.RequestParser().feed(
+            proto.render_request("GET", wire.HEALTH_PATH, "h:1"))[0]
+        assert proto.trace_context(req.headers) is None
+
+    @pytest.mark.parametrize("trace_id", [
+        "", "zz99", "a" * 65, "ab cd", "ab\tcd", "<script>"])
+    def test_invalid_trace_id_never_relayed(self, trace_id):
+        assert proto.trace_context({"x-trace-id": trace_id}) is None
+
+    def test_invalid_parent_dropped_trace_kept(self):
+        assert proto.trace_context(
+            {"x-trace-id": "ab12", "x-parent-span": "not~valid"}) \
+            == ("ab12", "")
+        assert proto.trace_context(
+            {"x-trace-id": "ab12", "x-parent-span": "f" * 65}) \
+            == ("ab12", "")
+
+    def test_stdlib_message_headers_resolve_case_insensitively(self):
+        # The threaded front-end hands trace_context an
+        # email.message.Message (BaseHTTPRequestHandler.headers) whose
+        # .get is case-insensitive — same answer as the parsed dict.
+        from email.message import Message
+        msg = Message()
+        msg["X-Trace-Id"] = "ab12cd34"
+        msg["X-Parent-Span"] = "3.c"
+        assert proto.trace_context(msg) == ("ab12cd34", "3.c")
+
+    def test_replies_never_carry_trace_headers(self):
+        raw = proto.render_response(
+            200, b"{}", extra_headers={"X-Probe": "1"})
+        resp = proto.ResponseParser().feed(raw)[0]
+        assert "x-trace-id" not in resp.headers
+        assert "x-parent-span" not in resp.headers
+
+
 # ---- the differential oracle ---------------------------------------
 
 
@@ -251,6 +316,90 @@ class TestDifferentialOracle:
             finally:
                 fe.stop()
         assert streams["threaded"] == streams["evloop"]
+
+    def test_byte_identity_holds_with_tracing_on_and_off(self, tmp_path):
+        """ISSUE 17 acceptance: replies never echo trace headers, so
+        turning tracing ON (frontend mints + journals spans, requests
+        may carry inbound context) changes ZERO reply bytes on either
+        backend — all four (backend x tracing) streams are identical.
+        StubBackend has no ``wire_traced`` attr, so the front-ends must
+        also never hand it a tctx kwarg (that inversion would 500)."""
+        from sharetrade_tpu.fleet.wire import WireTracer
+        from sharetrade_tpu.obs import collect
+        from sharetrade_tpu.obs.trace import SpanJournal, SpanSink
+
+        payload, n = _scripted_stream()
+        traced_req = proto.render_request(
+            "POST", wire.SUBMIT_PATH, "h:1",
+            json.dumps({"session": "d-1", "obs": [1.0, 2.0, 3.0]}).encode(),
+            headers={proto.TRACE_HEADER: "ab12cd34ef56ab78",
+                     proto.PARENT_HEADER: "1f.2"})
+        payload = traced_req + payload
+        n += 1
+        streams: dict = {}
+        for mode in ("off", "on"):
+            for backend in ("threaded", "evloop"):
+                sink = tracer = None
+                if mode == "on":
+                    sink = SpanSink(SpanJournal(
+                        str(tmp_path / f"spans-{backend}"), "fleet"))
+                    tracer = WireTracer(sink, mint=True)
+                fe = ServeFrontend(StubBackend(), MetricsRegistry(),
+                                   wire_backend=backend,
+                                   tracer=tracer).start()
+                try:
+                    streams[(mode, backend)] = _drive(
+                        fe.host, fe.port, payload, n)
+                finally:
+                    fe.stop()
+                    if sink is not None:
+                        sink.close()
+        assert len(set(streams.values())) == 1
+        # ...and tracing-on actually journaled: every POST got a
+        # frontend hop span on both backends (the evloop additionally
+        # traces GETs); the inbound context threads through intact
+        # while untraced requests were minted fresh unique ids.
+        posts = sum(1 for r in proto.RequestParser().feed(payload)
+                    if r.method == "POST"
+                    and r.target == wire.SUBMIT_PATH)
+        for backend in ("threaded", "evloop"):
+            spans = collect.read_span_dir(
+                str(tmp_path / f"spans-{backend}"))
+            fronts = [s for s in spans if s["name"] == "frontend"]
+            assert len(fronts) >= posts
+            assert len({s["span"] for s in fronts}) == len(fronts)
+            assert len({s["trace"] for s in fronts}) == len(fronts)
+            inbound = [s for s in fronts
+                       if s["trace"] == "ab12cd34ef56ab78"]
+            assert len(inbound) == 1 and inbound[0]["parent"] == "1f.2"
+
+    def test_tracing_off_emits_zero_headers_and_files(self, tmp_path):
+        """obs.enabled=false default: no tracer → the backend sees no
+        trace context even when the CLIENT sends headers, and nothing
+        span-shaped is ever written."""
+        seen: list = []
+
+        class Recorder(StubBackend):
+            wire_traced = True
+
+            def serve_request(self, session, obs, deadline_ms,
+                              tctx=None):
+                seen.append(tctx)
+                return super().serve_request(session, obs, deadline_ms)
+
+        payload = proto.render_request(
+            "POST", wire.SUBMIT_PATH, "h:1",
+            json.dumps({"session": "d-1", "obs": [1.0]}).encode(),
+            headers={proto.TRACE_HEADER: "ab12cd34ef56ab78"})
+        for backend in ("threaded", "evloop"):
+            fe = ServeFrontend(Recorder(), MetricsRegistry(),
+                               wire_backend=backend).start()
+            try:
+                _drive(fe.host, fe.port, payload, 1)
+            finally:
+                fe.stop()
+        assert seen == [None, None]
+        assert list(tmp_path.iterdir()) == []
 
     def test_wire_backend_knob(self):
         reg = MetricsRegistry()
@@ -347,3 +496,34 @@ class TestEvloopLint:
         # The real tree is clean (the repo-level invariant).
         real_block, real_imports = lint_hot_loop.lint_evloop_sansio()
         assert real_block == [] and real_imports == []
+
+
+class TestSpanEmissionLint:
+    def test_lint_span_emission_semantics(self, tmp_path):
+        import lint_hot_loop
+        pkg = tmp_path / "pkg"
+        (pkg / "fleet").mkdir(parents=True)
+        (pkg / "fleet" / "evloop.py").write_text(
+            "import json\n"
+            "from collections import deque\n"
+            "def emit(tctx, out):\n"
+            "    line = json.dumps({'span': tctx})\n"   # per-event dumps
+            "    out.append(line)\n"
+            "def build():\n"
+            "    span_buf = []\n"                       # unbounded list
+            "    trace_ring = deque()\n"                # maxlen-less
+            "    other_ring = deque()\n"                # not span-named
+            "    # trace-buffer-ok: drained every flush\n"
+            "    span_ok = []\n"                        # marker-exempt
+            "    spans2 = deque([], 128)\n"             # bounded
+            "    return span_buf, trace_ring, span_ok, spans2\n")
+        (pkg / "fleet" / "router.py").write_text(
+            "import json\n"
+            "def fine(status):\n"
+            "    return json.dumps({'gauges': status})\n")  # no span ctx
+        hits = lint_hot_loop.lint_span_emission(root=pkg)
+        assert [(rel, ln) for rel, ln, _ in hits] == [
+            ("fleet/evloop.py", 4), ("fleet/evloop.py", 7),
+            ("fleet/evloop.py", 8)]
+        # The real tree is clean (the repo-level invariant).
+        assert lint_hot_loop.lint_span_emission() == []

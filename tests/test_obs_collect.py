@@ -1,0 +1,309 @@
+"""Distributed-trace collection (obs/trace.py SpanJournal/SpanSink,
+obs/collect.py, obs/tsdb.py — ISSUE 17): the correctness core of the
+stitched cross-process trace.
+
+The contracts pinned here:
+
+- **Clock alignment** — each journal RECORD leads with its process's own
+  ``(epoch, mono)`` anchor; the collector maps every span onto one
+  epoch-microsecond timeline, so spans from processes whose
+  ``perf_counter`` origins differ by SECONDS still nest correctly (and
+  without the offset they provably would not).
+- **Stitch verification** — unresolved parent ids and child intervals
+  escaping their parent beyond :data:`NEST_SLACK_US` are REPORTED as
+  errors (never silently dropped); spans parented under instants are
+  exempt from the nesting check (a SIGKILLed engine leaves instants).
+- **Bounded journals** — rotation at ``max_records``, oldest-segment
+  pruning at ``max_segments``, and a torn tail loses only the torn
+  record, never alignment (each record is self-describing).
+- **Telemetry history ring** — TsdbRing keeps at most ``max_rows`` rows
+  across an atomic compaction; readers tolerate a torn tail row.
+- **Off by default** — obs.enabled=false with no span_dir builds an Obs
+  with ``spans is None`` and writes NOTHING; span_dir alone (the engine-
+  worker spelling) journals spans with the rest of obs still off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from sharetrade_tpu.obs import build_obs, collect, read_trace
+from sharetrade_tpu.obs.trace import SpanJournal, SpanSink
+from sharetrade_tpu.obs.tsdb import (
+    TsdbRing,
+    read_history,
+    summarize_history,
+)
+from sharetrade_tpu.utils.metrics import MetricsRegistry
+
+
+def make_sink(spans_dir, proc: str, *, epoch: float, mono: float,
+              **journal_kw) -> SpanSink:
+    """A SpanSink whose journal carries a CONTROLLED clock anchor, so
+    tests can model processes with wildly different perf_counter
+    origins (the real anchor is sampled; determinism needs an override)."""
+    journal = SpanJournal(str(spans_dir), proc, **journal_kw)
+    journal.epoch, journal.mono = epoch, mono
+    journal._clock_line = json.dumps(
+        {"clock": 1, "proc": proc, "pid": journal.pid,
+         "epoch": epoch, "mono": mono}, separators=(",", ":")).encode()
+    return SpanSink(journal)
+
+
+class TestClockAlignment:
+    def test_spans_align_across_disjoint_monotonic_clocks(self, tmp_path):
+        # Proc a: mono origin 0; proc b: its perf_counter reads 5 s LESS
+        # for the same wall instant (anchor mono=-5 at epoch 1000). Raw
+        # t0s differ by ~5 s, yet the stitched intervals must nest: the
+        # collector maps t0 -> epoch + (t0 - mono).
+        a = make_sink(tmp_path, "fleet", epoch=1000.0, mono=0.0)
+        b = make_sink(tmp_path, "engine-e0", epoch=1000.0, mono=-5.0)
+        a.span("t1", "a.1", "", "relay", 100.0, 100.5)
+        b.span("t1", "b.1", "a.1", "engine_request", 95.1, 95.3)
+        a.close()
+        b.close()
+        spans = collect.read_span_dir(str(tmp_path))
+        by_id = {s["span"]: s for s in spans}
+        assert by_id["a.1"]["ts_us"] == (1000.0 + 100.0) * 1e6
+        assert by_id["b.1"]["ts_us"] == (1000.0 + 95.1 - (-5.0)) * 1e6
+        stitched = collect.stitch(spans, "t1")
+        assert stitched["errors"] == []
+        assert stitched["procs"] == ["engine-e0", "fleet"]
+        # The offset is load-bearing: ignoring it, b.1 would sit ~5 s
+        # outside its parent's 500 ms window.
+        assert abs((95.1 - 100.0) * 1e6) > collect.NEST_SLACK_US
+
+    def test_anchor_rides_every_record_not_just_the_first(self, tmp_path):
+        # Two separate flushes = two framed records; both must carry the
+        # anchor, so pruning record 1 can never misalign record 2.
+        sink = make_sink(tmp_path, "fleet", epoch=50.0, mono=10.0)
+        sink.span("t1", "a.1", "", "first", 11.0, 11.1)
+        sink.flush()
+        sink.span("t1", "a.2", "a.1", "second", 11.02, 11.05)
+        sink.close()
+        path = sink._journal.path
+        from sharetrade_tpu.data.journal import iter_framed_records
+        records = [payload for _off, payload in
+                   iter_framed_records(path, warn=False)]
+        assert len(records) == 2
+        for payload in records:
+            clock = json.loads(payload.split(b"\n")[0])
+            assert (clock["epoch"], clock["mono"]) == (50.0, 10.0)
+
+
+class TestStitchVerification:
+    def _spans(self, tmp_path, triples) -> list:
+        sink = make_sink(tmp_path, "fleet", epoch=0.0, mono=0.0)
+        for span_id, parent, name, t0, t1 in triples:
+            sink.span("t1", span_id, parent, name, t0, t1)
+        sink.close()
+        return collect.read_span_dir(str(tmp_path))
+
+    def test_unresolved_parent_is_reported(self, tmp_path):
+        spans = self._spans(tmp_path, [
+            ("a.1", "", "relay", 1.0, 2.0),
+            ("a.2", "ghost", "engine_recv", 1.1, None)])
+        errors = collect.stitch(spans, "t1")["errors"]
+        assert len(errors) == 1
+        assert "parent ghost unresolved" in errors[0]
+
+    def test_child_escaping_parent_is_reported(self, tmp_path):
+        spans = self._spans(tmp_path, [
+            ("a.1", "", "relay", 1.0, 1.1),
+            ("a.2", "a.1", "late", 2.0, 2.1)])      # ~1 s outside
+        errors = collect.stitch(spans, "t1")["errors"]
+        assert len(errors) == 1 and "escapes parent" in errors[0]
+
+    def test_nesting_within_slack_is_clean(self, tmp_path):
+        slack_s = collect.NEST_SLACK_US / 1e6
+        spans = self._spans(tmp_path, [
+            ("a.1", "", "relay", 1.0, 1.1),
+            ("a.2", "a.1", "edge", 1.0 - slack_s / 2,
+             1.1 + slack_s / 2)])
+        assert collect.stitch(spans, "t1")["errors"] == []
+
+    def test_instant_parents_are_never_nest_checked(self, tmp_path):
+        # engine_recv is an instant (no dur); a SIGKILLed engine leaves
+        # exactly these — children under them must not be flagged.
+        spans = self._spans(tmp_path, [
+            ("a.1", "", "engine_recv", 1.0, None),
+            ("a.2", "a.1", "engine_request", 5.0, 6.0)])
+        assert collect.stitch(spans, "t1")["errors"] == []
+
+    def test_trace_ids_ordered_by_first_timestamp(self, tmp_path):
+        spans = self._spans(tmp_path, [
+            ("a.1", "", "relay", 10.0, 11.0),
+            ("a.2", "", "relay", 2.0, 3.0),
+            ("a.3", "", "relay", 2.5, 3.5)])
+        spans[0]["trace"] = "late"
+        spans[1]["trace"] = "early"
+        spans[2]["trace"] = "early"
+        assert collect.trace_ids(spans) == {"early": 2, "late": 1}
+
+    def test_migrated_traces_key_on_the_migrate_annotation(self, tmp_path):
+        sink = make_sink(tmp_path, "fleet", epoch=0.0, mono=0.0)
+        e0 = make_sink(tmp_path, "engine-e0", epoch=0.0, mono=0.0)
+        e1 = make_sink(tmp_path, "engine-e1", epoch=0.0, mono=0.0)
+        # Trace "mig": first attempt dies on e0, migrates to e1.
+        sink.span("mig", "f.1", "", "relay", 1.0, 2.0, note="migrated")
+        sink.span("mig", "f.2", "f.1", "relay_attempt", 1.0, 1.4,
+                  note="first conn reset")
+        sink.span("mig", "f.3", "f.1", "relay_attempt", 1.4, 1.9,
+                  note="migrate:conn reset status 200")
+        e0.span("mig", "e0.1", "f.2", "engine_recv", 1.1, None)
+        e1.span("mig", "e1.1", "f.3", "engine_recv", 1.5, None)
+        # Trace "ok": plain single-attempt success — not migrated.
+        sink.span("ok", "f.4", "", "relay", 3.0, 3.2)
+        sink.span("ok", "f.5", "f.4", "relay_attempt", 3.0, 3.2,
+                  note="first status 200")
+        for s in (sink, e0, e1):
+            s.close()
+        spans = collect.read_span_dir(str(tmp_path))
+        migrated = collect.migrated_traces(spans)
+        assert [t["trace_id"] for t in migrated] == ["mig"]
+        assert migrated[0]["engines"] == ["engine-e0", "engine-e1"]
+        assert migrated[0]["errors"] == []
+
+    def test_write_perfetto_rendering(self, tmp_path):
+        spans = self._spans(tmp_path, [
+            ("a.1", "", "relay", 1.0, 2.0),
+            ("a.2", "a.1", "engine_recv", 1.5, None)])
+        out = str(tmp_path / "trace.json")
+        stitched = collect.collect_trace(str(tmp_path), "t1", out=out)
+        assert stitched["perfetto"] == out
+        events = read_trace(out)    # same array format as obs traces
+        meta = [e for e in events if e.get("ph") == "M"]
+        assert [m["args"]["name"] for m in meta] == ["fleet"]
+        complete = [e for e in events if e.get("ph") == "X"]
+        instants = [e for e in events if e.get("ph") == "i"]
+        assert [e["name"] for e in complete] == ["relay"]
+        assert complete[0]["dur"] == 1e6
+        assert [e["name"] for e in instants] == ["engine_recv"]
+
+    def test_missing_trace_stitches_empty(self, tmp_path):
+        stitched = collect.collect_trace(str(tmp_path), "nope")
+        assert stitched["spans"] == [] and stitched["errors"] == []
+
+
+class TestJournalBounds:
+    def test_rotation_and_oldest_first_pruning(self, tmp_path):
+        sink = make_sink(tmp_path, "fleet", epoch=0.0, mono=0.0,
+                         max_records=2, max_segments=2)
+        for i in range(12):     # one record per flush
+            sink.span("t1", f"a.{i}", "", "step", float(i), i + 0.5)
+            sink.flush()
+        sink.close()
+        names = sorted(os.listdir(tmp_path))
+        segs = [n for n in names if ".seg" in n]
+        assert len(segs) == 2   # pruned down from 6 rotations
+        spans = collect.read_span_dir(str(tmp_path))
+        # Newest survive (2 segments x 2 records); the prune took
+        # whole oldest segments.
+        kept = sorted(int(s["span"].split(".")[1]) for s in spans)
+        assert kept == list(range(8, 12))
+
+    def test_torn_tail_loses_only_the_torn_record(self, tmp_path):
+        sink = make_sink(tmp_path, "fleet", epoch=0.0, mono=0.0)
+        sink.span("t1", "a.1", "", "whole", 1.0, 2.0)
+        sink.flush()
+        sink.span("t1", "a.2", "", "torn", 3.0, 4.0)
+        sink.close()
+        path = sink._journal.path
+        with open(path, "rb") as f:
+            raw = f.read()
+        with open(path, "wb") as f:
+            f.write(raw[:-7])   # tear mid-record
+        spans = collect.read_span_dir(str(tmp_path))
+        assert [s["span"] for s in spans] == ["a.1"]
+
+    def test_sink_ring_is_bounded_and_counts_drops(self, tmp_path):
+        sink = make_sink(tmp_path, "fleet", epoch=0.0, mono=0.0)
+        sink._buf = type(sink._buf)(maxlen=4)
+        sink._flush_every = 100     # never auto-flush: force overflow
+        for i in range(10):
+            sink.span("t1", f"a.{i}", "", "s", float(i), i + 0.1)
+        assert sink.dropped == 6
+        sink.close()
+        spans = collect.read_span_dir(str(tmp_path))
+        assert len(spans) == 4      # the newest ring-ful
+
+
+class TestTsdbRing:
+    def test_bounded_by_atomic_compaction(self, tmp_path):
+        path = str(tmp_path / "hist.jsonl")
+        ring = TsdbRing(path, max_rows=5)
+        for i in range(23):
+            ring.append({"ts": float(i), "fleet_p99_ms": i * 2.0})
+        ring.close()
+        rows = read_history(path)
+        assert len(rows) <= 10      # never past 2x the bound
+        assert rows[-1]["ts"] == 22.0
+        assert all(r["ts"] > 12 for r in rows)  # oldest were compacted
+
+    def test_reopen_counts_existing_rows(self, tmp_path):
+        path = str(tmp_path / "hist.jsonl")
+        ring = TsdbRing(path, max_rows=4)
+        for i in range(3):
+            ring.append({"ts": float(i)})
+        ring.close()
+        ring2 = TsdbRing(path, max_rows=4)      # a restarted router
+        for i in range(3, 10):
+            ring2.append({"ts": float(i)})
+        ring2.close()
+        assert len(read_history(path)) <= 8
+
+    def test_torn_tail_row_is_tolerated(self, tmp_path):
+        path = str(tmp_path / "hist.jsonl")
+        with open(path, "w") as f:
+            f.write('{"ts": 1.0, "fleet_p99_ms": 9.0}\n{"ts": 2.0, "fl')
+        assert read_history(path) == [{"ts": 1.0, "fleet_p99_ms": 9.0}]
+        assert read_history(str(tmp_path / "missing.jsonl")) == []
+
+    def test_summarize_history(self):
+        rows = [{"ts": 10.0, "fleet_p99_ms": 5.0},
+                {"ts": 11.0, "fleet_p99_ms": 9.0},
+                {"ts": 14.0, "fleet_p99_ms": 7.0, "fleet_engines_live": 2}]
+        s = summarize_history(rows)
+        assert s["rows"] == 3 and s["window_s"] == 4.0
+        assert s["fleet_p99_ms"] == {"min": 5.0, "max": 9.0, "last": 7.0}
+        assert s["fleet_engines_live"]["last"] == 2
+        assert summarize_history([]) == {"rows": 0}
+
+    def test_read_history_last_n(self, tmp_path):
+        path = str(tmp_path / "hist.jsonl")
+        ring = TsdbRing(path, max_rows=16)
+        for i in range(6):
+            ring.append({"ts": float(i)})
+        ring.close()
+        assert [r["ts"] for r in read_history(path, last_n=2)] \
+            == [4.0, 5.0]
+
+
+class TestObsGating:
+    def test_disabled_default_builds_nothing(self, tmp_path, monkeypatch):
+        from sharetrade_tpu.config import FrameworkConfig
+        monkeypatch.chdir(tmp_path)
+        cfg = FrameworkConfig()
+        assert cfg.obs.enabled is False and cfg.obs.span_dir == ""
+        obs = build_obs(cfg, MetricsRegistry())
+        assert obs.spans is None and obs.enabled is False
+        obs.close()
+        assert list(tmp_path.iterdir()) == []   # ZERO files
+
+    def test_span_dir_alone_journals_with_obs_off(self, tmp_path):
+        # The fleet engine-worker spelling: obs.enabled stays False
+        # (telemetry lives with the fleet process) but span_dir is
+        # injected so the worker journals its half of every trace.
+        from sharetrade_tpu.config import FrameworkConfig
+        cfg = FrameworkConfig()
+        cfg.obs.span_dir = str(tmp_path / "spans")
+        cfg.obs.span_proc = "engine-e7"
+        obs = build_obs(cfg, MetricsRegistry())
+        assert obs.enabled is False and obs.spans is not None
+        assert obs.spans.proc == "engine-e7"
+        obs.spans.span("t1", obs.spans.new_span_id(), "", "engine_recv",
+                       1.0, 1.5)
+        obs.close()
+        spans = collect.read_span_dir(str(tmp_path / "spans"))
+        assert [s["proc"] for s in spans] == ["engine-e7"]

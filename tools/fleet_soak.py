@@ -34,7 +34,15 @@ asserts after EVERY kill and at the end:
   + fleet_unrouted_total``, and the client's completed+failed matches
   its submissions;
 - **drain** — SIGTERM ends the whole tier with exit 75, engine journals
-  stay CRC-clean through the segmented reader.
+  stay CRC-clean through the segmented reader;
+- **stitched kill forensics** — the client mints a trace per request
+  (span journal under ``<workdir>/obs/spans`` beside the fleet's own),
+  and after the drain at least one MIGRATED request stitches into ONE
+  trace holding spans from BOTH the killed engine (its eagerly-flushed
+  ``engine_recv`` ingress marker survives the SIGKILL) and a survivor,
+  plus the router's ``migrate:``-annotated relay attempt — with zero
+  stitch errors (every parent resolves, intervals nest after clock
+  alignment).
 
 Usage:
     python tools/fleet_soak.py                     # full (~3 engines, >=3 kills)
@@ -156,6 +164,7 @@ class Load:
         from sharetrade_tpu.fleet.flywheel import (
             SessionTransitionJournal, make_journaling_sessions)
         from sharetrade_tpu.fleet.loadgen import WireEngine
+        from sharetrade_tpu.obs.trace import SpanJournal, SpanSink
         prices = np.asarray(
             synthetic_price_series(length=900, seed=7).prices, np.float32)
         self.journal = SessionTransitionJournal(
@@ -163,8 +172,14 @@ class Load:
             obs_dim=OBS_DIM, flush_rows=32)
         self.sessions = make_journaling_sessions(
             prices, WINDOW, sessions, journal=self.journal, seed=7)
+        # The client end of the distributed trace: every load request
+        # mints a trace id and journals its client_submit root span into
+        # the SAME spans dir the fleet processes write (cli fleet points
+        # obs.span_dir at <obs.dir>/spans when tracing is on).
+        self.spans = SpanSink(SpanJournal(
+            os.path.join(workdir, "obs", "spans"), "client"))
         self.engine = WireEngine(host, port, workers=concurrency,
-                                 timeout_s=20.0)
+                                 timeout_s=20.0, sink=self.spans)
         self.concurrency = concurrency
         self.completed = 0
         self.failed = 0
@@ -210,6 +225,7 @@ class Load:
             t.join(timeout=30.0)
         self.engine.stop()
         self.journal.close()
+        self.spans.close()
 
 
 def probe_request(host: str, port: int, sid: str,
@@ -265,6 +281,7 @@ def run_soak(*, engines: int, kills: int, ramp_s: float,
 
         # ---- chaos: whole-engine SIGKILLs mid-load ------------------
         injected = 0
+        victims: list[str] = []
         for k in range(kills):
             pids = live_engine_pids(status_path)
             if len(pids) < 2:
@@ -276,6 +293,7 @@ def run_soak(*, engines: int, kills: int, ramp_s: float,
                    f"(pid {victim_pid})")
             os.kill(victim_pid, signal.SIGKILL)
             injected += 1
+            victims.append(victim_id)
             # Router must answer IMMEDIATELY (survivors absorb).
             out = probe_request(host, port, f"post-kill-{k}")
             if out.get("action") is None:
@@ -404,6 +422,47 @@ def run_soak(*, engines: int, kills: int, ramp_s: float,
             raise SoakError(
                 f"session journal high-water {hw} != rows journaled "
                 f"{rows_journaled}")
+
+        # ---- stitched kill forensics --------------------------------
+        # Every process has now flushed its span journal (client on
+        # load.stop(), fleet + engine workers on the drain; the victim's
+        # ingress markers were eagerly flushed BEFORE it died). At least
+        # one migrated request must stitch into one clean trace spanning
+        # the corpse, a survivor, and the router's annotated migration.
+        from sharetrade_tpu.obs import collect
+        wire_spans = collect.read_span_dir(
+            os.path.join(workdir, "obs", "spans"))
+        if not wire_spans:
+            raise SoakError("no wire spans journaled (tracing is on)")
+        migrated_tr = collect.migrated_traces(wire_spans)
+        if not migrated_tr:
+            raise SoakError(
+                "no stitched trace carries a migrate-annotated relay "
+                f"attempt despite {injected} kill(s)")
+        victim_procs = {f"engine-{v}" for v in victims}
+        witnesses = [
+            t for t in migrated_tr
+            if len(t["engines"]) >= 2 and "client" in t["procs"]
+            and victim_procs & set(t["engines"]) and not t["errors"]]
+        if not witnesses:
+            raise SoakError(
+                "no CLEAN migrated trace spans both the killed engine "
+                "and a survivor; migrated traces: "
+                + json.dumps([{k: t[k] for k in
+                               ("trace_id", "procs", "engines", "errors")}
+                              for t in migrated_tr]))
+        pick = witnesses[0]
+        result["tracing"] = {
+            "wire_spans": len(wire_spans),
+            "traces": len(collect.trace_ids(wire_spans)),
+            "migrated_traces": len(migrated_tr),
+            "witness": {"trace_id": pick["trace_id"],
+                        "procs": pick["procs"],
+                        "engines": pick["engines"],
+                        "spans": len(pick["spans"])},
+        }
+        eprint(f"stitched kill forensics: trace {pick['trace_id']} "
+               f"spans {pick['engines']} through the migration")
         result["ok"] = True
         return result
     finally:

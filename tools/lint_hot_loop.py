@@ -486,6 +486,24 @@ SANSIO_FORBIDDEN_IMPORTS = ("socket", "select", "selectors", "ssl",
                             "http", "socketserver", "asyncio")
 SANSIO_FILE = "fleet/proto.py"
 
+#: Check 16 (the distributed-tracing PR): span emission in the evloop
+#: loop-runner and the router relay path stays a bounded buffered
+#: append. These two files run per-event/per-hop at wire rate; the
+#: SpanSink contract (obs/trace.py) is ONE tuple append into a bounded
+#: ring now, serialization deferred to the batched flush — so (a) no
+#: ``json.dumps`` may appear on a line that also touches span/trace
+#: context (per-event serialization on the hot path), and (b) no
+#: span/trace-named name may be assigned an UNBOUNDED accumulator (a
+#: list literal, ``list()``, or a maxlen-less ``deque``) — span volume
+#: tracks offered load, exactly check 11's leak class on the wire
+#: path. Escape hatch: ``trace-buffer-ok`` (shared with check 11) on
+#: the line or the two above, naming the bound / why serialization is
+#: off the hot path.
+SPAN_EMIT_FILES = ("fleet/evloop.py", "fleet/router.py")
+SPAN_EMIT_DUMPS_PATTERN = re.compile(r"json\.dumps?\s*\(")
+SPAN_EMIT_CTX_PATTERN = re.compile(r"span|tctx|trace", re.IGNORECASE)
+SPAN_NAME_PATTERN = re.compile(r"span|trace", re.IGNORECASE)
+
 
 def lint_hot_loop_syncs() -> tuple[list[tuple[str, int, str]], set[str]]:
     return _scan_named_funcs(HOT_FUNCS, PATTERN, MARKER)
@@ -766,6 +784,70 @@ def lint_evloop_sansio(
                         (SANSIO_FILE, node.lineno,
                          src.splitlines()[node.lineno - 1].strip()))
     return blocking_bad, import_bad
+
+
+def lint_span_emission(
+        root: pathlib.Path | None = None) -> list[tuple[str, int, str]]:
+    """Check 16: in the evloop/router wire path (SPAN_EMIT_FILES), span
+    emission must be a bounded buffered append — no per-event
+    ``json.dumps`` on a span/trace-context line, no unbounded
+    span/trace-named accumulator construction — unless the line (or the
+    two above) carries ``trace-buffer-ok`` naming the bound. Returns
+    (relpath, line, text) hits. ``root`` overrides the scanned package
+    root (tests exercise the semantics on fixtures)."""
+    root = root or TARGET.parent.parent     # sharetrade_tpu/
+    bad: list[tuple[str, int, str]] = []
+    for rel in SPAN_EMIT_FILES:
+        path = pathlib.Path(root) / rel
+        if not path.exists():
+            continue
+        src = path.read_text()
+        lines = src.splitlines()
+
+        def exempt(ln: int) -> bool:
+            return any(TRACE_BUFFER_MARKER in w
+                       for w in lines[max(0, ln - 3):ln])
+
+        for ln, text in enumerate(lines, 1):
+            if text.lstrip().startswith("#"):
+                continue
+            if (SPAN_EMIT_DUMPS_PATTERN.search(text)
+                    and SPAN_EMIT_CTX_PATTERN.search(text)
+                    and not exempt(ln)):
+                bad.append((rel, ln, text.strip()))
+        for node in ast.walk(ast.parse(src)):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            tgts = (node.targets if isinstance(node, ast.Assign)
+                    else [node.target])
+            names = set()
+            for tgt in tgts:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+                elif isinstance(tgt, ast.Attribute):
+                    names.add(tgt.attr)
+            if not any(SPAN_NAME_PATTERN.search(n) for n in names):
+                continue
+            value = node.value
+            unbounded = isinstance(value, ast.List)
+            if isinstance(value, ast.Call):
+                fn = value.func
+                fname = (fn.attr if isinstance(fn, ast.Attribute)
+                         else getattr(fn, "id", None))
+                if fname == "list":
+                    unbounded = True
+                elif fname == "deque":
+                    bound_expr = (
+                        value.args[1] if len(value.args) >= 2
+                        else next((kw.value for kw in value.keywords
+                                   if kw.arg == "maxlen"), None))
+                    unbounded = bound_expr is None or (
+                        isinstance(bound_expr, ast.Constant)
+                        and bound_expr.value in (None, 0))
+            if unbounded and not exempt(node.lineno):
+                bad.append((rel, node.lineno,
+                            lines[node.lineno - 1].strip()))
+    return sorted(bad, key=lambda hit: (hit[0], hit[1]))
 
 
 def lint_dispatcher_blocking() -> tuple[list[tuple[str, int, str]], set[str]]:
@@ -1063,6 +1145,19 @@ def main() -> int:
               "pipelining tests; keep I/O in fleet/evloop.py and "
               "fleet/wire.py")
         return 1
+    span_bad = lint_span_emission()
+    if span_bad:
+        print("span-emission hot-path lint FAILED:")
+        for rel, ln, text in span_bad:
+            print(f"  sharetrade_tpu/{rel}:{ln}: {text}")
+        print("span emission on the evloop/router wire path must be a "
+              "bounded buffered append: one tuple into the SpanSink "
+              "ring now, json.dumps only at the batched flush "
+              "(obs/trace.py), and never an unbounded span list; route "
+              "emission through SpanSink.span/instant, or tag the line "
+              f"(or the two above) '# {TRACE_BUFFER_MARKER}: <the "
+              "bound / why serialization is off the hot path>'")
+        return 1
     dur_bad = lint_durable_replace()
     if dur_bad:
         print("durable-rename fsync lint FAILED:")
@@ -1092,6 +1187,7 @@ def main() -> int:
           f"sharetrade_tpu/{FLEET_NET_DIR}/); "
           f"evloop non-blocking lint OK ({', '.join(EVLOOP_FILES)}); "
           f"sans-IO import lint OK ({SANSIO_FILE}); "
+          f"span-emission lint OK ({', '.join(SPAN_EMIT_FILES)}); "
           f"durable-rename fsync lint OK ({', '.join(DURABLE_WRITE_FILES)})")
     return 0
 

@@ -14,6 +14,12 @@ Two phases, both seconds-scale on CPU:
    exposition format, SLO burn-rate gauges, and the slowest-request
    exemplar artifact — then the same ``cli obs`` summary renders the
    serve block.
+3. **Fleet tracing** (ISSUE 17) — a real 2-engine ``cli fleet``
+   subprocess under traced traffic takes a whole-engine SIGKILL; at
+   least one migrated request must stitch (obs/collect.py) into ONE
+   clean Perfetto trace spanning client → frontend → router relay
+   attempts (migration annotated) → both engines, written as an
+   artifact and rendered again through ``cli obs --trace``.
 
 Wired into ``make check`` so the whole surface (instrumentation → files →
 CLI reader) breaks loudly, not silently.
@@ -84,7 +90,10 @@ def main() -> int:
         rc = serve_demo(d)
         if rc != 0:
             return rc
-        return cli.main(["obs", "--dir", os.path.join(d, "obs-serve")])
+        rc = cli.main(["obs", "--dir", os.path.join(d, "obs-serve")])
+        if rc != 0:
+            return rc
+        return fleet_demo(d)
 
 
 def serve_demo(workdir: str) -> int:
@@ -164,6 +173,96 @@ def serve_demo(workdir: str) -> int:
           f"{slowest[0]['latency_ms']:.2f} ms "
           f"(stages {slowest[0]['stages']})")
     return 0
+
+
+def fleet_demo(workdir: str) -> int:
+    """Phase 3: one stitched distributed trace through a real engine
+    kill — the fleet half of the zero-to-summary loop (ISSUE 17)."""
+    import signal
+    import threading
+    import time
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import fleet_soak
+    from soak_common import launch_cli
+
+    from sharetrade_tpu import cli
+    from sharetrade_tpu.fleet.loadgen import WireEngine
+    from sharetrade_tpu.obs import collect
+    from sharetrade_tpu.obs.trace import SpanJournal, SpanSink
+
+    d = os.path.join(workdir, "fleet-demo")
+    os.makedirs(d, exist_ok=True)
+    cfg_path = fleet_soak.build_config(d, engines=2)
+    log_path = os.path.join(d, "fleet.log")
+    status_path = os.path.join(d, "fleet", "fleet_status.json")
+    proc = launch_cli("fleet", cfg_path, log_path, symbol="MSFT",
+                      extra_args=["--learner", "--engines", "2",
+                                  "--duration", "0"])
+    sink = engine = None
+    try:
+        ready = fleet_soak.wait_ready(proc, log_path, timeout_s=240.0)
+        host, port = ready["host"], ready["port"]
+        # The client end of the trace: journals client_submit root
+        # spans into the SAME spans dir the fleet processes write.
+        sink = SpanSink(SpanJournal(
+            os.path.join(d, "obs", "spans"), "client"))
+        engine = WireEngine(host, port, workers=6, timeout_s=20.0,
+                            sink=sink)
+        rng = np.random.default_rng(0)
+        stop = threading.Event()
+
+        def traffic() -> None:
+            while not stop.is_set():
+                handles = [engine.submit(
+                    f"demo{j}", rng.uniform(1.0, 2.0, fleet_soak.OBS_DIM))
+                    for j in range(8)]
+                for h in handles:
+                    h.wait(25.0)
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        time.sleep(2.0)     # warm sessions, requests in flight
+        pids = fleet_soak.live_engine_pids(status_path)
+        victim_id, victim_pid = sorted(pids.items())[0]
+        os.kill(victim_pid, signal.SIGKILL)
+        print(f"obs-demo[fleet]: SIGKILL engine {victim_id} "
+              f"(pid {victim_pid}) under traced traffic")
+        time.sleep(3.0)     # traffic rides the migration window
+        stop.set()
+        t.join(timeout=60.0)
+        engine.drain(30.0)
+    finally:
+        if engine is not None:
+            engine.stop()
+        if sink is not None:
+            sink.close()
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=120)
+            except Exception:   # noqa: BLE001
+                proc.kill()
+                proc.wait(timeout=30)
+
+    spans = collect.read_span_dir(os.path.join(d, "obs", "spans"))
+    migrated = collect.migrated_traces(spans)
+    if not migrated:
+        print("obs-demo[fleet]: no migrated trace captured through "
+              "the kill")
+        return 1
+    pick = next((tr for tr in migrated if len(tr["engines"]) >= 2),
+                migrated[0])
+    if pick["errors"]:
+        print(f"obs-demo[fleet]: stitch errors {pick['errors']}")
+        return 1
+    out = os.path.join(d, f"trace-{pick['trace_id']}.json")
+    collect.write_perfetto(pick, out)
+    print(f"obs-demo[fleet]: stitched migrated trace "
+          f"{pick['trace_id']} ({len(pick['spans'])} spans across "
+          f"{pick['procs']}) -> {out}")
+    return cli.main(["obs", "--dir", os.path.join(d, "obs"),
+                     "--trace", pick["trace_id"]])
 
 
 if __name__ == "__main__":

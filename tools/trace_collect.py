@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Stitch fleet span journals into per-request Perfetto traces.
+
+The standalone spelling of ``cli obs --trace`` (obs/collect.py does the
+work for both): point it at a spans directory — ``<obs.dir>/spans`` from
+a tracing ``cli fleet`` run, or a soak workdir via ``--dir`` — list the
+trace ids it holds, stitch one, or dump every migrated trace the journals
+contain (the kill-correlation view the fleet soak asserts on).
+
+    python tools/trace_collect.py --spans obs/spans --list
+    python tools/trace_collect.py --spans obs/spans --trace <id> \
+        --out trace.json
+    python tools/trace_collect.py --dir /tmp/soak --migrated
+
+Exit code: 0 when every requested stitch verified clean (parents resolve,
+intervals nest after clock alignment), 1 on stitch errors or nothing
+found — so a soak/CI step can gate on the collector's verdict directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from sharetrade_tpu.obs import collect  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--spans", default=None,
+                    help="spans directory (the journals' home)")
+    ap.add_argument("--dir", default=None,
+                    help="run/soak workdir; reads <dir>/obs/spans")
+    ap.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="stitch this trace")
+    ap.add_argument("--list", action="store_true",
+                    help="enumerate trace ids (span counts)")
+    ap.add_argument("--migrated", action="store_true",
+                    help="stitch every trace whose relay migrated")
+    ap.add_argument("--out", default=None,
+                    help="write the stitched trace as Perfetto JSON "
+                         "(with --migrated: one file per trace id, "
+                         "suffixed)")
+    args = ap.parse_args()
+
+    spans_dir = args.spans or (os.path.join(args.dir, "obs", "spans")
+                               if args.dir else None)
+    if not spans_dir or not os.path.isdir(spans_dir):
+        print(f"no spans directory at {spans_dir!r} (run a fleet with "
+              f"obs.enabled=true)", file=sys.stderr)
+        return 1
+    spans = collect.read_span_dir(spans_dir)
+    if args.list or not (args.trace or args.migrated):
+        print(json.dumps({"spans_dir": spans_dir,
+                          "spans": len(spans),
+                          "traces": collect.trace_ids(spans)}, indent=2))
+        return 0 if spans else 1
+
+    rc = 0
+    if args.trace:
+        stitched = collect.stitch(spans, args.trace)
+        if not stitched["spans"]:
+            print(f"trace {args.trace} not found under {spans_dir}",
+                  file=sys.stderr)
+            return 1
+        if args.out:
+            stitched["perfetto"] = collect.write_perfetto(stitched,
+                                                          args.out)
+        print(json.dumps({k: stitched[k] for k in stitched
+                          if k != "spans"}
+                         | {"spans": len(stitched["spans"])}, indent=2))
+        rc |= bool(stitched["errors"])
+    if args.migrated:
+        migrated = collect.migrated_traces(spans)
+        views = []
+        for stitched in migrated:
+            if args.out:
+                root, ext = os.path.splitext(args.out)
+                stitched["perfetto"] = collect.write_perfetto(
+                    stitched, f"{root}-{stitched['trace_id']}{ext}")
+            views.append({k: stitched[k] for k in stitched
+                          if k != "spans"}
+                         | {"spans": len(stitched["spans"])})
+            rc |= bool(stitched["errors"])
+        print(json.dumps({"migrated_traces": views}, indent=2))
+        if not migrated:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
