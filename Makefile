@@ -8,7 +8,7 @@
 
 PYTHON ?= python
 
-.PHONY: check test slow native bench autotune autotune-quick bench-actor bench-async bench-autotune bench-ckpt bench-dispatch bench-fleet bench-obs bench-router bench-precision bench-replay bench-reshard bench-roofline bench-serve bench-serve-overload actor-soak crash-soak fleet-soak obs-demo lint perf-gate serve-chaos serve-soak shard-audit clean
+.PHONY: check test slow native bench autotune autotune-quick bench-actor bench-async bench-autotune bench-ckpt bench-dispatch bench-fleet bench-obs bench-paging bench-router bench-precision bench-replay bench-reshard bench-roofline bench-serve bench-serve-overload actor-soak crash-soak fleet-soak fleet-soak-autoscale obs-demo lint perf-gate serve-chaos serve-soak shard-audit clean
 
 check: native lint
 	$(PYTHON) -m pytest tests/ -q -m "not slow" -x
@@ -148,6 +148,15 @@ bench-serve-overload:
 	$(PYTHON) -c "import json, bench; \
 	print(json.dumps(bench.bench_serve_overload(), indent=2))"
 
+# Tiered-session-paging capacity ladder (bench.py bench_session_paging):
+# one engine's device arena vs 1x/8x/64x-slots session populations, warm
+# host-RAM tier vs the no-warm cold-re-prefill control — the numbers
+# behind BASELINE.md "Session tiers" and the session_capacity_qps /
+# warm_unpark_ms perf-gate series.
+bench-paging:
+	$(PYTHON) -c "import json, bench; \
+	print(json.dumps(bench.bench_session_paging(), indent=2))"
+
 # Actor/learner disaggregation scaling (distrib/): experience produced
 # (summed actor rollouts) and ingested by the live learner at N in
 # {1,2,4} actor subprocesses vs the single-process train baseline — the
@@ -180,6 +189,15 @@ actor-soak:
 # `make check`.
 fleet-soak:
 	$(PYTHON) tools/fleet_soak.py --engines 3 --kills 3
+
+# Diurnal autoscale profile (tools/fleet_soak.py --autoscale): one
+# cli fleet --autoscale tier through a surge/quiet cycle — membership
+# grows to the ceiling under queueing load and retires back to the
+# floor in silence, zero restart storms, availability burn < 1, clean
+# exit-75 drain. The same profile rides tier-1 via
+# tests/test_fleet_soak.py::TestAutoscaleSoak.
+fleet-soak-autoscale:
+	$(PYTHON) tools/fleet_soak.py --autoscale --ceiling 2
 
 # Fleet scale-out bench (bench.py bench_fleet): single-engine saturation
 # vs N=2/4 engines behind the router, wire-framed, each engine pinned to

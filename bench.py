@@ -1191,6 +1191,180 @@ def bench_serve_overload(*, duration_s: float = 2.5, sessions: int = 2048,
     }
 
 
+def bench_session_paging(*, duration_s: float = 1.5, slots: int = 16,
+                         max_batch: int = 8,
+                         ladder: tuple[int, ...] = (1, 8, 64),
+                         warm_budget_bytes: int = 1 << 29) -> dict:
+    """Tiered-session-paging capacity ladder (ISSUE 18; BASELINE.md
+    "Session tiers"): one engine with ``slots`` device rows serves
+    populations of 1x / 8x / 64x ``slots`` sessions on the EPISODE
+    workload (the stateful K/V-carry model — the warm tier is a no-op
+    for stateless MLP sessions), round-robin open-loop arrivals at half
+    the engine's own all-hot saturation rate, in two arms per rung:
+
+    - **warm**: the host-RAM parked-carry tier (``serve.warm_bytes``)
+      absorbs evictions — a faulting session re-enters through the
+      batched scatter install (bitwise-identical to never having left,
+      tests/test_session_paging.py pins it);
+    - **no_warm** (control): ``warm_bytes=0``, the PR-8 shape — every
+      fault pays a full cold re-prefill through the session journal.
+
+    Gate rows:
+
+    - ``session_capacity_qps`` — the warm arm's achieved QPS at the
+      TOP rung (64x slots). Lower is worse: this is the "population
+      100x the arena" capacity claim, and it collapses if paging ever
+      rides the dispatch thread.
+    - ``warm_unpark_ms`` — end-to-end p50 in a phase where EVERY
+      request pages in from warm (population 2x slots, round-robin, so
+      each arrival faults; primed so the faults are all warm hits).
+      One unpark per request, so this p50 IS the unpark path's cost
+      plus the base step; HIGHER is worse (``*_ms`` inverts the band).
+    """
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import serve_soak
+
+    from sharetrade_tpu.config import ServeConfig
+    from sharetrade_tpu.serve import ServeEngine
+    from sharetrade_tpu.serve.driver import (
+        make_sessions,
+        run_closed_loop,
+        run_open_loop,
+    )
+    from sharetrade_tpu.utils.metrics import MetricsRegistry
+
+    cfg_env = FrameworkConfig()
+    # Envelope provenance: the gated (warm) arm's actual knobs.
+    cfg_env.serve.max_batch = max_batch
+    cfg_env.serve.slots = slots
+    cfg_env.serve.warm_bytes = warm_budget_bytes
+    # window=32 keeps the per-session K/V carry ~128 KiB so the 64x
+    # rung's parked population fits comfortably under the warm budget.
+    model, params, prices, window = serve_soak.build_workload(
+        mlp=False, window=32)
+
+    def build(warm_bytes: int):
+        registry = MetricsRegistry()
+        engine = ServeEngine(
+            model,
+            ServeConfig(max_batch=max_batch, slots=slots,
+                        batch_timeout_ms=cfg_env.serve.batch_timeout_ms,
+                        swap_poll_s=0.0, stats_interval_s=0.5,
+                        max_queue=cfg_env.serve.max_queue,
+                        warm_bytes=warm_bytes),
+            params, registry=registry)
+        engine.warmup()
+        return engine, registry
+
+    # The engine's own all-hot capacity anchors the offered rate: every
+    # rung and arm sees the same arrivals, so capacity loss under a
+    # paging population shows as achieved-QPS/p99 degradation, not as a
+    # different workload.
+    engine, _ = build(warm_budget_bytes)
+    hot = run_closed_loop(
+        engine, make_sessions(prices, window, slots, prefix="hot-"),
+        concurrency=2 * max_batch, duration_s=min(duration_s, 1.5))
+    engine.stop()
+    # 0.3x saturation: every fault costs a park gather + scatter install
+    # on top of the step, so the warm arm's every-request-faults capacity
+    # is well under all-hot saturation — the offered rate must sit below
+    # THAT for the top rung's p99 to measure paging cost, not backlog.
+    rate = 0.3 * hot["qps"]
+
+    rungs = []
+    for mult in ladder:
+        population = mult * slots
+        rung: dict = {"population_x_slots": mult, "sessions": population}
+        for arm, warm_bytes in (("warm", warm_budget_bytes),
+                                ("no_warm", 0)):
+            engine, registry = build(warm_bytes)
+            sess = make_sessions(prices, window, population,
+                                 prefix=f"{arm}{mult}-")
+            # Un-recorded priming pass: long enough to touch the whole
+            # population once, so the measured pass starts at steady
+            # state instead of measuring mandatory first-touch prefills.
+            run_open_loop(engine, sess, rate_qps=rate,
+                          duration_s=min(max(duration_s,
+                                             population / max(rate, 1.0)),
+                                         4.0))
+            pre_arm = registry.counters()
+            run = run_open_loop(engine, sess, rate_qps=rate,
+                                duration_s=duration_s)
+            engine.stop(drain=False)
+            counters = {
+                k: v - pre_arm.get(k, 0)
+                for k, v in registry.counters().items()}
+            hits = int(counters.get("serve_warm_hits_total", 0))
+            misses = int(counters.get("serve_warm_misses_total", 0))
+            rung[arm] = {
+                "qps": round(run["qps"], 1),
+                "p50_ms": round(run["p50_ms"], 3),
+                "p99_ms": round(run["p99_ms"], 3),
+                "completed": run["completed"],
+                "failed": run["failed"],
+                "generator_dropped": run["dropped"],
+                "prefills": int(counters.get("serve_prefills_total", 0)),
+                "warm_parks": int(
+                    counters.get("serve_warm_parks_total", 0)),
+                "warm_hits": hits,
+                "warm_misses": misses,
+                "warm_hit_rate": (round(hits / (hits + misses), 4)
+                                  if hits + misses else None),
+            }
+        rungs.append(rung)
+
+    # Unpark-cost phase: population 2x slots round-robin means every
+    # arrival faults; the un-recorded priming pass moves every session
+    # through its first cold touch so the measured pass is all warm
+    # hits, at a low rate so queueing delay does not pollute the p50.
+    engine, registry = build(warm_budget_bytes)
+    unpark_sessions = make_sessions(prices, window, 2 * slots,
+                                    prefix="unpark-")
+    run_open_loop(engine, unpark_sessions, rate_qps=rate,
+                  duration_s=min(duration_s, 1.0))
+    pre = registry.counters()
+    unpark = run_open_loop(engine, unpark_sessions, rate_qps=0.25 * rate,
+                           duration_s=duration_s)
+    engine.stop(drain=False)
+    counters = registry.counters()
+    m_hits = int(counters.get("serve_warm_hits_total", 0)
+                 - pre.get("serve_warm_hits_total", 0))
+    m_misses = int(counters.get("serve_warm_misses_total", 0)
+                   - pre.get("serve_warm_misses_total", 0))
+
+    top = rungs[-1]
+    precision = cfg_env.precision.mode
+    return {
+        **_result_envelope(cfg_env),
+        "metric": "session_capacity_qps",
+        "value": top["warm"]["qps"],
+        "unit": "requests/s/chip",
+        "precision": precision,
+        "note": f"warm-arm achieved QPS at {ladder[-1]}x-slots "
+                "population; the no_warm control re-prefills every "
+                "fault (recorded, not gated)",
+        "warm_unpark": {
+            "metric": "warm_unpark_ms",
+            "value": round(unpark["p50_ms"], 3),
+            "precision": precision,
+            "warm_hit_rate": (round(m_hits / (m_hits + m_misses), 4)
+                              if m_hits + m_misses else None),
+            "note": "end-to-end p50 when every request pages in from "
+                    "warm (one unpark per request); higher is worse "
+                    "(gate band inverted)"},
+        "hot_anchor": {"qps": round(hot["qps"], 1),
+                       "p50_ms": round(hot["p50_ms"], 3),
+                       "p99_ms": round(hot["p99_ms"], 3)},
+        "offered_rate_qps": round(rate, 1),
+        "slots": slots,
+        "warm_budget_bytes": warm_budget_bytes,
+        "ladder": rungs,
+    }
+
+
 def bench_autotune(*, duration_s: float = 1.2, sessions: int = 1024,
                    max_batch: int = 16, max_queue: int = 512,
                    batch_timeout_ms: float = 25.0,
@@ -2606,6 +2780,7 @@ def _await_devices(attempts: int = 3, timeout_s: float = 180.0,
                  "r['precision'] = bench.bench_precision(); "
                  "r['serve'] = bench.bench_serve(); "
                  "r['serve_overload'] = bench.bench_serve_overload(); "
+                 "r['session_paging'] = bench.bench_session_paging(); "
                  "r['autotune'] = bench.bench_autotune(); "
                  "r['replay'] = bench.bench_replay(); "
                  "r['actor_scaling'] = bench.bench_actor_scaling(); "
@@ -2674,6 +2849,7 @@ def main() -> None:
     result["precision"] = bench_precision()
     result["serve"] = bench_serve()
     result["serve_overload"] = bench_serve_overload()
+    result["session_paging"] = bench_session_paging()
     result["autotune"] = bench_autotune()
     result["replay"] = bench_replay()
     result["actor_scaling"] = bench_actor_scaling()

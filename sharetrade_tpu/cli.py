@@ -427,6 +427,17 @@ def cmd_serve(args) -> int:
             "stopped_clean": stopped_clean,
             "engine_failed": engine_failed,
         }
+        # Session-tier counters (ISSUE 18): only meaningful when the
+        # warm tier is on (serve.warm_bytes > 0), so gate on activity.
+        warm_parks = int(counters.get("serve_warm_parks_total", 0))
+        warm_hits = int(counters.get("serve_warm_hits_total", 0))
+        warm_misses = int(counters.get("serve_warm_misses_total", 0))
+        if warm_parks or warm_hits or warm_misses:
+            summary["warm_parks"] = warm_parks
+            summary["warm_hits"] = warm_hits
+            summary["warm_misses"] = warm_misses
+            summary["warm_demotions"] = int(
+                counters.get("serve_warm_demotions_total", 0))
         # Stage-decomposition tail (the ISSUE-11 observability surface):
         # histogram-derived per-stage p99s plus the slowest exemplars —
         # the "which stage owns the tail" answer in the run summary.
@@ -700,6 +711,8 @@ def cmd_fleet(args) -> int:
     cfg = _load_config(args)
     if args.engines:
         cfg.fleet.num_engines = args.engines
+    if getattr(args, "autoscale", False):
+        cfg.fleet.autoscale = True
     if args.learner:
         # The flywheel's learner half: ingest session journals with no
         # ActorPool in this process, and evaluate often enough that
@@ -712,7 +725,7 @@ def cmd_fleet(args) -> int:
         if cfg.data.journal_segment_records <= 0:
             cfg.data.journal_segment_records = 256
     service = orch = None
-    pool = router = frontend = obs_bundle = None
+    pool = router = frontend = obs_bundle = autoscaler = None
     stop_evt = threading.Event()
     preempt_at: list[float] = []
 
@@ -759,6 +772,14 @@ def cmd_fleet(args) -> int:
             wire_backend=cfg.fleet.wire_backend,
             tracer=(WireTracer(obs_bundle.spans, mint=True)
                     if obs_bundle.spans is not None else None)).start()
+        if cfg.fleet.autoscale:
+            # Membership control loop (ISSUE 18): reads the router's
+            # telemetry history ring, drives EnginePool.scale within
+            # [min_engines, max_engines].
+            from sharetrade_tpu.fleet.autoscale import EngineAutoscaler
+            autoscaler = EngineAutoscaler(
+                pool, cfg.fleet, workdir=cfg.fleet.dir,
+                registry=registry, obs=obs_bundle).start()
 
         if args.learner:
             from sharetrade_tpu.config import FrameworkConfig
@@ -807,6 +828,8 @@ def cmd_fleet(args) -> int:
             stop_evt.wait(0.25)
 
         grace = cfg.fleet.drain_grace_s
+        if autoscaler is not None:
+            autoscaler.stop()   # membership frozen before the drain
         frontend.drain(timeout_s=grace * 0.5)
         frontend.stop()
         router.stop()
@@ -824,6 +847,12 @@ def cmd_fleet(args) -> int:
             "engine_restarts": pool.restarts_total,
             **{f"engines_{k}": v for k, v in pool.counts().items()},
         }
+        if autoscaler is not None:
+            summary["scale_events"] = pool.scale_events
+            summary["autoscale_up"] = int(
+                counters.get("fleet_autoscale_up_total", 0))
+            summary["autoscale_down"] = int(
+                counters.get("fleet_autoscale_down_total", 0))
         if orch is not None:
             snap = orch.snapshot() or {}
             summary["learner_updates"] = snap.get("updates")
@@ -836,6 +865,8 @@ def cmd_fleet(args) -> int:
     finally:
         for s, h in prev_handlers.items():
             signal.signal(s, h)
+        if autoscaler is not None:
+            autoscaler.stop()
         if frontend is not None:
             frontend.stop()
         if router is not None:
@@ -1000,6 +1031,10 @@ def main(argv=None) -> int:
                                 "republish tag_best)")
             p.add_argument("--resume", action="store_true",
                            help="learner resumes the latest checkpoint")
+            p.add_argument("--autoscale", action="store_true",
+                           help="drive EnginePool.scale from the "
+                                "telemetry history ring (fleet/"
+                                "autoscale.py; implies fleet.autoscale)")
         p.set_defaults(fn=fn)
 
     p = sub.add_parser("obs", help="summarize a telemetry run dir")

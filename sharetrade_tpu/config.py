@@ -520,6 +520,24 @@ class ServeConfig:
     max_restarts: int = 0
     restart_backoff_s: float = 0.05      # initial; doubles per attempt
     restart_backoff_max_s: float = 2.0   # backoff ceiling
+    # --- Warm session tier (ISSUE 18: tiered session paging) ---------
+    # Host-RAM byte budget for PARKED session carries (the warm tier of
+    # the hot/warm/cold hierarchy). An evicted session's device carry is
+    # gathered on the dispatch thread (async device op), read back on
+    # the CONSUMER thread (page-out never blocks dispatch), and held in
+    # a bounded LRU keyed by session id; when the session returns, the
+    # parked carry is reinstalled through the batched scatter path and
+    # the session continues BITWISE-identically to one that was never
+    # evicted. Past the budget (or warm_max_sessions) the stalest parked
+    # carry demotes to COLD — the session journal / re-prefill path, the
+    # pre-existing contract. 0 (default) disables the tier entirely:
+    # every eviction is a cold restart, the PR-8 bitwise fresh-session
+    # contract unchanged.
+    warm_bytes: int = 0
+    # Session-count bound on the warm tier (belt to the byte budget's
+    # suspenders; both are enforced — lint check 17 requires the tier
+    # to be bounded in code).
+    warm_max_sessions: int = 4096
     # Hot-swap circuit breaker: this many CONSECUTIVE verified-restore
     # failures (distinct corrupt/mismatched candidates) stop the watcher
     # from polling the wedged tag for swap_breaker_cooldown_s (exported
@@ -673,6 +691,39 @@ class FleetConfig:
     # Drain budget on SIGTERM: in-flight requests finish, engines drain
     # (their own SIGTERM → 75 contract), stragglers are killed past it.
     drain_grace_s: float = 15.0
+    # --- Fleet autoscaler (ISSUE 18: fleet/autoscale.py) -------------
+    # Close the telemetry loop into fleet MEMBERSHIP: a controller
+    # thread reads the router's per-poll gauge history ring
+    # (obs/tsdb.py, the PR-17 ``fleet_history.jsonl``) and drives
+    # ``EnginePool.scale()`` from sustained ``fleet_slo_availability_
+    # burn`` / ``fleet_overload`` / per-engine queue depth — the PR-14
+    # serve-controller discipline verbatim: dead band between the up/
+    # down thresholds, a LONGER quiet window before scaling down than
+    # up (hysteresis), at most ONE engine per decision (bounded steps),
+    # one decision per cooldown, and the CONFIG as the ceiling (the
+    # autoscaler may never exceed max_engines nor drop below
+    # min_engines). Off by default — membership changes are an operator
+    # decision until explicitly delegated.
+    autoscale: bool = False
+    # Membership bounds the autoscaler must respect. max_engines 0 =
+    # num_engines (no headroom: the autoscaler can only shed).
+    min_engines: int = 1
+    max_engines: int = 0
+    # Decision cadence (seconds between history reads) and cooldown
+    # (minimum seconds between two APPLIED scalings — the rate limit).
+    autoscale_interval_s: float = 1.0
+    autoscale_cooldown_s: float = 5.0
+    # Scale-up triggers, each averaged over the last autoscale_window
+    # history rows: availability burn >= burn_high (1.0 = spending the
+    # full error budget), or per-engine queue depth >= queue_high, or
+    # overload on at least half the window's rows. Scale-down requires
+    # a 2x-longer window with burn < burn_low AND queue < queue_low AND
+    # zero overload throughout — the dead band is everything between.
+    autoscale_window: int = 5
+    autoscale_burn_high: float = 1.0
+    autoscale_burn_low: float = 0.25
+    autoscale_queue_high: float = 8.0
+    autoscale_queue_low: float = 1.0
     # Wire data path for every front-end in the fleet (the router's
     # public port and each engine worker's listener). "evloop" (default)
     # = the sans-IO selector event loop (fleet/evloop.py): one thread,

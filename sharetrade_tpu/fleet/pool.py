@@ -137,6 +137,7 @@ class EnginePool:
         self._engines: dict[str, _EngineHandle] = {}
         self._next_index = 0
         self.target = 0
+        self.scale_events = 0
         self.restarts_total = 0
         self._lock = threading.RLock()
         self._stop = threading.Event()
@@ -287,6 +288,53 @@ class EnginePool:
         """Stop respawning: the fleet is draining — engines exiting from
         here on retire instead of crashing."""
         self._quiesced.set()
+
+    def scale(self, n: int) -> None:
+        """Retarget LIVE membership to ``n`` engines (the autoscaler's —
+        and the operator's — actuator; the ActorPool.scale contract at
+        engine granularity). Growing spawns fresh workers; shrinking
+        retires the NEWEST live engines first (highest numeric id — the
+        longest-lived members keep their warm slot pools and session
+        affinity), each through the SIGTERM drain → exit-75 contract so
+        in-flight requests finish and its sessions migrate cold. Refused
+        while draining: a quiesced pool must not spawn."""
+        if self._quiesced.is_set():
+            log.warning("scale(%d) refused: pool is quiesced/draining", n)
+            return
+        with self._lock:
+            if n < 0:
+                raise ConfigError(f"scale target must be >= 0, got {n}")
+            self.target = n
+            self.scale_events += 1
+            live = [h for h in self._engines.values()
+                    if h.state in (STARTING, ALIVE, BACKOFF)]
+            if len(live) < n:
+                for _ in range(n - len(live)):
+                    self._spawn_new_locked()
+            elif len(live) > n:
+                victims = sorted(
+                    live, key=lambda h: int(h.engine_id[1:]),
+                    reverse=True)[:len(live) - n]
+                for h in victims:
+                    self._retire_locked(h)
+            self._write_status_locked()
+            log.info("fleet scaled to target=%d (%s)", n,
+                     {h.engine_id: h.state
+                      for h in self._engines.values()})
+
+    def _retire_locked(self, h: _EngineHandle) -> None:
+        """Retire one engine: a live process drains via SIGTERM (its own
+        drain → exit 75 contract; the next reap classifies the exit as a
+        RETIRING retirement, never a crash); a dead/backing-off handle
+        just retires in place."""
+        if h.proc is not None and h.proc.poll() is None:
+            h.state = RETIRING
+            try:
+                h.proc.send_signal(signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        else:
+            h.state = RETIRED
 
     def _reap(self) -> None:
         for h in self._engines.values():
@@ -462,6 +510,7 @@ class EnginePool:
                 "pid": os.getpid(),
                 "started_at": self.started_at,
                 "target": self.target,
+                "scale_events": self.scale_events,
                 "restarts_total": self.restarts_total,
                 **self.counts(),
                 "engines": {

@@ -545,6 +545,13 @@ class FleetRouter:
             live = sum(v.healthy for v in self._views.values())
             steps = [v.params_step for v in self._views.values()
                      if v.healthy and v.params_step >= 0]
+            # Fleet-aggregate load signals, into the same gauge row the
+            # history ring records — the autoscaler's (fleet/autoscale.
+            # py) queue-pressure and overload inputs.
+            agg_depth = sum(v.queue_depth
+                            for v in self._views.values() if v.healthy)
+            agg_overload = float(any(
+                v.overload for v in self._views.values() if v.healthy))
         for engine_id in dead_engines:
             self._drop_engine_affinity(engine_id)
         # Router-level failures count against availability too: an
@@ -556,7 +563,11 @@ class FleetRouter:
         self._prev_unrouted = unrouted
         window_bad += d_unrouted
         window_total += d_unrouted
-        gauges: dict[str, float] = {"fleet_engines_live": float(live)}
+        gauges: dict[str, float] = {
+            "fleet_engines_live": float(live),
+            "fleet_queue_depth": float(agg_depth),
+            "fleet_overload": agg_overload,
+        }
         if window_counts is not None and sum(window_counts) > 0:
             from sharetrade_tpu.obs.hist import quantile_from_counts
             gauges["fleet_p50_ms"] = quantile_from_counts(

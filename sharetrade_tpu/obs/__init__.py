@@ -391,6 +391,41 @@ def summarize_run_dir(run_dir: str) -> dict:
                             quantile_from_snapshot(snap, 0.99), 3)}
             if stages:
                 out["serve"]["stages"] = stages
+        if any(k.startswith(("serve_sessions_", "serve_warm_"))
+               for k in list(gauges) + list(counters)):
+            # Session tiers (ISSUE 18): the hot/warm/cold population and
+            # the paging economics in one glanceable block — how many
+            # sessions ride device slots vs the host-RAM warm tier, the
+            # warm hit rate (a warm hit skips a cold re-prefill), bytes
+            # held vs budget, and the live ms-saved-per-MB gauge that
+            # answers "is the warm tier paying for its RAM".
+            hits = counters.get("serve_warm_hits_total", 0.0)
+            misses = counters.get("serve_warm_misses_total", 0.0)
+            lookups = hits + misses
+            out["sessions"] = {
+                "hot": gauges.get("serve_sessions_hot"),
+                "warm": gauges.get("serve_warm_sessions"),
+                "warm_bytes": gauges.get("serve_warm_bytes"),
+                "warm_budget_bytes": gauges.get(
+                    "serve_warm_budget_bytes"),
+                "warm_parks_total": counters.get(
+                    "serve_warm_parks_total", 0.0),
+                "warm_hits_total": hits,
+                "warm_misses_total": misses,
+                "warm_hit_rate": (round(hits / lookups, 4)
+                                  if lookups else None),
+                "warm_demotions_total": counters.get(
+                    "serve_warm_demotions_total", 0.0),
+                "warm_stale_drops_total": counters.get(
+                    "serve_warm_stale_drops_total", 0.0),
+                # Cold tier = sessions resumable only through the
+                # journal re-prefill path (serve_prefills_total counts
+                # every cold entry, first-time or paged back in).
+                "cold_prefills_total": counters.get(
+                    "serve_prefills_total", 0.0),
+                "econ_ms_per_mb": gauges.get(
+                    "serve_warm_econ_ms_per_mb"),
+            }
         if (manifest_tuning
                 or any(k.startswith(("serve_knob_", "serve_controller_",
                                      "ingest_"))
@@ -459,6 +494,25 @@ def summarize_run_dir(run_dir: str) -> dict:
                 "slo_availability_burn": fgauges.get(
                     "fleet_slo_availability_burn"),
                 "counters": fs.get("counters"),
+            }
+    autoscale_path = os.path.join(run_dir, "fleet_autoscale.json")
+    if os.path.isfile(autoscale_path):
+        # Fleet autoscaler (ISSUE 18, fleet/autoscale.py): the membership
+        # control loop's atomically-rewritten state — current target vs
+        # actual engines, the operator bounds, and the last applied
+        # decision with its reason. Folded into the "sessions" section
+        # so paging capacity and fleet capacity read as one story.
+        try:
+            with open(autoscale_path, encoding="utf-8") as f:
+                a = json.load(f)
+        except (OSError, ValueError):
+            a = None
+        if a:
+            out.setdefault("sessions", {})["autoscaler"] = {
+                "target": a.get("target"), "actual": a.get("actual"),
+                "floor": a.get("floor"), "ceiling": a.get("ceiling"),
+                "decisions": a.get("decisions"),
+                "last_decision": a.get("last_decision"),
             }
     exemplars_path = os.path.join(run_dir, "serve_exemplars.json")
     if os.path.isfile(exemplars_path):

@@ -107,6 +107,33 @@ ALWAYS (they are the SLO gauges' source):
   event on threshold crossing — the per-engine signal a fleet router
   aggregates.
 
+Session tiers (ISSUE 18; ``serve.warm_bytes``). The slot pool is the
+HOT tier of a hot/warm/cold hierarchy that lets one engine serve a
+session POPULATION far larger than its device arena:
+
+- **hot**: a device slot — the carry lives in the arena, steady-state
+  requests run the warm program (unchanged).
+- **warm**: a PARKED carry in :class:`WarmStore`, a bounded
+  byte-budgeted host-RAM LRU. On eviction the victim's arena row is
+  batch-gathered on the dispatch thread (async device op, never a
+  readback), and the CONSUMER thread pages it out (``device_get`` —
+  blocking host work belongs there, lint check 17) into the dispatcher's
+  park inbox; the dispatcher commits it to the store, dropping entries
+  whose session already re-entered (stale). A returning session's
+  parked carry is reinstalled through the batched scatter path
+  (``device_put`` + one jitted donated scatter) and the session
+  continues BITWISE-identically to one that was never evicted — the
+  round trip is an exact byte copy, the tier's acceptance oracle.
+- **cold**: everything else — the pre-existing
+  restart-through-batched-prefill path, unchanged, and still what a
+  warm-tier overflow demotes to (stalest parked carry first).
+
+``warm_bytes=0`` (default) disables the tier: every eviction is a cold
+restart, bitwise-identical to the PR-8 contract. Eviction economics is
+a live gauge: ``serve_warm_econ_ms_per_mb`` — prefill-recompute
+milliseconds avoided by warm hits this stats window, per MB of carry
+bytes held (EWMA'd cold device time × window hits / held MB).
+
 With obs enabled (``obs.request_trace``), the lifecycle additionally
 emits through obs/trace.py as nested ASYNC spans keyed by
 request/batch/session ids, so Perfetto renders request flows through the
@@ -339,6 +366,13 @@ class _DoneBatch(NamedTuple):
     #: pre-fault batches draining out of the done queue during a backoff
     #: attest nothing about post-fault engine health.
     epoch: int = 0
+    #: Page-out payload (warm tier on, this tick evicted someone): the
+    #: victims' session ids and their still-device-resident carry rows
+    #: (stacked at the max_batch shape; only the first len(parked_sids)
+    #: rows are real). The CONSUMER device_gets the rows and hands the
+    #: host copies back through the dispatcher's park inbox.
+    parked_sids: tuple = ()
+    parked_rows: Any = None
 
 
 class SlotPool:
@@ -367,6 +401,12 @@ class SlotPool:
             self._lru.move_to_end(session_id)
         return slot
 
+    def contains(self, session_id: Any) -> bool:
+        """Membership WITHOUT a recency refresh — the park-inbox
+        staleness check (a session that re-entered the pool before its
+        page-out committed makes that parked carry stale)."""
+        return session_id in self._lru
+
     def drop(self, session_id: Any) -> None:
         """Forget a session (its slot returns to the free list) — the
         dispatch-fault path, where an admitted slot may never have
@@ -391,6 +431,73 @@ class SlotPool:
         raise RuntimeError(
             "slot pool exhausted by pinned sessions (capacity < max_batch "
             "should have been rejected at construction)")
+
+
+class WarmStore:
+    """The WARM session tier: a bounded, byte-budgeted LRU of PARKED
+    carries (host numpy trees read back by the consumer thread's
+    page-out). Owned by ONE thread — the dispatcher commits, hits, and
+    demotes; no lock guards the map. The stats other threads publish
+    (``bytes``/``len``) read single references, atomic under the GIL.
+
+    Bounded by construction (lint check 17): every ``put`` demotes
+    stalest-first until BOTH the byte budget and the session bound hold
+    again, and a single carry larger than the whole budget is refused
+    outright (that session pages straight to cold)."""
+
+    def __init__(self, max_bytes: int, max_sessions: int):
+        self.max_bytes = int(max_bytes)
+        self.max_sessions = max(1, int(max_sessions))
+        self._lru: OrderedDict[Any, tuple[Any, int]] = OrderedDict()
+        self.bytes = 0
+        # Event totals (dispatcher-thread writes; readers see ints).
+        self.demotions = 0
+        self.refusals = 0
+        self.stale_drops = 0
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def pop(self, session_id: Any) -> Any | None:
+        """Remove and return a parked carry (the warm HIT — unpark);
+        None on a miss (never parked, demoted, or page-out still in
+        flight — cold either way)."""
+        entry = self._lru.pop(session_id, None)
+        if entry is None:
+            return None
+        rows, nbytes = entry
+        self.bytes -= nbytes
+        return rows
+
+    def discard(self, session_id: Any) -> None:
+        """Forget a parked carry without returning it (poisoned/dropped
+        sessions must not resurrect an old episode state)."""
+        self.pop(session_id)
+
+    def put(self, session_id: Any, rows: Any, nbytes: int) -> list:
+        """Park one carry; returns the sessions DEMOTED to cold to make
+        room (stalest first). A carry that cannot fit the budget at all
+        is refused — the caller's session simply stays cold."""
+        nbytes = int(nbytes)
+        if nbytes <= 0 or nbytes > self.max_bytes:
+            self.refusals += 1
+            return []
+        old = self._lru.pop(session_id, None)
+        if old is not None:
+            self.bytes -= old[1]
+        self._lru[session_id] = (rows, nbytes)
+        self.bytes += nbytes
+        demoted = []
+        # The boundedness contract: demote stalest-first until both the
+        # byte budget and the session bound hold (terminates — the entry
+        # just parked fits the budget on its own).
+        while (self.bytes > self.max_bytes
+               or len(self._lru) > self.max_sessions):
+            victim, (_, vbytes) = self._lru.popitem(last=False)
+            self.bytes -= vbytes
+            self.demotions += 1
+            demoted.append(victim)
+        return demoted
 
 
 class ServeEngine:
@@ -440,6 +547,14 @@ class ServeEngine:
                 "serve.restart_backoff_s / restart_backoff_max_s must be "
                 f"> 0, got {cfg.restart_backoff_s}/"
                 f"{cfg.restart_backoff_max_s}")
+        if cfg.warm_bytes < 0:
+            raise ConfigError(
+                f"serve.warm_bytes must be >= 0 (0 disables the warm "
+                f"tier), got {cfg.warm_bytes}")
+        if cfg.warm_max_sessions < 1:
+            raise ConfigError(
+                f"serve.warm_max_sessions must be >= 1, got "
+                f"{cfg.warm_max_sessions}")
         self.model = model
         self.cfg = cfg
         self._precision = precision
@@ -450,6 +565,17 @@ class ServeEngine:
         self._live = _Live(jax.device_put(precision.cast_compute(params)),
                            int(params_step))
         self._carry0 = precision.cast_carry(model.init_carry(), model)
+        #: One session's carry footprint in bytes — the warm tier's
+        #: accounting unit (static per model/precision) and the
+        #: numerator of the eviction-economics gauge.
+        self._carry_nbytes = sum(
+            int(leaf.size) * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(self._carry0))
+        #: Warm tier on only when budgeted AND the model has a carry to
+        #: park (a stateless MLP's pool is structurally empty — there is
+        #: nothing a warm tier could preserve).
+        self._warm_enabled = (cfg.warm_bytes > 0
+                              and self._carry_nbytes > 0)
         self._build_arena_and_programs()
 
         # Live tunable knobs (tuned-knob-ok: seeded from config — the
@@ -512,6 +638,11 @@ class ServeEngine:
         self._stats_t = time.perf_counter()
         self._stats_completed = 0
         self._stats_occupancy: list[float] = []
+        # Eviction-economics inputs (survive a supervised rebuild — they
+        # are measurements, not session state): EWMA cold-re-entry cost
+        # and the warm-hit counter base of the last stats window.
+        self._ewma_prefill_ms = 0.0
+        self._prev_warm_hits = 0.0
         #: Serializes _publish_stats: the consumer thread publishes after
         #: every batch, but terminal FAILURES (shed/reject/expiry/engine-
         #: failed) also publish from their own threads — during a total
@@ -632,6 +763,19 @@ class ServeEngine:
         action/logit/value outputs, which are never donated."""
         cfg = self.cfg
         self._slots = SlotPool(cfg.slots)
+        # Fresh warm tier too: the restart contract is ALL sessions cold
+        # (a parked carry would survive the rebuild bit-exactly, but the
+        # documented supervision semantics — and the soak's assertions —
+        # say a rebuilt engine serves only cold re-entries).
+        self._warm = WarmStore(cfg.warm_bytes, cfg.warm_max_sessions)
+        # Page-outs the consumer has read back but the dispatcher has
+        # not yet committed to the store (single-owner handoff: the
+        # consumer appends host carries, the dispatcher — who owns ALL
+        # admission state — drains at the top of each tick and drops
+        # entries whose session already re-entered).
+        # trace-buffer-ok: bounded by in-flight batches
+        # (done_depth * max_batch entries at most)
+        self._park_inbox: deque = deque()
         n_arena = cfg.slots + cfg.max_batch
         self._pool = jax.tree.map(
             lambda x: jnp.repeat(jnp.asarray(x)[None], n_arena, axis=0),
@@ -647,6 +791,14 @@ class ServeEngine:
         else:
             self._step_fn = jax.jit(self._generic_program,
                                     donate_argnums=donate)
+        if self._warm_enabled:
+            # Paging programs, both at the static max_batch shape (one
+            # compile each). The park gather does NOT donate — the arena
+            # must survive it for the tick's programs; the unpark
+            # install donates like every other arena writer.
+            self._park_fn = jax.jit(self._park_program)
+            self._install_fn = jax.jit(self._install_program,
+                                       donate_argnums=(0,))
 
     # -- device programs --------------------------------------------------
 
@@ -668,6 +820,18 @@ class ServeEngine:
                                 new_rows)
         actions = jnp.argmax(out.logits, axis=-1).astype(jnp.int32)
         return actions, out.logits, out.value, new_pool
+
+    def _park_program(self, pool, idx):
+        """Batch-gather the tick's eviction victims' carry rows (page-out
+        step 1). Async device compute, never a readback — legal on the
+        dispatch thread; the CONSUMER device_gets the result."""
+        return jax.tree.map(lambda x: x[idx], pool)
+
+    def _install_program(self, pool, rows, idx):
+        """Scatter parked carries back into their (re-)admitted slots
+        (unpark): the same ``.at[idx].set`` path every program writes
+        through, so a warm re-entry is bitwise a never-evicted session."""
+        return jax.tree.map(lambda p, r: p.at[idx].set(r), pool, rows)
 
     def _generic_program(self, params, pool, obs, idx, cold):
         """Single program for models without a prefill/incremental split:
@@ -960,6 +1124,15 @@ class ServeEngine:
             _, _, _, pool = self._step_fn(self._live.params, self._pool,
                                           obs, idx, cold)
             self._pool = pool
+        if self._warm_enabled:
+            # Compile the paging programs too — a first-eviction compile
+            # on the dispatch thread would stall every queued deadline.
+            # Scratch-only, like everything else here: the gather pads
+            # to scratch row 0, the install writes only scratch rows.
+            pidx = np.full((cfg.max_batch,), cfg.slots, np.int32)
+            self._park_fn(self._pool, pidx)
+            row0 = jax.tree.map(np.asarray, self._carry0)
+            self._pool = self._install_parked([row0], [cfg.slots])
 
     def drain(self, timeout_s: float = 30.0) -> bool:
         """Block until every submitted request has been answered (the
@@ -1306,23 +1479,60 @@ class ServeEngine:
         Runs on the dispatch critical path: NO blocking host ops here
         (tools/lint_hot_loop.py check 8) — jit calls return asynchronously
         and readback belongs to ``_complete_batch``."""
+        self._drain_park_inbox()
         pinned = {r.session_id for r in batch}
         cold_reqs: list[_Request] = []
         cold_idx: list[int] = []
         warm_reqs: list[_Request] = []
         warm_idx: list[int] = []
         evicted = 0
+        park_sids: list[Any] = []       # this tick's eviction victims …
+        park_slots: list[int] = []      # … and the arena rows they held
+        unpark_slots: list[int] = []    # slots taking a parked carry back
+        unpark_rows: list[Any] = []     # the parked host carries
+        warm_on = self._warm_enabled
         for req in batch:
             slot = self._slots.lookup(req.session_id)
-            if slot is None:
-                slot, victim = self._slots.admit(req.session_id, pinned)
-                if victim is not None:
-                    evicted += 1
-                cold_reqs.append(req)
-                cold_idx.append(slot)
-            else:
+            if slot is not None:
                 warm_reqs.append(req)
                 warm_idx.append(slot)
+                continue
+            parked = self._warm.pop(req.session_id) if warm_on else None
+            slot, victim = self._slots.admit(req.session_id, pinned)
+            if victim is not None:
+                evicted += 1
+                if warm_on:
+                    # The victim's carry still sits in the arena row the
+                    # admission just reassigned: remember it for the
+                    # batched park gather below (which runs BEFORE any
+                    # program or install writes the row).
+                    park_sids.append(victim)
+                    park_slots.append(slot)
+            if parked is not None:
+                # Warm HIT: the parked carry reinstalls into the new
+                # slot and the session continues through the warm path,
+                # bitwise as if never evicted.
+                self._registry.inc("serve_warm_hits_total")
+                unpark_slots.append(slot)
+                unpark_rows.append(parked)
+                warm_reqs.append(req)
+                warm_idx.append(slot)
+            else:
+                if warm_on:
+                    self._registry.inc("serve_warm_misses_total")
+                cold_reqs.append(req)
+                cold_idx.append(slot)
+        parked_rows = None
+        if park_sids:
+            # Page-out step 1 (dispatch side): ONE batched gather of the
+            # victims' rows at the static max_batch shape — async device
+            # compute; the consumer does the host readback (check 17).
+            pidx = np.full((self.cfg.max_batch,), self.cfg.slots,
+                           np.int32)
+            pidx[:len(park_slots)] = park_slots
+            parked_rows = self._park_fn(self._pool, pidx)
+        if unpark_rows:
+            self._pool = self._install_parked(unpark_rows, unpark_slots)
         # self._pool is reassigned IMMEDIATELY after each program call:
         # the calls donate the arena, so holding the old reference across
         # a later failure (the warm group's _pad raising after the cold
@@ -1368,7 +1578,9 @@ class ServeEngine:
             groups.append((reqs, act, logit, val))
         return _DoneBatch(groups=groups, step=live.step, n=len(batch),
                           cold=len(cold_reqs), evicted=evicted,
-                          epoch=self._fault_epoch)
+                          epoch=self._fault_epoch,
+                          parked_sids=tuple(park_sids),
+                          parked_rows=parked_rows)
 
     def _pad(self, reqs: list[_Request],
              idx: list[int]) -> tuple[np.ndarray, np.ndarray]:
@@ -1385,6 +1597,45 @@ class ServeEngine:
             obs[i] = reqs[0].obs
             out_idx[i] = cfg.slots + i
         return obs, out_idx
+
+    # -- session paging (dispatch side) -----------------------------------
+
+    def _drain_park_inbox(self) -> None:
+        """Commit consumer-read-back page-outs into the warm store.
+        Dispatcher-only, so ALL admission state (slot pool + warm store)
+        has one owner and no insert can race an unpark. An entry whose
+        session re-entered the slot pool before its page-out committed
+        is STALE — that session already restarted cold and its old
+        episode state must never resurrect — and is dropped."""
+        while self._park_inbox:
+            sid, rows = self._park_inbox.popleft()
+            if self._slots.contains(sid):
+                self._warm.stale_drops += 1
+                self._registry.inc("serve_warm_stale_drops_total")
+                continue
+            demoted = self._warm.put(sid, rows, self._carry_nbytes)
+            if demoted:
+                self._registry.inc("serve_warm_demotions_total",
+                                   len(demoted))
+
+    def _install_parked(self, rows: list[Any], slots: list[int]) -> Any:
+        """Unpark: stack the tick's parked host carries, pad to the
+        static ``max_batch`` shape (padding rows repeat row 0 and write
+        SCRATCH arena rows, mirroring :meth:`_pad`), and scatter-install
+        into the (re-)admitted slots. ``device_put`` of host rows is an
+        async H2D enqueue — legal on the dispatch thread; no readback
+        happens here."""
+        cfg = self.cfg
+        n = len(rows)
+        idx = np.empty((cfg.max_batch,), np.int32)
+        idx[:n] = slots
+        for i in range(n, cfg.max_batch):
+            idx[i] = cfg.slots + i
+        pad = cfg.max_batch - n
+        stacked = jax.tree.map(
+            lambda *leaves: np.stack(leaves + (leaves[0],) * pad),
+            *rows)
+        return self._install_fn(self._pool, stacked, idx)
 
     # -- consumer thread --------------------------------------------------
 
@@ -1470,6 +1721,20 @@ class ServeEngine:
         n_done = slow = 0
         slo_target = self._slo[1]
         hists = self._hists
+        if done.parked_sids:
+            # Page-out step 2: the host readback of the victims' carry
+            # rows rides HERE, on the consumer — the dispatch loop never
+            # blocks on a device_get (lint check 17). The copies detach
+            # each session's rows from the stacked transfer buffer so a
+            # later partial demotion frees real memory.
+            # serve-host-ok: consumer-side page-out readback.
+            host_rows = jax.device_get(done.parked_rows)
+            for i, sid in enumerate(done.parked_sids):
+                row = jax.tree.map(lambda x: np.asarray(x[i]).copy(),
+                                   host_rows)
+                self._park_inbox.append((sid, row))
+            self._registry.inc("serve_warm_parks_total",
+                               len(done.parked_sids))
         # Batch-level trace buffer: one bulk tracer append per completed
         # batch instead of one lock round-trip per request.
         trace_lines: list[str] | None = (
@@ -1508,6 +1773,17 @@ class ServeEngine:
                         "batch_wait_ms": (t_disp - t_coll) * 1e3,
                         "device_ms": (now - t_disp) * 1e3,
                     }
+                    if tr.cold:
+                        # EWMA of what a cold re-entry COSTS (device
+                        # time incl. queueing behind the tick's other
+                        # programs — the amortized, honest figure): the
+                        # recompute side of the eviction-economics
+                        # gauge.
+                        prev_ewma = self._ewma_prefill_ms
+                        self._ewma_prefill_ms = (
+                            stages["device_ms"] if prev_ewma == 0.0
+                            else 0.9 * prev_ewma
+                            + 0.1 * stages["device_ms"])
                     result = ServeResult(
                         session_id=req.session_id,
                         action=int(actions[i]),
@@ -1675,6 +1951,28 @@ class ServeEngine:
         if occupancy:
             row["serve_batch_occupancy"] = (
                 sum(occupancy) / len(occupancy))
+        # Session-tier populations + warm accounting. Reading the
+        # dispatcher-owned structures from here is a couple of int/len
+        # loads (GIL-atomic references; approximate by a tick at worst —
+        # gauges, not invariants).
+        row["serve_sessions_hot"] = float(len(self._slots))
+        if self._warm_enabled:
+            warm = self._warm
+            row["serve_warm_sessions"] = float(len(warm))
+            row["serve_warm_bytes"] = float(warm.bytes)
+            row["serve_warm_budget_bytes"] = float(warm.max_bytes)
+            # Eviction economics, live: prefill-recompute ms AVOIDED by
+            # this window's warm hits, per MB of carry bytes held — the
+            # "is the RAM paying for itself" gauge (≫0: keep paging;
+            # ~0: the budget is dead weight).
+            hits = self._registry.counters().get(
+                "serve_warm_hits_total", 0.0)
+            d_hits = max(0.0, hits - self._prev_warm_hits)
+            self._prev_warm_hits = hits
+            held_mb = warm.bytes / 2**20
+            row["serve_warm_econ_ms_per_mb"] = (
+                d_hits * self._ewma_prefill_ms / held_mb
+                if held_mb > 0 else 0.0)
         row.update(self._slo_burn(now, term))
         self._registry.record_many(row)
         self._fold_exemplars(overloaded, io_ok)

@@ -55,6 +55,26 @@ class TestQuickSoak:
         assert "fleet" in tr["witness"]["procs"]
 
 
+class TestAutoscaleSoak:
+    def test_diurnal_profile_tracks_load(self, tmp_path):
+        """One ``cli fleet --autoscale`` tier through a surge/quiet
+        cycle: membership grows to the ceiling under queueing load and
+        retires back to the floor in silence, with ZERO restart storms
+        (every change a deliberate spawn/retirement), no dropped
+        requests, availability burn < 1 in the same history ring the
+        autoscaler decided on, and a clean exit-75 drain."""
+        summary = fleet_soak.run_autoscale_soak(
+            ceiling=2, sessions=32, concurrency=16,
+            workdir=str(tmp_path))
+        assert summary["ok"] is True
+        assert summary["autoscaler"]["decisions"] >= 2
+        assert summary["autoscaler"]["last_decision"]["action"] == "down"
+        assert summary["autoscaler"]["peak_burn"] < 1.0
+        assert summary["traffic"]["failed"] == 0
+        assert summary["traffic"]["completed"] > 0
+        assert summary["drain_rc"] == 75
+
+
 @pytest.mark.slow
 class TestFullSoak:
     def test_multi_engine_multi_kill(self, tmp_path):

@@ -567,8 +567,15 @@ class TestEpisodeMode:
         g_e = jax.grad(loss)(ts.params, model.apply_unroll)
         g_r = jax.grad(loss)(ts.params, model_r.apply_unroll)
         for p_e, p_r in zip(jax.tree.leaves(g_e), jax.tree.leaves(g_r)):
+            # rtol 5e-5, not 1e-5: remat recomputes the block forward
+            # inside the backward pass, and XLA fuses/reassociates that
+            # recompute differently from the saved-activation path, so
+            # gradients agree only to a few float32 ulps (observed max
+            # rel diff ~1.2e-5 on CPU) — a compiler-scheduling artifact,
+            # not a math difference; the primal outputs above stay at
+            # the tight tolerance.
             np.testing.assert_allclose(np.asarray(p_r), np.asarray(p_e),
-                                       rtol=1e-5, atol=1e-5)
+                                       rtol=5e-5, atol=1e-5)
 
     def test_episode_pp_b1_pipelines_sequence_chunks(self, cpu_devices,
                                                      monkeypatch):

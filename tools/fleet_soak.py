@@ -82,12 +82,17 @@ def eprint(*args):
 
 
 def build_config(workdir: str, engines: int,
-                 wire_backend: str = "evloop") -> str:
+                 wire_backend: str = "evloop", *,
+                 autoscale_ceiling: int = 0) -> str:
     """The soak's config: tiny MLP serve workload, journaled-DQN
     learner with session-feed ingest, fast swap/telemetry cadences.
     All paths ABSOLUTE into the scratch dir (children run from the
     repo root). ``wire_backend`` picks the front-end/router data path
-    (the default soaks the evloop; ``threaded`` soaks the oracle)."""
+    (the default soaks the evloop; ``threaded`` soaks the oracle).
+    ``autoscale_ceiling`` > 0 switches to the diurnal-autoscale
+    profile: membership [1, ceiling], fast controller cadences, and a
+    LARGE batch window so a client surge visibly queues on CPU (the
+    queue-depth signal the autoscaler scales on)."""
     from sharetrade_tpu.config import FrameworkConfig
     cfg = FrameworkConfig()
     cfg.seed = 7
@@ -126,6 +131,20 @@ def build_config(workdir: str, engines: int,
     cfg.obs.enabled = True
     cfg.obs.dir = os.path.join(workdir, "obs")
     cfg.obs.slo_availability = 0.999
+    if autoscale_ceiling:
+        cfg.fleet.autoscale = True
+        cfg.fleet.min_engines = 1
+        cfg.fleet.max_engines = autoscale_ceiling
+        cfg.fleet.autoscale_interval_s = 0.4
+        cfg.fleet.autoscale_cooldown_s = 1.5
+        cfg.fleet.autoscale_window = 3
+        cfg.fleet.autoscale_queue_high = 3.0
+        cfg.fleet.autoscale_queue_low = 0.5
+        # A wide batch window makes the surge QUEUE instead of racing
+        # through sub-ms MLP batches: with the closed loop's concurrency
+        # well above max_batch, the overflow sits in the ingress queue
+        # where the telemetry poller (and so the autoscaler) sees it.
+        cfg.serve.batch_timeout_ms = 50.0
     path = os.path.join(workdir, "fleet_soak_config.json")
     cfg.save(path)
     return path
@@ -478,6 +497,146 @@ def run_soak(*, engines: int, kills: int, ramp_s: float,
             shutil.rmtree(workdir, ignore_errors=True)
 
 
+def run_autoscale_soak(*, ceiling: int = 2, sessions: int = 32,
+                       concurrency: int = 16,
+                       surge_budget_s: float = 120.0,
+                       quiet_budget_s: float = 90.0,
+                       workdir: str | None = None,
+                       keep: bool = False) -> dict:
+    """Diurnal-load autoscale profile: one ``cli fleet --autoscale``
+    tier starting at the floor (1 engine, ceiling ``ceiling``), a
+    client SURGE whose queue depth drives the autoscaler up to the
+    ceiling, then a QUIET phase whose sustained silence walks it back
+    down to the floor. Asserts the membership controller's operational
+    contract under real processes:
+
+    - **engine count tracks load** — live membership reaches the
+      ceiling during the surge and returns to the floor in the quiet
+      (engines retire via the SIGTERM drain, never SIGKILL);
+    - **zero restart storms** — ``restarts_total`` stays 0 and no
+      engine lands in ``failed``: every membership change is a
+      deliberate spawn or retirement, never a crash-respawn loop;
+    - **SLO burn < 1** — the surge queues but does not burn the
+      availability budget (the closed loop drops nothing), read from
+      the router's own telemetry history ring — the same rows the
+      autoscaler decided on;
+    - the autoscaler's state file records both decisions, and SIGTERM
+      still drains the whole tier with exit 75.
+    """
+    own_dir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="fleet_autoscale_")
+    cfg_path = build_config(workdir, engines=1,
+                            autoscale_ceiling=ceiling)
+    status_path = os.path.join(workdir, "fleet", "fleet_status.json")
+    state_path = os.path.join(workdir, "fleet", "fleet_autoscale.json")
+    log_path = os.path.join(workdir, "fleet.log")
+    result: dict = {"ceiling": ceiling, "workdir": workdir}
+    proc = launch_cli("fleet", cfg_path, log_path, symbol="MSFT",
+                      extra_args=["--engines", "1", "--autoscale",
+                                  "--duration", "0"])
+    load = None
+    try:
+        ready = wait_ready(proc, log_path, timeout_s=240.0)
+        host, port = ready["host"], ready["port"]
+        eprint(f"fleet ready on {host}:{port} at the floor "
+               f"(1 engine, ceiling {ceiling}; pid {proc.pid})")
+
+        def pool_state() -> dict:
+            return ((read_json(status_path) or {}).get("pool")) or {}
+
+        # ---- surge: closed-loop concurrency >> one engine's batch ----
+        t_surge = time.monotonic()
+        load = Load(host, port, workdir, sessions=sessions,
+                    concurrency=concurrency).start()
+        wait_until(
+            lambda: len(live_engine_pids(status_path)) >= ceiling,
+            surge_budget_s,
+            desc=f"autoscaler grows membership to the ceiling ({ceiling})")
+        result["surge_scale_up_s"] = round(time.monotonic() - t_surge, 1)
+        pool = pool_state()
+        if pool.get("restarts_total", 0) != 0:
+            raise SoakError(
+                "restart storm during the surge: restarts_total = "
+                f"{pool.get('restarts_total')} (scale-ups must be "
+                "spawns, not crash-respawns)")
+        eprint(f"surge: membership at ceiling in "
+               f"{result['surge_scale_up_s']}s, restarts 0")
+
+        # ---- quiet: the load stops; silence walks membership down ----
+        load.stop()
+        surge_traffic = {"submitted": load.submitted,
+                         "completed": load.completed,
+                         "failed": load.failed}
+        load = None
+        if surge_traffic["failed"]:
+            raise SoakError(
+                f"{surge_traffic['failed']} requests failed during the "
+                "surge (queueing must delay, never drop)")
+        t_quiet = time.monotonic()
+        wait_until(
+            lambda: len(live_engine_pids(status_path)) == 1,
+            quiet_budget_s,
+            desc="autoscaler retires back to the floor (1 engine)")
+        result["quiet_scale_down_s"] = round(time.monotonic() - t_quiet, 1)
+        pool = pool_state()
+        if pool.get("restarts_total", 0) != 0:
+            raise SoakError(
+                "restart storm: retirements were misclassified — "
+                f"restarts_total = {pool.get('restarts_total')}")
+        eprint(f"quiet: membership back at the floor in "
+               f"{result['quiet_scale_down_s']}s, restarts still 0")
+
+        # ---- the controller's own ledger + the ring it decided on ----
+        state = read_json(state_path) or {}
+        if state.get("decisions", 0) < 2:
+            raise SoakError(
+                f"autoscaler state records {state.get('decisions')} "
+                "decisions; the diurnal profile needs >= 2 (up + down)")
+        if state.get("target") != 1:
+            raise SoakError(
+                f"autoscaler target settled at {state.get('target')}, "
+                "want the floor (1)")
+        sys.path.insert(0, REPO)
+        from sharetrade_tpu.obs.tsdb import read_history
+        rows = read_history(os.path.join(workdir, "fleet",
+                                         "fleet_history.jsonl"),
+                            last_n=64)
+        burns = [float(r.get("fleet_slo_availability_burn", 0.0) or 0.0)
+                 for r in rows]
+        if burns and max(burns) >= 1.0:
+            raise SoakError(
+                f"availability burn peaked at {max(burns):.2f} >= 1.0: "
+                "the surge ate the error budget")
+        result["autoscaler"] = {
+            "decisions": state.get("decisions"),
+            "last_decision": state.get("last_decision"),
+            "peak_burn": max(burns) if burns else 0.0,
+            "history_rows": len(rows),
+        }
+        result["traffic"] = surge_traffic
+
+        # ---- drain --------------------------------------------------
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        if rc != 75:
+            raise SoakError(
+                f"fleet drain exited {rc}, want 75: {log_tail(proc)}")
+        result["drain_rc"] = rc
+        result["ok"] = True
+        return result
+    finally:
+        if load is not None:
+            try:
+                load.stop()
+            except Exception:   # noqa: BLE001
+                pass
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        if own_dir and not keep:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--engines", type=int, default=3)
@@ -492,9 +651,31 @@ def main() -> int:
     parser.add_argument("--quick", action="store_true",
                         help="tier-1 profile: 2 engines, 1 kill, short "
                              "ramp")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="diurnal autoscale profile instead of the "
+                             "kill-test: surge to the ceiling, quiet "
+                             "back to the floor, zero restart storms")
+    parser.add_argument("--ceiling", type=int, default=2,
+                        help="autoscale profile's membership ceiling")
     parser.add_argument("--keep", action="store_true",
                         help="keep the scratch dir for forensics")
     args = parser.parse_args()
+    if args.autoscale:
+        t0 = time.monotonic()
+        try:
+            result = run_autoscale_soak(ceiling=args.ceiling,
+                                        sessions=args.sessions,
+                                        concurrency=args.concurrency,
+                                        keep=args.keep)
+        except SoakError as exc:
+            print(json.dumps({"ok": False, "error": str(exc)}),
+                  flush=True)
+            eprint(f"FLEET AUTOSCALE SOAK FAILED: {exc}")
+            return 1
+        result["elapsed_s"] = round(time.monotonic() - t0, 1)
+        print(json.dumps(result), flush=True)
+        eprint(f"fleet autoscale soak OK in {result['elapsed_s']}s")
+        return 0
     if args.quick:
         args.engines = min(args.engines, 2)
         args.kills = min(args.kills, 1)

@@ -504,6 +504,29 @@ SPAN_EMIT_DUMPS_PATTERN = re.compile(r"json\.dumps?\s*\(")
 SPAN_EMIT_CTX_PATTERN = re.compile(r"span|tctx|trace", re.IGNORECASE)
 SPAN_NAME_PATTERN = re.compile(r"span|trace", re.IGNORECASE)
 
+#: Check 17 (the session-paging PR): the warm session tier stays a
+#: BOUNDED host-RAM cache and the paging seam keeps the serve engine's
+#: dispatcher/consumer split. (a) The ``WarmStore`` class must carry its
+#: own eviction evidence IN CODE — an actual ``popitem`` call inside a
+#: ``while`` loop whose condition references the byte/session budget —
+#: because a warm tier that only *documents* its bound is check 11's
+#: leak class at carry-tree size: each parked session holds a whole
+#: per-session carry, so unbounded growth tracks the SESSION population,
+#: not the request rate. (b) The paging functions that run on the
+#: dispatch thread (``_drain_park_inbox`` — the park-inbox commit at the
+#: top of ``_dispatch_batch`` — and ``_install_parked`` — the batched
+#: scatter re-install) inherit check 8's host-op ban wholesale: the
+#: whole point of parking on the consumer thread is that dispatch never
+#: blocks on a device_get/fsync/log for paging, and both functions must
+#: keep existing (a rename must update this lint, not un-guard the
+#: seam). Escape hatch: ``warm-tier-ok`` on the class line (or the two
+#: above) naming where the bound actually lives; the dispatch half uses
+#: check 8's ``serve-host-ok``.
+SERVE_WARM_CLASS = "WarmStore"
+SERVE_PAGE_FUNCS = ("_drain_park_inbox", "_install_parked")
+WARM_TIER_MARKER = "warm-tier-ok"
+WARM_BOUND_PATTERN = re.compile(r"max_bytes|max_sessions")
+
 
 def lint_hot_loop_syncs() -> tuple[list[tuple[str, int, str]], set[str]]:
     return _scan_named_funcs(HOT_FUNCS, PATTERN, MARKER)
@@ -850,6 +873,50 @@ def lint_span_emission(
     return sorted(bad, key=lambda hit: (hit[0], hit[1]))
 
 
+def lint_warm_tier(target: pathlib.Path | None = None
+                   ) -> tuple[list[tuple[str, int, str]], set[str]]:
+    """Check 17: (a) the ``WarmStore`` class carries in-code eviction
+    evidence — a ``popitem`` call plus a ``while`` loop conditioned on
+    the byte/session budget — unless the class line (or the two above)
+    carries ``warm-tier-ok`` naming where the bound lives; (b) the
+    dispatch-thread paging functions (SERVE_PAGE_FUNCS) inherit check
+    8's blocking-host-op ban (``serve-host-ok`` escape). Returns (hits,
+    found names over the class + paging functions). ``target``
+    overrides the scanned file (tests exercise the semantics on
+    fixtures)."""
+    target = target or SERVE_TARGET
+    src = target.read_text()
+    lines = src.splitlines()
+    bad: list[tuple[str, int, str]] = []
+    found: set[str] = set()
+    for node in ast.walk(ast.parse(src)):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name == SERVE_WARM_CLASS):
+            continue
+        found.add(node.name)
+        window = lines[max(0, node.lineno - 3):node.lineno]
+        if any(WARM_TIER_MARKER in w for w in window):
+            continue
+        called: set = set()
+        for child in ast.walk(node):
+            if isinstance(child, ast.Call):
+                f = child.func
+                called.add(f.attr if isinstance(f, ast.Attribute)
+                           else getattr(f, "id", None))
+        bounded_loop = any(
+            isinstance(child, ast.While)
+            and WARM_BOUND_PATTERN.search(
+                ast.get_source_segment(src, child.test) or "")
+            for child in ast.walk(node))
+        if "popitem" not in called or not bounded_loop:
+            bad.append((node.name, node.lineno,
+                        lines[node.lineno - 1].strip()))
+    page_bad, page_found = _scan_named_funcs(
+        SERVE_PAGE_FUNCS, SERVE_BLOCK_PATTERN, SERVE_MARKER, target=target)
+    return (sorted(bad + page_bad, key=lambda hit: hit[1]),
+            found | page_found)
+
+
 def lint_dispatcher_blocking() -> tuple[list[tuple[str, int, str]], set[str]]:
     """Check 4: no unmarked blocking host calls in the dispatcher section;
     the consumer-side functions must still exist. Returns (hits, found
@@ -1158,6 +1225,28 @@ def main() -> int:
               f"(or the two above) '# {TRACE_BUFFER_MARKER}: <the "
               "bound / why serialization is off the hot path>'")
         return 1
+    warm_bad, warm_found = lint_warm_tier()
+    warm_missing = ({SERVE_WARM_CLASS} | set(SERVE_PAGE_FUNCS)) - warm_found
+    if warm_missing:
+        print(f"warm-tier lint: name(s) {sorted(warm_missing)} not found "
+              f"in {SERVE_TARGET} — the session-paging tier was renamed; "
+              "update tools/lint_hot_loop.py SERVE_WARM_CLASS/"
+              "SERVE_PAGE_FUNCS")
+        return 1
+    if warm_bad:
+        print(f"warm-tier lint FAILED ({SERVE_TARGET.name}):")
+        for fn, ln, text in warm_bad:
+            print(f"  {fn}:{ln}: {text}")
+        print("the warm session tier must evict IN CODE (a popitem loop "
+              "conditioned on max_bytes/max_sessions — each parked "
+              "session holds a whole carry tree, so an unbounded store "
+              "leaks at session-population rate), and the dispatch-"
+              "thread paging functions must not block on host ops "
+              "(device_get belongs to the consumer's park readback); "
+              f"tag the class '# {WARM_TIER_MARKER}: <where the bound "
+              f"lives>' or the line '# {SERVE_MARKER}: <why this host "
+              "op rides dispatch>'")
+        return 1
     dur_bad = lint_durable_replace()
     if dur_bad:
         print("durable-rename fsync lint FAILED:")
@@ -1188,6 +1277,8 @@ def main() -> int:
           f"evloop non-blocking lint OK ({', '.join(EVLOOP_FILES)}); "
           f"sans-IO import lint OK ({SANSIO_FILE}); "
           f"span-emission lint OK ({', '.join(SPAN_EMIT_FILES)}); "
+          f"warm-tier lint OK ({SERVE_WARM_CLASS}, "
+          f"{', '.join(SERVE_PAGE_FUNCS)}); "
           f"durable-rename fsync lint OK ({', '.join(DURABLE_WRITE_FILES)})")
     return 0
 
