@@ -1752,10 +1752,32 @@ def bench_router_relay(*, duration_s: float = 2.0,
     gap — the scaling slope (flat vs degrading) is the honest signal
     there.
 
-    Gate row (tools/perf_gate.py): ``router_relay_qps`` — the evloop
-    arm's relay throughput at the largest scan point. Acceptance
-    (ISSUE 16): evloop >= 10x the threaded arm in the same run
-    (``accepted_10x``; reported as measured, never asserted).
+    Gate row (tools/perf_gate.py): ``router_relay_qps`` — the
+    PRODUCTION evloop arm's relay throughput at the largest scan point
+    (evloop-native when the extension is built, evloop-py otherwise).
+    Acceptance (ISSUE 16): evloop >= 10x the threaded arm in the same
+    run (``accepted_10x``; reported as measured, never asserted).
+
+    Wire-backend arms (ISSUE 19): the scan now runs THREE arms per
+    connection count — ``threaded`` (the blocking oracle, Python
+    parser), ``evloop_py`` (selector loop, Python parser) and
+    ``evloop_native`` (selector loop, the GIL-free C parser behind
+    ``proto.set_backend("native")``; skipped when the extension is not
+    built). The loadgen pins ``proto.PyResponseParser`` /
+    ``proto.py_render_request`` directly so CLIENT-side parse cost is
+    identical across arms and the native delta is router-side only.
+
+    CPU honesty: on a 1-vCPU host loadgen + router share the core, so
+    qps ratios compress — the load-bearing native reading is ROUTER CPU
+    TIME PER REQUEST. Each arm reports ``cpu_us_per_req``: the
+    process-wide ``time.process_time()`` delta over the timed window
+    minus every loadgen thread's own ``time.thread_time()`` delta
+    (echo engines are subprocesses, excluded by construction) — what
+    remains is the router's parse/route/relay/render work, divided by
+    requests served. Acceptance (ISSUE 19): evloop-native >= 2.5x
+    evloop-py qps at the largest scan point OR router CPU/request down
+    >= 2.5x (``accepted_native_2p5x``; reported as measured, never
+    asserted).
 
     Tracing A/B (ISSUE 17): after the scan, three extra evloop runs at
     the FIRST scan point — two tracing-off (the A/A control that bounds
@@ -1806,10 +1828,12 @@ def bench_router_relay(*, duration_s: float = 2.0,
                 raise RuntimeError(f"echo {i} bad ready line: {line!r}")
             endpoints[f"echo{i}"] = (ready["host"], ready["port"])
 
-        def run_arm(backend_name: str, connections: int,
-                    traced: bool = False) -> dict:
+        def run_arm(wire_backend: str, parse_backend: str,
+                    connections: int, traced: bool = False) -> dict:
             registry = MetricsRegistry()
             cfg = FrameworkConfig().fleet
+            prev_parse = proto.proto_backend
+            proto.set_backend(parse_backend)
             span_dir, sink, tracer, obs_shim = None, None, None, None
             if traced:
                 from sharetrade_tpu.obs.trace import SpanJournal, SpanSink
@@ -1822,14 +1846,18 @@ def bench_router_relay(*, duration_s: float = 2.0,
             router.poll_once()          # one scrape: views go live
             frontend = ServeFrontend(
                 router, registry,
-                wire_backend=backend_name, tracer=tracer).start()
+                wire_backend=wire_backend, tracer=tracer).start()
             host, port = frontend.host, frontend.port
             n_threads = max(1, min(loadgen_threads, connections))
             per_thread = [connections // n_threads
                           + (1 if i < connections % n_threads else 0)
                           for i in range(n_threads)]
-            barrier = threading.Barrier(n_threads)
+            # +1 party: the main thread syncs on the same barrier so
+            # its process_time() window matches the workers' timed
+            # rounds (CPU accounting below).
+            barrier = threading.Barrier(n_threads + 1)
             results: dict = {}
+            loadgen_cpu: dict = {}
 
             def worker(idx: int, n_socks: int) -> None:
                 socks: list = []
@@ -1852,11 +1880,15 @@ def bench_router_relay(*, duration_s: float = 2.0,
                         body = _json.dumps(
                             {"session": f"relay-{idx}-{j}",
                              "obs": [1.0, 2.0, 3.0]}).encode()
-                        batch = proto.render_request(
+                        # Pinned to the Python implementations so the
+                        # CLIENT'S parse/render cost is identical
+                        # across arms — only the router feels
+                        # proto.set_backend.
+                        batch = proto.py_render_request(
                             "POST", wire.SUBMIT_PATH,
                             f"{host}:{port}", body) * pipeline
                         socks.append((s, batch,
-                                      proto.ResponseParser()))
+                                      proto.PyResponseParser()))
 
                     def do_round() -> None:
                         nonlocal failed
@@ -1877,11 +1909,13 @@ def bench_router_relay(*, duration_s: float = 2.0,
                     do_round()          # warmup: every conn served once
                     barrier.wait(timeout=300.0)
                     counted = 0
+                    cpu0 = time.thread_time()
                     t0 = time.monotonic()
                     while time.monotonic() - t0 < duration_s:
                         do_round()
                         counted += n_socks * pipeline
                     elapsed = time.monotonic() - t0
+                    loadgen_cpu[idx] = time.thread_time() - cpu0
                     results[idx] = (counted, failed, elapsed)
                 except Exception as exc:    # noqa: BLE001
                     barrier.abort()
@@ -1899,10 +1933,22 @@ def bench_router_relay(*, duration_s: float = 2.0,
                        for i in range(n_threads)]
             for t in threads:
                 t.start()
+            # Router CPU accounting: process_time() sums EVERY thread
+            # in this process (router selector/handlers + loadgen);
+            # subtracting each loadgen thread's own thread_time()
+            # leaves the router's share. Echo engines are subprocesses
+            # — excluded by construction.
+            try:
+                barrier.wait(timeout=300.0)
+            except threading.BrokenBarrierError:
+                pass                    # a worker failed; errors below
+            proc_cpu0 = time.process_time()
             for t in threads:
                 t.join(timeout=600.0)
+            proc_cpu = time.process_time() - proc_cpu0
             frontend.stop()
             router.stop()
+            proto.set_backend(prev_parse)
             if sink is not None:
                 sink.close()
             if span_dir is not None:
@@ -1914,27 +1960,51 @@ def bench_router_relay(*, duration_s: float = 2.0,
             # Sum of per-thread steady-state rates: each thread times
             # its own window, so a long final round cannot skew it.
             qps = sum(c / e for c, _f, e in good if e > 0)
+            counted = sum(c for c, _f, _e in good)
+            router_cpu = max(proc_cpu - sum(loadgen_cpu.values()), 0.0)
+            cpu_us = (router_cpu / counted * 1e6) if counted else None
             return {
-                "wire_backend": backend_name,
+                "wire_backend": wire_backend,
+                "parse_backend": parse_backend,
                 "qps": round(qps, 1),
+                "router_cpu_s": round(router_cpu, 4),
+                "cpu_us_per_req": (round(cpu_us, 2)
+                                   if cpu_us is not None else None),
                 "failed": sum(f for _c, f, _e in good),
                 "errors": errors[:4],
                 "connections": connections,
             }
 
+        native_ok = proto.native_available()
+        arm_defs = [("threaded", "threaded", "py"),
+                    ("evloop_py", "evloop", "py")]
+        if native_ok:
+            arm_defs.append(("evloop_native", "evloop", "native"))
         scan = []
-        arms: dict = {"threaded": [], "evloop": []}
+        arms: dict = {name: [] for name, _, _ in arm_defs}
         for conns in scan_connections:
             point: dict = {"connections": conns}
-            for name in ("threaded", "evloop"):
-                arm = run_arm(name, conns)
+            for name, wb, pb in arm_defs:
+                arm = run_arm(wb, pb, conns)
                 arms[name].append(arm)
                 point[f"{name}_qps"] = arm["qps"]
+                point[f"{name}_cpu_us_per_req"] = arm["cpu_us_per_req"]
                 point[f"{name}_failed"] = (arm["failed"]
                                            + len(arm["errors"]))
+            best_ev = point.get("evloop_native_qps",
+                                point["evloop_py_qps"])
             point["ratio"] = round(
-                point["evloop_qps"]
-                / max(point["threaded_qps"], 1e-9), 2)
+                best_ev / max(point["threaded_qps"], 1e-9), 2)
+            if native_ok:
+                point["native_vs_py_qps"] = round(
+                    point["evloop_native_qps"]
+                    / max(point["evloop_py_qps"], 1e-9), 2)
+                py_cpu = point["evloop_py_cpu_us_per_req"]
+                nat_cpu = point["evloop_native_cpu_us_per_req"]
+                point["native_vs_py_cpu"] = (
+                    round(py_cpu / max(nat_cpu, 1e-9), 2)
+                    if py_cpu is not None and nat_cpu is not None
+                    else None)
             scan.append(point)
 
         def at_90pct(points: list) -> int:
@@ -1947,15 +2017,23 @@ def bench_router_relay(*, duration_s: float = 2.0,
 
         threaded = dict(arms["threaded"][-1],
                         conns_at_90pct=at_90pct(arms["threaded"]))
-        evloop = dict(arms["evloop"][-1],
-                      conns_at_90pct=at_90pct(arms["evloop"]))
+        evloop_py = dict(arms["evloop_py"][-1],
+                         conns_at_90pct=at_90pct(arms["evloop_py"]))
+        evloop_native = (dict(arms["evloop_native"][-1],
+                              conns_at_90pct=at_90pct(
+                                  arms["evloop_native"]))
+                         if native_ok else None)
+        # Headline arm: the production default — native when built,
+        # the Python parser otherwise.
+        evloop = evloop_native if native_ok else evloop_py
 
         # Tracing A/B (see docstring): runs AFTER the scan so the gate
         # series above is untouched.
+        ab_pb = "native" if native_ok else "py"
         ab_conns = scan_connections[0]
-        aa1 = run_arm("evloop", ab_conns)
-        aa2 = run_arm("evloop", ab_conns)
-        traced_arm = run_arm("evloop", ab_conns, traced=True)
+        aa1 = run_arm("evloop", ab_pb, ab_conns)
+        aa2 = run_arm("evloop", ab_pb, ab_conns)
+        traced_arm = run_arm("evloop", ab_pb, ab_conns, traced=True)
         off_qps = (aa1["qps"] + aa2["qps"]) / 2.0
         aa_spread_pct = (abs(aa1["qps"] - aa2["qps"])
                          / max(off_qps, 1e-9) * 100.0)
@@ -1981,6 +2059,17 @@ def bench_router_relay(*, duration_s: float = 2.0,
                 proc.kill()
 
     speedup = evloop["qps"] / max(threaded["qps"], 1e-9)
+    native_qps_ratio = native_cpu_ratio = None
+    accepted_native = None
+    if evloop_native is not None:
+        native_qps_ratio = round(
+            evloop_native["qps"] / max(evloop_py["qps"], 1e-9), 2)
+        py_cpu = evloop_py["cpu_us_per_req"]
+        nat_cpu = evloop_native["cpu_us_per_req"]
+        if py_cpu is not None and nat_cpu is not None:
+            native_cpu_ratio = round(py_cpu / max(nat_cpu, 1e-9), 2)
+        accepted_native = (native_qps_ratio >= 2.5
+                           or (native_cpu_ratio or 0.0) >= 2.5)
     return {
         **_result_envelope(),
         "metric": "router_relay_qps",
@@ -1990,18 +2079,25 @@ def bench_router_relay(*, duration_s: float = 2.0,
         "echo_engines": echo_engines,
         "threaded": threaded,
         "evloop": evloop,
+        "evloop_py": evloop_py,
+        "evloop_native": evloop_native,
         "scan": scan,
         "speedup": round(speedup, 1),
         "accepted_10x": speedup >= 10.0,
+        "native_vs_py_qps": native_qps_ratio,
+        "native_vs_py_cpu": native_cpu_ratio,
+        "accepted_native_2p5x": accepted_native,
         "tracing_ab": tracing_ab,
         "note": (f"pure relay cost through one router process "
                  f"(keep-alive conns scanned over {list(scan_connections)}, "
                  f"{pipeline}-deep pipelines, loopback echo subprocesses; "
                  "engine compute subtracted by construction). On a "
                  "single-vCPU host loadgen+router+echo share one core, "
-                 "so the qps ratio understates the structural gap; the "
+                 "so qps ratios understate the structural gap; the "
                  "scaling slope (threaded degrades per conn, evloop "
-                 "flat) is the load-bearing reading there"),
+                 "flat) and router CPU-time/request (native sheds "
+                 "interpreter parse/render work) are the load-bearing "
+                 "readings there"),
     }
 
 

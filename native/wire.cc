@@ -1,0 +1,911 @@
+// Native wire hot path: the fleet's HTTP/1.1 parse/render state
+// machines as a CPython extension (module name: stwire).
+//
+// This file is the C twin of sharetrade_tpu/fleet/proto.py — the
+// sans-IO protocol core — with the EXACT same event semantics:
+// Content-Length-only framing, MAX_HEAD/MAX_BODY refusal before
+// buffering, torn reads at any byte offset, pipelining, last-wins
+// lower-cased header dicts, the HTTP/1.0-vs-1.1 keep-alive folding,
+// and byte-identical render_request/render_response output. The
+// Python parsers survive as the differential oracle
+// (tests/test_fleet_wire.py replays seeded corpora through both and
+// requires identical event streams and identical ProtocolError
+// statuses).
+//
+// Binding contract (lint check 18):
+// - the ONLY Python module that loads this extension is
+//   fleet/proto.py (the backend dispatch seam);
+// - the byte-level parse and render cores run with the GIL RELEASED
+//   (Py_BEGIN_ALLOW_THREADS pairing below), so the evloop's selector
+//   thread stops serializing against engine-dispatch callbacks and
+//   loadgen threads while it frames bytes;
+// - the extension holds REFERENCES to proto.py's Request / Response /
+//   ProtocolError classes (configure() below) instead of defining its
+//   own, so events and exceptions are the same Python types under
+//   both backends — `except proto.ProtocolError` and isinstance
+//   checks never see a backend difference.
+//
+// Error-detail fidelity: the detail strings replicate proto.py's
+// f-strings including Python's repr() of the offending bytes/str, so
+// the differential tests can compare .detail, not just .status.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr size_t MAX_HEAD_BYTES = 16384;
+constexpr long long MAX_BODY_BYTES = 1LL << 26;
+
+// ---- Python-repr replicas (for ProtocolError detail parity) --------
+
+bool needs_double_quote(const std::string &s) {
+  return s.find('\'') != std::string::npos &&
+         s.find('"') == std::string::npos;
+}
+
+// Python bytes.__repr__: b'...' (double quotes iff ' present, " not).
+std::string bytes_repr(const std::string &s) {
+  char quote = needs_double_quote(s) ? '"' : '\'';
+  std::string out = "b";
+  out += quote;
+  char hex[8];
+  for (unsigned char c : s) {
+    if (c == static_cast<unsigned char>(quote) || c == '\\') {
+      out += '\\';
+      out += static_cast<char>(c);
+    } else if (c == '\t') {
+      out += "\\t";
+    } else if (c == '\n') {
+      out += "\\n";
+    } else if (c == '\r') {
+      out += "\\r";
+    } else if (c >= 0x20 && c < 0x7f) {
+      out += static_cast<char>(c);
+    } else {
+      std::snprintf(hex, sizeof hex, "\\x%02x", c);
+      out += hex;
+    }
+  }
+  out += quote;
+  return out;
+}
+
+// Python str.__repr__ over a latin-1 string: printable latin-1 stays
+// literal (the result is later decoded latin-1 into the detail str);
+// C0/C1 controls, DEL, NBSP and SOFT HYPHEN escape as \xHH, matching
+// CPython's unicode printability rules for the latin-1 range.
+std::string str_repr_latin1(const std::string &s) {
+  char quote = needs_double_quote(s) ? '"' : '\'';
+  std::string out;
+  out += quote;
+  char hex[8];
+  for (unsigned char c : s) {
+    if (c == static_cast<unsigned char>(quote) || c == '\\') {
+      out += '\\';
+      out += static_cast<char>(c);
+    } else if (c == '\t') {
+      out += "\\t";
+    } else if (c == '\n') {
+      out += "\\n";
+    } else if (c == '\r') {
+      out += "\\r";
+    } else if (c < 0x20 || c == 0x7f || (c >= 0x80 && c <= 0xa0) ||
+               c == 0xad) {
+      std::snprintf(hex, sizeof hex, "\\x%02x", c);
+      out += hex;
+    } else {
+      out += static_cast<char>(c);
+    }
+  }
+  out += quote;
+  return out;
+}
+
+// ---- pure-C parse core (no Python API: runs GIL-free) --------------
+
+struct Header {
+  std::string name;     // lowered (latin-1 rules) unless needs_py_lower
+  std::string raw_name; // original stripped bytes (for the 0xB5 case)
+  std::string value;    // stripped, raw latin-1 bytes
+  bool needs_py_lower;  // contains U+00B5 (lowers outside latin-1)
+};
+
+struct Msg {
+  std::string method;
+  std::string target;
+  long long status = 0;
+  std::vector<Header> headers;
+  std::string body;
+  bool keep_alive = true;
+};
+
+struct Err {
+  bool set = false;
+  int status = 400;
+  std::string detail; // latin-1 bytes of the detail string
+  void fail(const std::string &d) {
+    set = true;
+    detail = d;
+  }
+};
+
+bool is_ascii_ws(unsigned char c) {
+  return c == ' ' || (c >= 9 && c <= 13);
+}
+
+std::string strip_ascii(const std::string &s) {
+  size_t b = 0, e = s.size();
+  while (b < e && is_ascii_ws(s[b])) ++b;
+  while (e > b && is_ascii_ws(s[e - 1])) --e;
+  return s.substr(b, e - b);
+}
+
+// str.lower() restricted to latin-1: ASCII A-Z and U+00C0-U+00DE
+// (minus the multiplication sign U+00D7) gain 0x20; U+00B5 (MICRO
+// SIGN) lowers to U+03BC — OUTSIDE latin-1 — so such names defer to
+// Python's str.lower at event-construction time for exactness.
+void lower_latin1(const std::string &raw, std::string *out,
+                  bool *needs_py) {
+  *needs_py = false;
+  out->clear();
+  out->reserve(raw.size());
+  for (unsigned char c : raw) {
+    if (c == 0xb5) *needs_py = true;
+    if ((c >= 'A' && c <= 'Z') ||
+        (c >= 0xc0 && c <= 0xde && c != 0xd7)) {
+      out->push_back(static_cast<char>(c + 0x20));
+    } else {
+      out->push_back(static_cast<char>(c));
+    }
+  }
+}
+
+bool ascii_ieq(const std::string &a, const char *b) {
+  size_t n = std::strlen(b);
+  if (a.size() != n) return false;
+  for (size_t i = 0; i < n; ++i) {
+    unsigned char c = a[i];
+    if (c >= 'A' && c <= 'Z') c += 0x20;
+    if (c != static_cast<unsigned char>(b[i])) return false;
+  }
+  return true;
+}
+
+// int(str(v).strip()) with Python's rules: unicode-whitespace strip
+// (latin-1 subset), optional sign, ASCII decimal digits with single
+// underscores BETWEEN digits. Returns 0 ok / 1 malformed /
+// 2 negative / 3 over-limit; *canon is the canonical decimal (the
+// {n} in proto.py's over-limit message).
+int parse_content_length(const std::string &v, long long *out_n,
+                         std::string *canon) {
+  auto is_uws = [](unsigned char c) {
+    return c == ' ' || (c >= 9 && c <= 13) || (c >= 0x1c && c <= 0x1f) ||
+           c == 0x85 || c == 0xa0;
+  };
+  size_t b = 0, e = v.size();
+  while (b < e && is_uws(v[b])) ++b;
+  while (e > b && is_uws(v[e - 1])) --e;
+  if (b == e) return 1;
+  bool neg = false;
+  size_t i = b;
+  if (v[i] == '+' || v[i] == '-') {
+    neg = v[i] == '-';
+    ++i;
+  }
+  if (i == e) return 1;
+  std::string digits;
+  bool prev_digit = false;
+  for (; i < e; ++i) {
+    unsigned char c = v[i];
+    if (c >= '0' && c <= '9') {
+      digits += static_cast<char>(c);
+      prev_digit = true;
+    } else if (c == '_') {
+      if (!prev_digit) return 1; // leading / doubled underscore
+      prev_digit = false;
+    } else {
+      return 1;
+    }
+  }
+  if (!prev_digit) return 1; // trailing underscore
+  size_t z = 0;
+  while (z + 1 < digits.size() && digits[z] == '0') ++z;
+  std::string d = digits.substr(z);
+  if (neg && d != "0") return 2; // int("-0") == 0, not negative
+  *canon = d;
+  if (d.size() > 18) return 3; // beyond long long: certainly > MAX
+  long long n = 0;
+  for (char c : d) n = n * 10 + (c - '0');
+  *out_n = n;
+  if (n > MAX_BODY_BYTES) return 3;
+  return 0;
+}
+
+// int(bytes) for the status token: optional sign, ASCII digits with
+// single underscores between digits. NO unicode-whitespace stripping
+// (that is content_length's int(str.strip()) path, not this one) —
+// and the token, produced by an ASCII-whitespace split, can hold no
+// ASCII whitespace anyway. Returns false on Python's ValueError.
+bool parse_int_token(const std::string &s, long long *out) {
+  size_t i = 0, e = s.size();
+  if (i == e) return false;
+  bool neg = false;
+  if (s[i] == '+' || s[i] == '-') {
+    neg = s[i] == '-';
+    ++i;
+  }
+  if (i == e) return false;
+  bool prev_digit = false;
+  long long n = 0;
+  size_t digits = 0;
+  for (; i < e; ++i) {
+    unsigned char c = s[i];
+    if (c >= '0' && c <= '9') {
+      if (digits < 18) n = n * 10 + (c - '0');
+      ++digits;
+      prev_digit = true;
+    } else if (c == '_') {
+      if (!prev_digit) return false;
+      prev_digit = false;
+    } else {
+      return false;
+    }
+  }
+  if (!prev_digit) return false;
+  if (digits > 18) return false; // beyond long long; no real status is
+  *out = neg ? -n : n;
+  return true;
+}
+
+void content_length_error(int rc, const std::string &raw_value,
+                          const std::string &canon, Err *err) {
+  if (rc == 1) {
+    err->fail("malformed Content-Length " + str_repr_latin1(raw_value));
+  } else if (rc == 2) {
+    err->fail("negative Content-Length " + str_repr_latin1(raw_value));
+  } else {
+    err->fail("declared body of " + canon + " bytes exceeds the " +
+              std::to_string(MAX_BODY_BYTES) + "-byte limit");
+  }
+}
+
+// bytes.split() (any ASCII-whitespace run) with optional maxsplit.
+std::vector<std::string> ws_split(const std::string &s, int maxsplit) {
+  std::vector<std::string> out;
+  size_t i = 0, n = s.size();
+  while (i < n) {
+    while (i < n && is_ascii_ws(s[i])) ++i;
+    if (i >= n) break;
+    if (maxsplit >= 0 && static_cast<int>(out.size()) == maxsplit) {
+      out.push_back(s.substr(i));
+      break;
+    }
+    size_t j = i;
+    while (j < n && !is_ascii_ws(s[j])) ++j;
+    out.push_back(s.substr(i, j - i));
+    i = j;
+  }
+  return out;
+}
+
+std::vector<std::string> crlf_split(const std::string &s) {
+  std::vector<std::string> out;
+  size_t start = 0;
+  for (;;) {
+    size_t idx = s.find("\r\n", start);
+    if (idx == std::string::npos) {
+      out.push_back(s.substr(start));
+      return out;
+    }
+    out.push_back(s.substr(start, idx - start));
+    start = idx + 2;
+  }
+}
+
+// _parse_headers: partition on ':', both sides ASCII-stripped, name
+// lowered, LAST occurrence wins (resolved at dict build / lookup).
+bool parse_header_lines(const std::vector<std::string> &lines,
+                        size_t first, std::vector<Header> *out,
+                        Err *err) {
+  for (size_t i = first; i < lines.size(); ++i) {
+    const std::string &line = lines[i];
+    size_t colon = line.find(':');
+    std::string raw_name =
+        strip_ascii(colon == std::string::npos ? line
+                                               : line.substr(0, colon));
+    if (colon == std::string::npos || raw_name.empty()) {
+      err->fail("malformed header line " + bytes_repr(line));
+      return false;
+    }
+    Header h;
+    h.raw_name = raw_name;
+    lower_latin1(raw_name, &h.name, &h.needs_py_lower);
+    h.value = strip_ascii(line.substr(colon + 1));
+    out->push_back(std::move(h));
+  }
+  return true;
+}
+
+// headers.get(name): last-wins over the parse order.
+const Header *find_header(const std::vector<Header> &headers,
+                          const char *lowered) {
+  for (size_t i = headers.size(); i > 0; --i) {
+    if (headers[i - 1].name == lowered) return &headers[i - 1];
+  }
+  return nullptr;
+}
+
+struct WireCore {
+  bool is_request;
+  std::string buf;
+  bool have_head = false;
+  Msg head; // parsed head awaiting its body
+  size_t need = 0;
+
+  explicit WireCore(bool req) : is_request(req) {}
+
+  bool pending() const { return !buf.empty() || have_head; }
+
+  // Returns false on protocol error (err set); completed messages are
+  // appended to *out in arrival order.
+  bool feed(const char *data, size_t n, std::vector<Msg> *out,
+            Err *err) {
+    buf.append(data, n);
+    for (;;) {
+      if (!have_head) {
+        size_t idx = buf.find("\r\n\r\n");
+        if (idx == std::string::npos) {
+          if (buf.size() > MAX_HEAD_BYTES) {
+            err->fail("header block exceeds " +
+                      std::to_string(MAX_HEAD_BYTES) + " bytes");
+            return false;
+          }
+          return true;
+        }
+        if (idx > MAX_HEAD_BYTES) {
+          err->fail("header block exceeds " +
+                    std::to_string(MAX_HEAD_BYTES) + " bytes");
+          return false;
+        }
+        std::string head_bytes = buf.substr(0, idx);
+        buf.erase(0, idx + 4); // consumed before parse, like proto.py
+        head = Msg();
+        if (!(is_request ? parse_request_head(head_bytes, err)
+                         : parse_response_head(head_bytes, err))) {
+          return false;
+        }
+        have_head = true;
+      }
+      if (buf.size() < need) return true;
+      head.body = buf.substr(0, need);
+      buf.erase(0, need);
+      have_head = false;
+      out->push_back(std::move(head));
+      head = Msg();
+    }
+  }
+
+  bool parse_request_head(const std::string &head_bytes, Err *err) {
+    std::vector<std::string> lines = crlf_split(head_bytes);
+    std::vector<std::string> parts = ws_split(lines[0], -1);
+    if (parts.size() != 3) {
+      err->fail("malformed request line " + bytes_repr(lines[0]));
+      return false;
+    }
+    const std::string &version = parts[2];
+    if (version.compare(0, 7, "HTTP/1.") != 0) {
+      err->fail("unsupported version " + bytes_repr(version));
+      return false;
+    }
+    if (!parse_header_lines(lines, 1, &head.headers, err)) return false;
+    const Header *conn = find_header(head.headers, "connection");
+    if (version == "HTTP/1.0") {
+      head.keep_alive = conn != nullptr && ascii_ieq(conn->value,
+                                                     "keep-alive");
+    } else {
+      head.keep_alive = conn == nullptr || !ascii_ieq(conn->value,
+                                                      "close");
+    }
+    head.method = parts[0];
+    head.target = parts[1];
+    const Header *cl = find_header(head.headers, "content-length");
+    need = 0;
+    if (cl != nullptr) {
+      long long n = 0;
+      std::string canon;
+      int rc = parse_content_length(cl->value, &n, &canon);
+      if (rc != 0) {
+        content_length_error(rc, cl->value, canon, err);
+        return false;
+      }
+      need = static_cast<size_t>(n);
+    }
+    return true;
+  }
+
+  bool parse_response_head(const std::string &head_bytes, Err *err) {
+    std::vector<std::string> lines = crlf_split(head_bytes);
+    std::vector<std::string> parts = ws_split(lines[0], 2);
+    if (parts.size() < 2 ||
+        parts[0].compare(0, 7, "HTTP/1.") != 0) {
+      err->fail("malformed status line " + bytes_repr(lines[0]));
+      return false;
+    }
+    long long status = 0;
+    if (!parse_int_token(parts[1], &status)) {
+      err->fail("malformed status line " + bytes_repr(lines[0]));
+      return false;
+    }
+    head.status = status;
+    if (!parse_header_lines(lines, 1, &head.headers, err)) return false;
+    const Header *cl = find_header(head.headers, "content-length");
+    if (cl == nullptr) {
+      err->fail("response without Content-Length on a keep-alive "
+                "connection");
+      return false;
+    }
+    long long n = 0;
+    std::string canon2;
+    int body_rc = parse_content_length(cl->value, &n, &canon2);
+    if (body_rc != 0) {
+      content_length_error(body_rc, cl->value, canon2, err);
+      return false;
+    }
+    need = static_cast<size_t>(n);
+    return true;
+  }
+};
+
+// ---- Python binding ------------------------------------------------
+
+PyObject *g_request_cls = nullptr;
+PyObject *g_response_cls = nullptr;
+PyObject *g_protocol_error = nullptr;
+
+int raise_protocol_error(const Err &err) {
+  if (g_protocol_error == nullptr) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "stwire.configure() was never called");
+    return -1;
+  }
+  PyObject *detail = PyUnicode_DecodeLatin1(err.detail.data(),
+                                            err.detail.size(), nullptr);
+  if (detail == nullptr) return -1;
+  PyObject *args = PyTuple_Pack(1, detail);
+  Py_DECREF(detail);
+  if (args == nullptr) return -1;
+  PyObject *kwargs = Py_BuildValue("{s:i}", "status", err.status);
+  if (kwargs == nullptr) {
+    Py_DECREF(args);
+    return -1;
+  }
+  PyObject *exc = PyObject_Call(g_protocol_error, args, kwargs);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  if (exc == nullptr) return -1;
+  PyErr_SetObject(g_protocol_error, exc);
+  Py_DECREF(exc);
+  return -1;
+}
+
+PyObject *headers_to_dict(const std::vector<Header> &headers) {
+  PyObject *dict = PyDict_New();
+  if (dict == nullptr) return nullptr;
+  for (const Header &h : headers) {
+    PyObject *key;
+    if (h.needs_py_lower) {
+      // U+00B5 lowers outside latin-1: defer to str.lower for the
+      // exact CPython mapping.
+      PyObject *raw = PyUnicode_DecodeLatin1(h.raw_name.data(),
+                                             h.raw_name.size(), nullptr);
+      if (raw == nullptr) {
+        Py_DECREF(dict);
+        return nullptr;
+      }
+      key = PyObject_CallMethod(raw, "lower", nullptr);
+      Py_DECREF(raw);
+    } else {
+      key = PyUnicode_DecodeLatin1(h.name.data(), h.name.size(),
+                                   nullptr);
+    }
+    if (key == nullptr) {
+      Py_DECREF(dict);
+      return nullptr;
+    }
+    PyObject *value = PyUnicode_DecodeLatin1(h.value.data(),
+                                             h.value.size(), nullptr);
+    if (value == nullptr) {
+      Py_DECREF(key);
+      Py_DECREF(dict);
+      return nullptr;
+    }
+    int rc = PyDict_SetItem(dict, key, value); // last-wins, like proto
+    Py_DECREF(key);
+    Py_DECREF(value);
+    if (rc < 0) {
+      Py_DECREF(dict);
+      return nullptr;
+    }
+  }
+  return dict;
+}
+
+PyObject *build_event(bool is_request, const Msg &msg) {
+  PyObject *headers = headers_to_dict(msg.headers);
+  if (headers == nullptr) return nullptr;
+  PyObject *body = PyBytes_FromStringAndSize(msg.body.data(),
+                                             static_cast<Py_ssize_t>(
+                                                 msg.body.size()));
+  if (body == nullptr) {
+    Py_DECREF(headers);
+    return nullptr;
+  }
+  PyObject *event = nullptr;
+  if (is_request) {
+    PyObject *method = PyUnicode_DecodeLatin1(msg.method.data(),
+                                              msg.method.size(), nullptr);
+    PyObject *target =
+        method == nullptr
+            ? nullptr
+            : PyUnicode_DecodeLatin1(msg.target.data(),
+                                     msg.target.size(), nullptr);
+    if (target != nullptr) {
+      event = PyObject_CallFunctionObjArgs(
+          g_request_cls, method, target, headers, body,
+          msg.keep_alive ? Py_True : Py_False, nullptr);
+    }
+    Py_XDECREF(method);
+    Py_XDECREF(target);
+  } else {
+    PyObject *status = PyLong_FromLongLong(msg.status);
+    if (status != nullptr) {
+      event = PyObject_CallFunctionObjArgs(g_response_cls, status,
+                                           headers, body, nullptr);
+      Py_DECREF(status);
+    }
+  }
+  Py_DECREF(headers);
+  Py_DECREF(body);
+  return event;
+}
+
+struct ParserObject {
+  PyObject_HEAD
+  WireCore *core;
+};
+
+extern PyTypeObject RequestParserType;
+extern PyTypeObject ResponseParserType;
+
+int parser_init(PyObject *self, PyObject *args, PyObject *kwargs) {
+  static const char *kwlist[] = {nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, ":Parser",
+                                   const_cast<char **>(kwlist))) {
+    return -1;
+  }
+  ParserObject *p = reinterpret_cast<ParserObject *>(self);
+  delete p->core;
+  p->core = new WireCore(Py_TYPE(self) == &RequestParserType);
+  return 0;
+}
+
+void parser_dealloc(PyObject *self) {
+  ParserObject *p = reinterpret_cast<ParserObject *>(self);
+  delete p->core;
+  p->core = nullptr;
+  Py_TYPE(self)->tp_free(self);
+}
+
+PyObject *parser_feed(PyObject *self, PyObject *args) {
+  Py_buffer view;
+  if (!PyArg_ParseTuple(args, "y*:feed", &view)) return nullptr;
+  ParserObject *p = reinterpret_cast<ParserObject *>(self);
+  if (p->core == nullptr || g_request_cls == nullptr) {
+    PyBuffer_Release(&view);
+    PyErr_SetString(PyExc_RuntimeError,
+                    "stwire parser used before configure()");
+    return nullptr;
+  }
+  std::vector<Msg> msgs;
+  Err err;
+  bool ok;
+  // The framing core touches only C buffers: release the GIL so the
+  // selector thread's parse overlaps engine callbacks and loadgen.
+  Py_BEGIN_ALLOW_THREADS
+  ok = p->core->feed(static_cast<const char *>(view.buf),
+                     static_cast<size_t>(view.len), &msgs, &err);
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&view);
+  if (!ok) {
+    raise_protocol_error(err);
+    return nullptr;
+  }
+  PyObject *out = PyList_New(static_cast<Py_ssize_t>(msgs.size()));
+  if (out == nullptr) return nullptr;
+  for (size_t i = 0; i < msgs.size(); ++i) {
+    PyObject *event = build_event(p->core->is_request, msgs[i]);
+    if (event == nullptr) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, static_cast<Py_ssize_t>(i), event);
+  }
+  return out;
+}
+
+PyObject *parser_pending_bytes(PyObject *self, PyObject *) {
+  ParserObject *p = reinterpret_cast<ParserObject *>(self);
+  if (p->core != nullptr && p->core->pending()) Py_RETURN_TRUE;
+  Py_RETURN_FALSE;
+}
+
+PyMethodDef parser_methods[] = {
+    {"feed", parser_feed, METH_VARARGS,
+     "Feed any slice of the byte stream; returns every message "
+     "COMPLETED by it, in order (proto.py feed contract)."},
+    {"pending_bytes", parser_pending_bytes, METH_NOARGS,
+     "True if buffered bytes of an incomplete message are held."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+#pragma GCC diagnostic push
+#pragma GCC diagnostic ignored "-Wmissing-field-initializers"
+PyTypeObject RequestParserType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "stwire.RequestParser",        // tp_name
+    sizeof(ParserObject),          // tp_basicsize
+    0,                             // tp_itemsize
+    parser_dealloc,                // tp_dealloc
+};
+
+PyTypeObject ResponseParserType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "stwire.ResponseParser",       // tp_name
+    sizeof(ParserObject),          // tp_basicsize
+    0,                             // tp_itemsize
+    parser_dealloc,                // tp_dealloc
+};
+#pragma GCC diagnostic pop
+
+// ---- renderers -----------------------------------------------------
+
+// str(obj) encoded latin-1 into *out; false (exception set) on a
+// non-latin-1 char — the same UnicodeEncodeError class proto.py's
+// .encode("latin-1") raises.
+bool obj_to_latin1(PyObject *obj, std::string *out) {
+  PyObject *text = PyObject_Str(obj);
+  if (text == nullptr) return false;
+  PyObject *raw = PyUnicode_AsLatin1String(text);
+  Py_DECREF(text);
+  if (raw == nullptr) return false;
+  out->assign(PyBytes_AS_STRING(raw),
+              static_cast<size_t>(PyBytes_GET_SIZE(raw)));
+  Py_DECREF(raw);
+  return true;
+}
+
+// (headers or {}).items() in insertion order; false on exception.
+bool collect_header_pairs(
+    PyObject *headers,
+    std::vector<std::pair<std::string, std::string>> *out) {
+  if (headers == nullptr || headers == Py_None) return true;
+  int truthy = PyObject_IsTrue(headers);
+  if (truthy < 0) return false;
+  if (truthy == 0) return true;
+  PyObject *items = PyObject_CallMethod(headers, "items", nullptr);
+  if (items == nullptr) return false;
+  PyObject *fast = PySequence_Fast(items, "headers.items() is not "
+                                          "iterable");
+  Py_DECREF(items);
+  if (fast == nullptr) return false;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *pair = PySequence_Fast_GET_ITEM(fast, i);
+    PyObject *key = PySequence_GetItem(pair, 0);
+    PyObject *value = key ? PySequence_GetItem(pair, 1) : nullptr;
+    std::string k, v;
+    bool ok = value != nullptr && obj_to_latin1(key, &k) &&
+              obj_to_latin1(value, &v);
+    Py_XDECREF(key);
+    Py_XDECREF(value);
+    if (!ok) {
+      Py_DECREF(fast);
+      return false;
+    }
+    out->emplace_back(std::move(k), std::move(v));
+  }
+  Py_DECREF(fast);
+  return true;
+}
+
+PyObject *assemble(const std::vector<std::string> &head,
+                   const char *body, size_t body_len) {
+  std::string wire;
+  // Pure byte assembly — GIL released (all inputs are C strings).
+  Py_BEGIN_ALLOW_THREADS
+  size_t total = 2 + body_len; // final "\r\n" + body
+  for (const std::string &line : head) total += line.size() + 2;
+  wire.reserve(total);
+  for (const std::string &line : head) {
+    wire += line;
+    wire += "\r\n";
+  }
+  wire += "\r\n";
+  wire.append(body, body_len);
+  Py_END_ALLOW_THREADS
+  return PyBytes_FromStringAndSize(wire.data(),
+                                   static_cast<Py_ssize_t>(wire.size()));
+}
+
+PyObject *wire_render_request(PyObject *, PyObject *args,
+                              PyObject *kwargs) {
+  static const char *kwlist[] = {"method", "target", "host", "body",
+                                 "headers", nullptr};
+  PyObject *method, *target, *host;
+  Py_buffer body = {};
+  PyObject *headers = nullptr;
+  if (!PyArg_ParseTupleAndKeywords(
+          args, kwargs, "OOO|y*O:render_request",
+          const_cast<char **>(kwlist), &method, &target, &host, &body,
+          &headers)) {
+    return nullptr;
+  }
+  PyObject *result = nullptr;
+  std::string m, t, h;
+  std::vector<std::pair<std::string, std::string>> extra;
+  if (obj_to_latin1(method, &m) && obj_to_latin1(target, &t) &&
+      obj_to_latin1(host, &h) && collect_header_pairs(headers, &extra)) {
+    std::vector<std::string> head;
+    head.push_back(m + " " + t + " HTTP/1.1");
+    head.push_back("Host: " + h);
+    head.push_back("Content-Length: " +
+                   std::to_string(body.obj ? body.len : 0));
+    for (const auto &kv : extra) {
+      head.push_back(kv.first + ": " + kv.second);
+    }
+    result = assemble(head,
+                      body.obj ? static_cast<const char *>(body.buf)
+                               : "",
+                      body.obj ? static_cast<size_t>(body.len) : 0);
+  }
+  if (body.obj) PyBuffer_Release(&body);
+  return result;
+}
+
+const char *reason_for(long long status) {
+  switch (status) {
+    case 200: return "OK";
+    case 400: return "Bad Request";
+    case 404: return "Not Found";
+    case 429: return "Too Many Requests";
+    case 500: return "Internal Server Error";
+    case 503: return "Service Unavailable";
+    case 504: return "Gateway Timeout";
+    default: return "Unknown";
+  }
+}
+
+PyObject *wire_render_response(PyObject *, PyObject *args,
+                               PyObject *kwargs) {
+  static const char *kwlist[] = {"status", "body", "content_type",
+                                 "keep_alive", "extra_headers", nullptr};
+  long long status;
+  Py_buffer body = {};
+  const char *content_type = "application/json";
+  int keep_alive = 1;
+  PyObject *extra_headers = nullptr;
+  if (!PyArg_ParseTupleAndKeywords(
+          args, kwargs, "Ly*|s$pO:render_response",
+          const_cast<char **>(kwlist), &status, &body, &content_type,
+          &keep_alive, &extra_headers)) {
+    return nullptr;
+  }
+  PyObject *result = nullptr;
+  std::vector<std::pair<std::string, std::string>> extra;
+  if (collect_header_pairs(extra_headers, &extra)) {
+    std::vector<std::string> head;
+    head.push_back("HTTP/1.1 " + std::to_string(status) + " " +
+                   reason_for(status));
+    head.push_back(std::string("Content-Type: ") + content_type);
+    head.push_back("Content-Length: " + std::to_string(body.len));
+    if (!keep_alive) head.push_back("Connection: close");
+    for (const auto &kv : extra) {
+      head.push_back(kv.first + ": " + kv.second);
+    }
+    result = assemble(head, static_cast<const char *>(body.buf),
+                      static_cast<size_t>(body.len));
+  }
+  PyBuffer_Release(&body);
+  return result;
+}
+
+PyObject *wire_configure(PyObject *, PyObject *args) {
+  PyObject *request_cls, *response_cls, *protocol_error;
+  if (!PyArg_ParseTuple(args, "OOO:configure", &request_cls,
+                        &response_cls, &protocol_error)) {
+    return nullptr;
+  }
+  Py_INCREF(request_cls);
+  Py_INCREF(response_cls);
+  Py_INCREF(protocol_error);
+  Py_XDECREF(g_request_cls);
+  Py_XDECREF(g_response_cls);
+  Py_XDECREF(g_protocol_error);
+  g_request_cls = request_cls;
+  g_response_cls = response_cls;
+  g_protocol_error = protocol_error;
+  Py_RETURN_NONE;
+}
+
+PyMethodDef module_methods[] = {
+    {"configure", wire_configure, METH_VARARGS,
+     "configure(Request, Response, ProtocolError): hand the extension "
+     "proto.py's event/exception classes so both backends emit the "
+     "same Python types."},
+    {"render_request",
+     reinterpret_cast<PyCFunction>(
+         reinterpret_cast<void (*)()>(wire_render_request)),
+     METH_VARARGS | METH_KEYWORDS,
+     "Byte-identical twin of proto.render_request."},
+    {"render_response",
+     reinterpret_cast<PyCFunction>(
+         reinterpret_cast<void (*)()>(wire_render_response)),
+     METH_VARARGS | METH_KEYWORDS,
+     "Byte-identical twin of proto.render_response."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef stwire_module = {
+    PyModuleDef_HEAD_INIT,
+    "stwire",
+    "Native HTTP/1.1 parse/render for the fleet wire (the C twin of "
+    "sharetrade_tpu/fleet/proto.py; loaded ONLY through proto.py's "
+    "backend dispatch).",
+    -1,
+    module_methods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+} // namespace
+
+PyMODINIT_FUNC PyInit_stwire(void) {
+  RequestParserType.tp_flags = Py_TPFLAGS_DEFAULT;
+  RequestParserType.tp_doc =
+      "Server side: bytes from a client connection -> Request events.";
+  RequestParserType.tp_methods = parser_methods;
+  RequestParserType.tp_init = parser_init;
+  RequestParserType.tp_new = PyType_GenericNew;
+  ResponseParserType.tp_flags = Py_TPFLAGS_DEFAULT;
+  ResponseParserType.tp_doc =
+      "Client side: bytes from a server connection -> Response events.";
+  ResponseParserType.tp_methods = parser_methods;
+  ResponseParserType.tp_init = parser_init;
+  ResponseParserType.tp_new = PyType_GenericNew;
+  if (PyType_Ready(&RequestParserType) < 0) return nullptr;
+  if (PyType_Ready(&ResponseParserType) < 0) return nullptr;
+  PyObject *mod = PyModule_Create(&stwire_module);
+  if (mod == nullptr) return nullptr;
+  Py_INCREF(&RequestParserType);
+  if (PyModule_AddObject(mod, "RequestParser",
+                         reinterpret_cast<PyObject *>(
+                             &RequestParserType)) < 0) {
+    Py_DECREF(&RequestParserType);
+    Py_DECREF(mod);
+    return nullptr;
+  }
+  Py_INCREF(&ResponseParserType);
+  if (PyModule_AddObject(mod, "ResponseParser",
+                         reinterpret_cast<PyObject *>(
+                             &ResponseParserType)) < 0) {
+    Py_DECREF(&ResponseParserType);
+    Py_DECREF(mod);
+    return nullptr;
+  }
+  return mod;
+}

@@ -331,7 +331,12 @@ def cmd_serve(args) -> int:
             # SIGTERM drains in-flight requests and exits 75 — the same
             # contract as the synthetic-driver mode, over a socket.
             from sharetrade_tpu.fleet import EngineBackend, ServeFrontend
+            from sharetrade_tpu.fleet import proto as fleet_proto
             from sharetrade_tpu.fleet.wire import WireTracer
+            # Pick the HTTP parse/render implementation BEFORE the
+            # front-end spins up ("native" degrades loudly to "py"
+            # when the extension isn't built — proto.set_backend).
+            fleet_proto.set_backend(cfg.fleet.proto_backend)
             host, _, port_s = args.listen.rpartition(":")
             # Span journaling (ISSUE 17): a worker spawned by a tracing
             # fleet carries obs.span_dir/span_proc (fleet/pool.py) and
@@ -354,6 +359,7 @@ def cmd_serve(args) -> int:
                               "host": frontend.host,
                               "port": frontend.port,
                               "pid": os.getpid(),
+                              "proto_backend": fleet_proto.proto_backend,
                               "params_step": step}), flush=True)
             deadline = (time.monotonic() + args.duration
                         if args.duration > 0 else None)
@@ -759,6 +765,11 @@ def cmd_fleet(args) -> int:
             cfg.obs.span_dir = os.path.join(cfg.obs.dir, "spans")
             cfg.obs.span_proc = cfg.obs.span_proc or "fleet"
         obs_bundle = build_obs(cfg, registry)
+        # Pick the HTTP parse/render implementation for the router's
+        # own front-end and FleetClient relay legs before anything
+        # touches the wire; workers pick theirs from the same config.
+        from sharetrade_tpu.fleet import proto as fleet_proto
+        fleet_proto.set_backend(cfg.fleet.proto_backend)
         pool = EnginePool(cfg, registry=registry, symbol=args.symbol,
                           start=args.start, end=args.end).start()
         if preempt_at:
@@ -816,6 +827,7 @@ def cmd_fleet(args) -> int:
                           "target_engines": cfg.fleet.num_engines,
                           "dir": cfg.fleet.dir,
                           "wire_backend": cfg.fleet.wire_backend,
+                          "proto_backend": fleet_proto.proto_backend,
                           "learner": bool(args.learner),
                           "pid": os.getpid()}), flush=True)
 

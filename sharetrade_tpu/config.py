@@ -732,6 +732,16 @@ class FleetConfig:
     # stdlib ThreadingHTTPServer path, retained as the differential-
     # testing oracle (identical wire contract, byte-identical replies).
     wire_backend: str = "evloop"
+    # HTTP parse/render implementation behind fleet/proto.py — the
+    # third rung of the wire ladder (ROADMAP item 2). "native"
+    # (default) = the C extension native/stwire.so (built by `make -C
+    # native`), which frames bytes with the GIL RELEASED; when the
+    # extension is missing or fails to load this degrades to the
+    # Python parser with one loud log line (a mode, not an error).
+    # "py" = the pure-Python state machines, retained as the
+    # differential oracle. Identical event semantics either way —
+    # tests/test_fleet_wire.py replays seeded corpora through both.
+    proto_backend: str = "native"
 
 
 @dataclass

@@ -223,8 +223,9 @@ class _Conn:
                 self.out += memoryview(data)[n:]
         else:
             self.out += data
-        if len(self.out) > _HIGH_WATER:
+        if len(self.out) > _HIGH_WATER and not self._reads_paused:
             self._reads_paused = True
+            self.on_paused()
         if self.close_after_flush and not self.out:
             self.close()
             return
@@ -313,6 +314,11 @@ class _Conn:
     def on_closed(self) -> None:
         pass
 
+    def on_paused(self) -> None:
+        """Reads just paused past high water (backpressure) — the
+        False→True transition only, so subclasses can count pauses
+        rather than bytes-over-water polls."""
+
 
 class _ServerConn(_Conn):
     """One downstream (client-facing) connection: requests parse off
@@ -362,8 +368,12 @@ class _ServerConn(_Conn):
         finally:
             self._pumping = False
 
+    def on_paused(self) -> None:
+        self.fe.note_backpressure()
+
     def on_closed(self) -> None:
         self.fe.conns.discard(self)
+        self.fe.record_open_conns()
         if self.tracked:
             # The client hung up with its request still in flight: the
             # backend call completes into a dead conn, but the in-flight
@@ -422,6 +432,7 @@ class _EngineCall:
         if self.done:
             return
         self.done = True
+        self.fe.note_deadline_expiry()
         self.fe.reply_error(self.conn, ServeEngineFailed(
             f"request did not complete within the front-end budget "
             f"({self.timeout_s:.1f}s)"))
@@ -462,6 +473,14 @@ class EvloopFrontend:
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> "EvloopFrontend":
+        # Selector internals land in /metrics (ISSUE 19): which parse
+        # path is live, how many keep-alive conns are open, and the
+        # counters note_backpressure/note_deadline_expiry bump — the
+        # loop thread stops being a black box.
+        self.registry.record(
+            "fleet_proto_backend_native",
+            1.0 if proto.proto_backend == "native" else 0.0)
+        self.record_open_conns()
         self.loop.add(self._lsock, _READ, self._on_accept)
         # Every connection and request multiplexes onto this single
         # selector thread, never a thread per connection:
@@ -537,7 +556,20 @@ class EvloopFrontend:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn = _ServerConn(self, sock)
             self.conns.add(conn)
+            self.record_open_conns()
             conn.register(_READ)
+
+    # -- selector observability ----------------------------------------
+
+    def record_open_conns(self) -> None:
+        self.registry.record("fleet_evloop_open_conns",
+                             float(len(self.conns)))
+
+    def note_backpressure(self) -> None:
+        self.registry.inc("fleet_evloop_backpressure_pauses_total")
+
+    def note_deadline_expiry(self) -> None:
+        self.registry.inc("fleet_evloop_deadline_expiries_total")
 
     # -- in-flight accounting (loop thread only) -----------------------
 
@@ -717,6 +749,9 @@ class _UpstreamConn(_Conn):
         self.parser = proto.ResponseParser()
         self.call = None
         self.connecting = False
+
+    def on_paused(self) -> None:
+        self.relay.fe.note_backpressure()
 
     def bind(self, call: "_RelayCall") -> None:
         self.call = call
@@ -921,6 +956,7 @@ class _RelayCall:
     def on_timeout(self) -> None:
         if self.done:
             return
+        self.relay.fe.note_deadline_expiry()
         up, self.up = self.up, None
         if up is not None:
             up.call = None

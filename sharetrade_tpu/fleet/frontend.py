@@ -381,6 +381,12 @@ class ThreadedServeFrontend:
         self._thread: threading.Thread | None = None
 
     def start(self) -> "ThreadedServeFrontend":
+        # Which parse/render implementation is live behind proto.py
+        # (native C or Python) — same gauge the evloop records, so
+        # /metrics names the wire path under either backend.
+        self.registry.record(
+            "fleet_proto_backend_native",
+            1.0 if proto.proto_backend == "native" else 0.0)
         self._thread = threading.Thread(
             target=self._server.serve_forever,
             kwargs={"poll_interval": 0.1},

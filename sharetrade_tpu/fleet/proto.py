@@ -228,9 +228,10 @@ class _Parser:
         raise NotImplementedError
 
 
-class RequestParser(_Parser):
+class PyRequestParser(_Parser):
     """Server side: bytes from a client connection → :class:`Request`
-    events."""
+    events. (Pure-Python rung; :data:`RequestParser` below points at
+    whichever backend is live.)"""
 
     def _parse_head(self, head: bytes):
         lines = head.split(b"\r\n")
@@ -255,7 +256,7 @@ class RequestParser(_Parser):
         return Request(method, target, headers, body, keep_alive)
 
 
-class ResponseParser(_Parser):
+class PyResponseParser(_Parser):
     """Client side: bytes from a server connection → :class:`Response`
     events. A response MUST carry Content-Length (module docstring)."""
 
@@ -284,9 +285,9 @@ class ResponseParser(_Parser):
 # ---- rendering ------------------------------------------------------
 
 
-def render_request(method: str, target: str, host: str,
-                   body: bytes = b"",
-                   headers: dict | None = None) -> bytes:
+def py_render_request(method: str, target: str, host: str,
+                      body: bytes = b"",
+                      headers: dict | None = None) -> bytes:
     """Build one request's wire bytes — the exact frame FleetClient has
     always sent (Host + Content-Length + extras, one buffer, ready for
     a single send)."""
@@ -298,10 +299,10 @@ def render_request(method: str, target: str, host: str,
     return ("\r\n".join(head) + "\r\n\r\n").encode("latin-1") + body
 
 
-def render_response(status: int, body: bytes,
-                    content_type: str = "application/json", *,
-                    keep_alive: bool = True,
-                    extra_headers: dict | None = None) -> bytes:
+def py_render_response(status: int, body: bytes,
+                       content_type: str = "application/json", *,
+                       keep_alive: bool = True,
+                       extra_headers: dict | None = None) -> bytes:
     """Build one response's wire bytes. Both wire backends (threaded
     and evloop) render through here, which is what makes their reply
     streams byte-identical — the differential test's precondition."""
@@ -313,3 +314,124 @@ def render_response(status: int, body: bytes,
     for k, v in (extra_headers or {}).items():
         head.append(f"{k}: {v}")
     return ("\r\n".join(head) + "\r\n\r\n").encode("latin-1") + body
+
+
+# ---- backend dispatch (ISSUE 19) ------------------------------------
+#
+# The HTTP/1.1 state machines above exist twice: here in Python (the
+# differential oracle) and in native/wire.cc (the hot path, a CPython
+# extension that releases the GIL around parse/render). Everything on
+# the wire — the evloop, the threaded front-end, FleetClient — reaches
+# the parsers and renderers through THESE module globals, so swapping
+# them swaps the backend for the whole fleet without any caller
+# changing. This module is the ONLY place the extension is loaded
+# (lint_hot_loop check 18 enforces the confinement), and the events
+# and exceptions the native parsers produce are these very classes
+# (stwire.configure hands them over), so `isinstance(ev, Request)` and
+# `except ProtocolError` are backend-blind.
+#
+# Contract: set_backend("native") on a host without the built
+# extension degrades to "py" with ONE loud log line per process and
+# never raises — a missing build is a mode, not an error.
+
+#: The stwire extension module when loaded, else None.
+_NATIVE = None
+#: Why the native load failed (the loud fallback line names it).
+_NATIVE_ERROR = ""
+_FALLBACK_LOGGED = False
+
+#: The backend that is LIVE right now: "native" or "py".
+proto_backend = "py"
+
+
+def _load_native_wire():
+    """Load ``native/stwire.so`` (built by ``make -C native``) as a
+    CPython extension module and hand it this module's event and
+    exception classes. Returns None — recording the reason — rather
+    than raising: callers decide loudness via :func:`set_backend`."""
+    global _NATIVE_ERROR
+    import os
+
+    if os.environ.get("SHARETRADE_WIRE_NATIVE", "1") == "0":
+        _NATIVE_ERROR = "disabled by SHARETRADE_WIRE_NATIVE=0"
+        return None
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "native", "stwire.so")
+    if not os.path.exists(path):
+        _NATIVE_ERROR = "stwire.so not built (run: make -C native)"
+        return None
+    try:
+        from importlib.machinery import ExtensionFileLoader  # native-wire-ok
+        from importlib.util import module_from_spec, spec_from_file_location
+
+        loader = ExtensionFileLoader("stwire", path)
+        spec = spec_from_file_location("stwire", path, loader=loader)
+        mod = module_from_spec(spec)
+        loader.exec_module(mod)
+        mod.configure(Request, Response, ProtocolError)
+    except Exception as exc:  # stale ABI, bad build, ...
+        _NATIVE_ERROR = f"{type(exc).__name__}: {exc}"
+        return None
+    return mod
+
+
+def native_available() -> bool:
+    """True when the native wire extension loaded (and "native" would
+    really mean native, not the logged fallback)."""
+    return _NATIVE is not None
+
+
+def native_load_error() -> str:
+    """Why :func:`native_available` is False ("" when it is True)."""
+    return "" if _NATIVE is not None else _NATIVE_ERROR
+
+
+def set_backend(name: str) -> str:
+    """Point the module-global parse/render surface at ``name``
+    ("native" or "py") and return what actually went live — "native"
+    degrades to "py" (one loud log line per process) when the
+    extension is missing or failed to load."""
+    global proto_backend, RequestParser, ResponseParser
+    global render_request, render_response, _FALLBACK_LOGGED
+    if name not in ("native", "py"):
+        raise ValueError(
+            f"unknown fleet.proto_backend {name!r} "
+            "(expected 'native' or 'py')")
+    if name == "native" and _NATIVE is None:
+        if not _FALLBACK_LOGGED:
+            import logging
+
+            logging.getLogger("sharetrade.fleet.proto").warning(
+                "native wire backend unavailable (%s) — falling back "
+                "to the Python parser", _NATIVE_ERROR)
+            _FALLBACK_LOGGED = True
+        name = "py"
+    if name == "native":
+        RequestParser = _NATIVE.RequestParser
+        ResponseParser = _NATIVE.ResponseParser
+        render_request = _NATIVE.render_request
+        render_response = _NATIVE.render_response
+    else:
+        RequestParser = PyRequestParser
+        ResponseParser = PyResponseParser
+        render_request = py_render_request
+        render_response = py_render_response
+    proto_backend = name
+    return name
+
+
+_NATIVE = _load_native_wire()
+
+#: Live parse/render surface — every wire party uses these names.
+RequestParser = PyRequestParser
+ResponseParser = PyResponseParser
+render_request = py_render_request
+render_response = py_render_response
+
+# Native is the default rung whenever the extension imports; the
+# silent-at-import case (unbuilt checkout) stays on "py" without the
+# loud line — the line belongs to an EXPLICIT "native" request, which
+# cli.py issues when fleet.proto_backend says so.
+if _NATIVE is not None:
+    set_backend("native")

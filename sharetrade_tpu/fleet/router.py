@@ -685,6 +685,14 @@ class FleetRouter:
                     "window_p99_ms": v.window_p99,
                 } for v in self._views.values()}
         status["gauges"] = {k: v for k, v in gauges.items()}
+        # Selector internals (ISSUE 19): the front-end records these
+        # straight into the shared registry (open keep-alive conns,
+        # live parse backend) — fold the latest values in so `cli obs`
+        # sees the loop thread without scraping /metrics.
+        for name, value in self.registry.snapshot().items():
+            if (name.startswith("fleet_evloop_")
+                    or name.startswith("fleet_proto_backend")):
+                status["gauges"][name] = value
         status["counters"] = {
             k: v for k, v in self.registry.counters().items()
             if k.startswith("fleet_")}

@@ -494,6 +494,25 @@ def summarize_run_dir(run_dir: str) -> dict:
                 "slo_availability_burn": fgauges.get(
                     "fleet_slo_availability_burn"),
                 "counters": fs.get("counters"),
+                # Selector-thread internals (ISSUE 19): which HTTP
+                # parse path is live (native C vs Python), open
+                # keep-alive connections, and the loop's backpressure
+                # and deadline-wheel counters.
+                "evloop": {
+                    "proto_backend": (
+                        "native"
+                        if fgauges.get("fleet_proto_backend_native")
+                        else "py"
+                        if "fleet_proto_backend_native" in fgauges
+                        else None),
+                    "open_conns": fgauges.get("fleet_evloop_open_conns"),
+                    "backpressure_pauses_total": (fs.get("counters")
+                                                  or {}).get(
+                        "fleet_evloop_backpressure_pauses_total", 0.0),
+                    "deadline_expiries_total": (fs.get("counters")
+                                                or {}).get(
+                        "fleet_evloop_deadline_expiries_total", 0.0),
+                },
             }
     autoscale_path = os.path.join(run_dir, "fleet_autoscale.json")
     if os.path.isfile(autoscale_path):

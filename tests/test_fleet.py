@@ -584,8 +584,12 @@ class TestFleetLintAndObs:
             "telemetry": {"e0": {"healthy": True,
                                  "window_p99_ms": 12.5}},
             "gauges": {"fleet_p50_ms": 2.5, "fleet_p99_ms": 12.5,
-                       "fleet_swap_lag_steps": 2.0},
-            "counters": {"fleet_requests_total": 100},
+                       "fleet_swap_lag_steps": 2.0,
+                       "fleet_proto_backend_native": 1.0,
+                       "fleet_evloop_open_conns": 5.0},
+            "counters": {"fleet_requests_total": 100,
+                         "fleet_evloop_backpressure_pauses_total": 2,
+                         "fleet_evloop_deadline_expiries_total": 1},
             "fleet_request_ms": {"count": 100, "p50_ms": 2.5,
                                  "p99_ms": 12.5},
         }
@@ -601,6 +605,12 @@ class TestFleetLintAndObs:
         assert fleet["engines"]["e0"]["window_p99_ms"] == 12.5
         assert fleet["engines"]["e1"]["state"] == "failed"
         assert fleet["counters"]["fleet_requests_total"] == 100
+        assert fleet["evloop"] == {
+            "proto_backend": "native",
+            "open_conns": 5.0,
+            "backpressure_pauses_total": 2,
+            "deadline_expiries_total": 1,
+        }
 
 
 # ---------------------------------------------------------------------------

@@ -25,6 +25,13 @@ The load-bearing contracts:
   so the differential oracle holds byte-identically with tracing on AND
   off — and check 16 keeps span emission on the evloop/router hot path
   a bounded buffered append.
+- **The native parser is indistinguishable** (ISSUE 19): the C
+  extension behind ``proto.set_backend("native")`` replays seeded
+  byte-split/pipelined/malformed corpora with event streams and
+  ``ProtocolError`` status+detail EXACTLY equal to the Python oracle's,
+  renders byte-identically, degrades loudly to "py" when the extension
+  is missing, and check 18 confines the binding surface to
+  fleet/proto.py with the GIL released in wire.cc.
 """
 
 from __future__ import annotations
@@ -527,3 +534,408 @@ class TestSpanEmissionLint:
             ("fleet/evloop.py", 8)]
         # The real tree is clean (the repo-level invariant).
         assert lint_hot_loop.lint_span_emission() == []
+
+
+# ---- the native wire backend (ISSUE 19) ----------------------------
+
+
+needs_native = pytest.mark.skipif(
+    not proto.native_available(),
+    reason="native wire extension not built (make -C native)")
+
+
+def _drive_chunks(parser_factory, chunks, key):
+    """Feed ``chunks`` into a fresh parser; returns (event keys before
+    any error, (status, detail) of the ProtocolError or None). Events
+    completed in the same feed() call as an error are discarded by
+    BOTH implementations — the driver mirrors that by catching per
+    call."""
+    p = parser_factory()
+    events, err = [], None
+    for chunk in chunks:
+        try:
+            events.extend(p.feed(chunk))
+        except proto.ProtocolError as exc:
+            err = (exc.status, exc.detail)
+            break
+    return [key(ev) for ev in events], err
+
+
+def _random_splits(rng, blob, n_cuts):
+    cuts = sorted(rng.sample(range(1, len(blob)), min(n_cuts,
+                                                      len(blob) - 1)))
+    chunks, prev = [], 0
+    for cut in cuts + [len(blob)]:
+        chunks.append(blob[prev:cut])
+        prev = cut
+    return chunks
+
+
+def _fuzz_request_corpus(rng) -> list[bytes]:
+    """Valid, malformed, oversized, and trace-header request blobs —
+    the satellite's four corpus classes, seeded."""
+    blobs = []
+    methods = ["GET", "POST", "PUT", "PATCH"]
+    for _ in range(30):
+        n = rng.randrange(1, 4)     # pipelined burst of n messages
+        parts = []
+        for _ in range(n):
+            body = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(0, 64)))
+            headers = {}
+            if rng.random() < 0.5:
+                headers[proto.TRACE_HEADER] = rng.choice(
+                    ["ab12cd34ef56ab78", "DEADbeef", "1a2f.3c",
+                     "not~a~trace", "z" * 70])
+            if rng.random() < 0.3:
+                headers[proto.PARENT_HEADER] = rng.choice(
+                    ["1f.2", "zz", "a" * 65])
+            if rng.random() < 0.3:
+                headers["X-Deadline-Ms"] = str(rng.randrange(1, 5000))
+            if rng.random() < 0.2:
+                headers["Connection"] = rng.choice(
+                    ["close", "keep-alive", "Keep-Alive", "CLOSE"])
+            parts.append(proto.py_render_request(
+                rng.choice(methods), f"/p/{rng.randrange(100)}",
+                "h:1", body, headers=headers or None))
+        blobs.append(b"".join(parts))
+    # hand-built heads: HTTP/1.0 folding, duplicate headers
+    # (last-wins), padded values, underscored and signed
+    # Content-Lengths, a µ header name (lowers OUTSIDE latin-1)
+    blobs += [
+        b"GET / HTTP/1.0\r\nHost: h\r\n\r\n",
+        b"GET / HTTP/1.0\r\nConnection: Keep-Alive\r\n\r\n",
+        b"POST /d HTTP/1.1\r\nX-N: 1\r\nX-N: 2\r\n"
+        b"Content-Length: 2\r\n\r\nhi",
+        b"POST /d HTTP/1.1\r\nContent-Length:   2  \r\n\r\nhi",
+        b"POST /d HTTP/1.1\r\nContent-Length: +1_0\r\n\r\n" + b"a" * 10,
+        b"GET /u HTTP/1.1\r\n\xb5Name: micro\r\nX-\xc0: caps\r\n\r\n",
+    ]
+    # malformed: bad request lines, versions, header lines, lengths
+    blobs += [
+        b"GARBAGE\r\n\r\n",
+        b"ONE TWO THREE FOUR\r\n\r\n",
+        b"GET /x HTTP/2\r\n\r\n",
+        b"GET /x HTTP/1.1\r\nNoColonHere\r\n\r\n",
+        b"GET /x HTTP/1.1\r\n  : empty-name\r\n\r\n",
+        b"GET /x HTTP/1.1\r\nContent-Length: xyz\r\n\r\n",
+        b"GET /x HTTP/1.1\r\nContent-Length: -5\r\n\r\n",
+        b"GET /x HTTP/1.1\r\nContent-Length: 1__0\r\n\r\n",
+        b"GET /x HTTP/1.1\r\nContent-Length: 5_\r\n\r\n",
+        b"GET /x HTTP/1.1\r\nContent-Length: \xa07\r\n\r\n",
+        (f"POST /x HTTP/1.1\r\nContent-Length: "
+         f"{proto.MAX_BODY_BYTES + 1}\r\n\r\n").encode(),
+        b"GET /x HTTP/1.1\r\nX: " + b"a" * (proto.MAX_HEAD_BYTES + 8),
+        b"\r\nGET / HTTP/1.1\r\n\r\n",
+    ]
+    # mutations: valid frames with one random head byte flipped
+    for _ in range(40):
+        raw = bytearray(proto.py_render_request(
+            rng.choice(methods), "/m", "h:1", b"xyz",
+            headers={"X-K": "v"}))
+        pos = rng.randrange(0, min(len(raw), 40))
+        raw[pos] = rng.randrange(256)
+        blobs.append(bytes(raw))
+    return blobs
+
+
+def _fuzz_response_corpus(rng) -> list[bytes]:
+    blobs = []
+    for _ in range(20):
+        n = rng.randrange(1, 4)
+        parts = []
+        for _ in range(n):
+            body = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(0, 64)))
+            parts.append(proto.py_render_response(
+                rng.choice([200, 400, 404, 429, 500, 503, 504, 299]),
+                body,
+                keep_alive=rng.random() < 0.8,
+                extra_headers=({"X-Probe": str(rng.randrange(10))}
+                               if rng.random() < 0.4 else None)))
+        blobs.append(b"".join(parts))
+    blobs += [
+        b"HTTP/1.1 200 OK\r\n\r\n",                 # no Content-Length
+        b"NOPE 200 OK\r\nContent-Length: 0\r\n\r\n",
+        b"HTTP/1.1 2x0 OK\r\nContent-Length: 0\r\n\r\n",
+        b"HTTP/1.1\r\nContent-Length: 0\r\n\r\n",
+        b"HTTP/1.1 2_0 OK\r\nContent-Length: 0\r\n\r\n",
+        b"HTTP/1.1 -1 Odd\r\nContent-Length: 0\r\n\r\n",
+        b"HTTP/1.1 200 OK with spaced reason\r\nContent-Length: 0\r\n\r\n",
+        b"HTTP/1.1 200 OK\r\nContent-Length: bad\r\n\r\n",
+    ]
+    for _ in range(30):
+        raw = bytearray(proto.py_render_response(200, b"body"))
+        pos = rng.randrange(0, min(len(raw), 30))
+        raw[pos] = rng.randrange(256)
+        blobs.append(bytes(raw))
+    return blobs
+
+
+@needs_native
+class TestNativeDifferentialFuzz:
+    """Satellite 2: seeded random byte-split + pipelined burst corpora
+    through BOTH parsers — event streams exactly equal, ProtocolError
+    status AND detail exactly equal."""
+
+    def _native(self):
+        return proto._NATIVE      # skipif guarantees it loaded
+
+    def test_request_parsers_agree_on_fuzzed_streams(self):
+        import random
+        rng = random.Random(0x57_17e)
+        stw = self._native()
+        for blob in _fuzz_request_corpus(rng):
+            for _ in range(4):
+                chunks = _random_splits(rng, blob, rng.randrange(0, 9))
+                got_py = _drive_chunks(proto.PyRequestParser, chunks,
+                                       _req_key)
+                got_c = _drive_chunks(stw.RequestParser, chunks,
+                                      _req_key)
+                assert got_c == got_py, blob
+
+    def test_response_parsers_agree_on_fuzzed_streams(self):
+        import random
+        rng = random.Random(0xbeef)
+        stw = self._native()
+        for blob in _fuzz_response_corpus(rng):
+            for _ in range(4):
+                chunks = _random_splits(rng, blob, rng.randrange(0, 9))
+                got_py = _drive_chunks(proto.PyResponseParser, chunks,
+                                       _resp_key)
+                got_c = _drive_chunks(stw.ResponseParser, chunks,
+                                      _resp_key)
+                assert got_c == got_py, blob
+
+    def test_renderers_agree_byte_for_byte(self):
+        import random
+        rng = random.Random(0x12e7de2)
+        stw = self._native()
+        for _ in range(60):
+            method = rng.choice(["GET", "POST", "DELETE"])
+            target = f"/t/{rng.randrange(1000)}"
+            body = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(0, 50)))
+            headers = ({f"X-H{rng.randrange(5)}": f"v{rng.randrange(9)}",
+                        "X-Trace-Id": "ab12"}
+                       if rng.random() < 0.7 else None)
+            assert stw.render_request(method, target, "h:1", body,
+                                      headers=headers) \
+                == proto.py_render_request(method, target, "h:1", body,
+                                           headers=headers)
+        for _ in range(60):
+            status = rng.choice([200, 400, 404, 429, 500, 503, 504,
+                                 299, 101])
+            body = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(0, 50)))
+            ct = rng.choice(["application/json",
+                             "text/plain; version=0.0.4"])
+            ka = rng.random() < 0.7
+            extra = ({"X-Probe": "1"} if rng.random() < 0.4 else None)
+            assert stw.render_response(status, body, ct,
+                                       keep_alive=ka,
+                                       extra_headers=extra) \
+                == proto.py_render_response(status, body, ct,
+                                            keep_alive=ka,
+                                            extra_headers=extra)
+
+    def test_empty_headers_dict_and_bytearray_feed(self):
+        stw = self._native()
+        assert stw.render_request("GET", "/", "h:1", b"", headers={}) \
+            == proto.py_render_request("GET", "/", "h:1", b"",
+                                       headers={})
+        raw = bytearray(proto.py_render_request("GET", "/", "h:1"))
+        assert len(stw.RequestParser().feed(raw)) == 1
+
+
+class TestNativeBackendDispatch:
+    """Satellite 1: the proto_backend seam — native default when
+    built, loud Python fallback when not, live-backend gauge."""
+
+    def _pin(self, monkeypatch):
+        # set_backend rebinds module globals outside monkeypatch's
+        # sight; no-op patches record the originals for teardown.
+        for name in ("RequestParser", "ResponseParser",
+                     "render_request", "render_response",
+                     "proto_backend", "_NATIVE", "_NATIVE_ERROR",
+                     "_FALLBACK_LOGGED"):
+            monkeypatch.setattr(proto, name, getattr(proto, name))
+
+    @needs_native
+    def test_native_is_the_default_when_built(self):
+        assert proto.proto_backend == "native"
+        assert proto.RequestParser is proto._NATIVE.RequestParser
+        assert proto.render_response is proto._NATIVE.render_response
+        assert proto.native_load_error() == ""
+
+    def test_set_backend_py_and_back(self, monkeypatch):
+        self._pin(monkeypatch)
+        assert proto.set_backend("py") == "py"
+        assert proto.proto_backend == "py"
+        assert proto.RequestParser is proto.PyRequestParser
+        assert proto.render_request is proto.py_render_request
+
+    def test_unknown_backend_refused(self):
+        with pytest.raises(ValueError, match="proto_backend"):
+            proto.set_backend("carrier")
+
+    def test_missing_extension_degrades_loudly_once(self, monkeypatch):
+        import logging
+        self._pin(monkeypatch)
+        monkeypatch.setattr(proto, "_NATIVE", None)
+        monkeypatch.setattr(proto, "_NATIVE_ERROR", "forced by test")
+        monkeypatch.setattr(proto, "_FALLBACK_LOGGED", False)
+        # The repo's "sharetrade" root logger is propagate=False, so
+        # caplog's root handler never sees it — attach directly.
+        records: list[logging.LogRecord] = []
+
+        class _Sink(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        logger = logging.getLogger("sharetrade.fleet.proto")
+        sink = _Sink(level=logging.WARNING)
+        logger.addHandler(sink)
+        try:
+            assert proto.set_backend("native") == "py"
+            assert proto.proto_backend == "py"
+            assert proto.RequestParser is proto.PyRequestParser
+            assert proto.native_available() is False
+            assert proto.native_load_error() == "forced by test"
+            assert len(records) == 1
+            msg = records[0].getMessage()
+            assert "falling back" in msg
+            assert "forced by test" in msg
+            # ONE loud line per process, not one per request/frontend.
+            assert proto.set_backend("native") == "py"
+            assert len(records) == 1
+        finally:
+            logger.removeHandler(sink)
+
+    @pytest.mark.parametrize("backend", ["threaded", "evloop"])
+    def test_live_backend_gauge_recorded(self, backend):
+        reg = MetricsRegistry()
+        fe = ServeFrontend(StubBackend(), reg,
+                           wire_backend=backend).start()
+        try:
+            want = 1.0 if proto.proto_backend == "native" else 0.0
+            assert reg.latest("fleet_proto_backend_native") == want
+        finally:
+            fe.stop()
+
+    @needs_native
+    def test_evloop_py_and_native_answer_byte_identically(self,
+                                                          monkeypatch):
+        self._pin(monkeypatch)
+        payload, n = _scripted_stream()
+        streams = {}
+        for pb in ("py", "native"):
+            proto.set_backend(pb)
+            fe = ServeFrontend(StubBackend(), MetricsRegistry(),
+                               wire_backend="evloop").start()
+            try:
+                streams[pb] = _drive(fe.host, fe.port, payload, n)
+            finally:
+                fe.stop()
+        proto.set_backend("native")
+        assert streams["py"] == streams["native"]
+
+
+class TestEvloopInternalsMetrics:
+    """Satellite 3: the selector thread's internals land in the shared
+    registry (→ /metrics and fleet_status.json)."""
+
+    def test_open_conns_gauge_tracks_the_connection(self):
+        import time
+        reg = MetricsRegistry()
+        fe = ServeFrontend(StubBackend(), reg,
+                           wire_backend="evloop").start()
+        try:
+            assert reg.latest("fleet_evloop_open_conns") == 0.0
+            payload, n = _scripted_stream()
+            _drive(fe.host, fe.port, payload, n)
+            deadline = time.monotonic() + 5.0
+            while (reg.latest("fleet_evloop_open_conns") != 0.0
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            # it went up on accept and back to zero on close
+            series = [v for _, v in
+                      (reg.snapshot_series("fleet_evloop_open_conns")
+                       if hasattr(reg, "snapshot_series") else [])]
+            assert reg.latest("fleet_evloop_open_conns") == 0.0
+        finally:
+            fe.stop()
+
+    def test_deadline_expiry_counter_fires_on_engine_timeout(self):
+        class WedgedBackend(StubBackend):
+            request_timeout_s = 0.05
+
+            def submit_async(self, session, obs, deadline_ms, done):
+                class Handle:
+                    result = None
+                    error = None
+                return Handle()     # never signals: the wheel must fire
+
+        reg = MetricsRegistry()
+        fe = ServeFrontend(WedgedBackend(), reg,
+                           wire_backend="evloop").start()
+        try:
+            body = json.dumps({"session": "w", "obs": [1.0]}).encode()
+            raw = _drive(fe.host, fe.port,
+                         proto.render_request("POST", wire.SUBMIT_PATH,
+                                              "h:1", body), 1)
+            resp = proto.ResponseParser().feed(raw)[0]
+            assert resp.status == wire.STATUS_UNAVAILABLE
+            assert reg.counters().get(
+                "fleet_evloop_deadline_expiries_total") == 1.0
+        finally:
+            fe.stop()
+
+
+class TestNativeWireLint:
+    def test_lint_native_wire_semantics(self, tmp_path):
+        import lint_hot_loop
+        pkg = tmp_path / "pkg"
+        (pkg / "fleet").mkdir(parents=True)
+        (pkg / "fleet" / "proto.py").write_text(
+            "import stwire\n")      # the ONE sanctioned seam: exempt
+        (pkg / "fleet" / "evloop.py").write_text(
+            "import stwire\n"
+            "def load(path):\n"
+            "    from importlib.machinery import ExtensionFileLoader\n"
+            "    # native-wire-ok: test probe\n"
+            "    import stwire as sw\n"
+            "    return sw\n"
+            "# stwire in a comment is prose, not a binding\n")
+        wire_cc = tmp_path / "wire.cc"
+        wire_cc.write_text(
+            "// Py_BEGIN_ALLOW_THREADS in prose does not count\n"
+            "static int core() {\n"
+            "  Py_BEGIN_ALLOW_THREADS\n"
+            "  Py_END_ALLOW_THREADS\n"
+            "  return 0;\n"
+            "}\n")
+        binding, gil, imports = lint_hot_loop.lint_native_wire(
+            root=pkg, wire_cc=wire_cc)
+        assert [(r, ln) for r, ln, _ in binding] \
+            == [("fleet/evloop.py", 1), ("fleet/evloop.py", 3)]
+        assert gil == [] and imports == []
+        # no GIL release at all
+        wire_cc.write_text("static int core() { return 0; }\n")
+        _, gil, _ = lint_hot_loop.lint_native_wire(root=pkg,
+                                                   wire_cc=wire_cc)
+        assert len(gil) == 1 and "Py_BEGIN_ALLOW_THREADS" in gil[0][2]
+        # unbalanced pairing
+        wire_cc.write_text("Py_BEGIN_ALLOW_THREADS\n"
+                           "Py_BEGIN_ALLOW_THREADS\n"
+                           "Py_END_ALLOW_THREADS\n")
+        _, gil, _ = lint_hot_loop.lint_native_wire(root=pkg,
+                                                   wire_cc=wire_cc)
+        assert len(gil) == 1 and "unbalanced" in gil[0][2]
+        # missing wire.cc is itself a failure
+        _, gil, _ = lint_hot_loop.lint_native_wire(
+            root=pkg, wire_cc=tmp_path / "absent.cc")
+        assert len(gil) == 1 and "missing" in gil[0][2]
+        # The real tree is clean (the repo-level invariant).
+        rb, rg, ri = lint_hot_loop.lint_native_wire()
+        assert rb == [] and rg == [] and ri == []

@@ -285,8 +285,10 @@ def run_soak(*, engines: int, kills: int, ramp_s: float,
     try:
         ready = wait_ready(proc, log_path, timeout_s=240.0)
         host, port = ready["host"], ready["port"]
+        result["proto_backend"] = ready.get("proto_backend")
         eprint(f"fleet ready on {host}:{port} with "
-               f"{ready['engines']}/{engines} engines (pid {proc.pid})")
+               f"{ready['engines']}/{engines} engines (pid {proc.pid}, "
+               f"proto_backend={ready.get('proto_backend', '?')})")
         if ready["engines"] != engines:
             raise SoakError(
                 f"only {ready['engines']}/{engines} engines came up")

@@ -527,6 +527,30 @@ SERVE_PAGE_FUNCS = ("_drain_park_inbox", "_install_parked")
 WARM_TIER_MARKER = "warm-tier-ok"
 WARM_BOUND_PATTERN = re.compile(r"max_bytes|max_sessions")
 
+#: Check 18 (the native-wire PR): the C parse/render extension
+#: (native/wire.cc → stwire.so) stays confined behind ONE seam.
+#: (a) No Python file in ``sharetrade_tpu/`` outside
+#: ``fleet/proto.py`` may touch the binding surface (the ``stwire``
+#: module or an ``ExtensionFileLoader``) — every wire party reaches
+#: the native path through proto.py's backend dispatch, which is what
+#: lets the Python oracle swap in (graceful degrade, differential
+#: fuzzing) without any caller changing. Escape: ``native-wire-ok`` on
+#: the line or the two above, naming why a second site must exist.
+#: (b) ``native/wire.cc`` must RELEASE the GIL around its parse/render
+#: cores — at least one ``Py_BEGIN_ALLOW_THREADS``, and the BEGIN/END
+#: pairing balanced — or the "native hot path" serializes against
+#: engine callbacks and loadgen threads exactly like the Python parser
+#: it replaces. (c) ``fleet/proto.py`` stays I/O-import-free under
+#: BOTH backends: the loader runs at import time, so check 15's
+#: sans-IO import scan is re-asserted here.
+NATIVE_WIRE_MODULE = "fleet/proto.py"
+NATIVE_WIRE_BINDING_PATTERN = re.compile(
+    r"\bstwire\b|ExtensionFileLoader")
+NATIVE_WIRE_MARKER = "native-wire-ok"
+NATIVE_WIRE_CC = TARGET.parent.parent.parent / "native" / "wire.cc"
+GIL_BEGIN = "Py_BEGIN_ALLOW_THREADS"
+GIL_END = "Py_END_ALLOW_THREADS"
+
 
 def lint_hot_loop_syncs() -> tuple[list[tuple[str, int, str]], set[str]]:
     return _scan_named_funcs(HOT_FUNCS, PATTERN, MARKER)
@@ -917,6 +941,61 @@ def lint_warm_tier(target: pathlib.Path | None = None
             found | page_found)
 
 
+def lint_native_wire(
+        root: pathlib.Path | None = None,
+        wire_cc: pathlib.Path | None = None) -> tuple[
+            list[tuple[str, int, str]], list[tuple[str, int, str]],
+            list[tuple[str, int, str]]]:
+    """Check 18: (a) the native wire binding surface (the ``stwire``
+    extension / an ``ExtensionFileLoader``) appears nowhere in
+    ``sharetrade_tpu/`` outside NATIVE_WIRE_MODULE, marker-exempt on
+    the line or the two above (``native-wire-ok``); (b) native/wire.cc
+    exists and releases the GIL around parse/render (at least one
+    ``Py_BEGIN_ALLOW_THREADS``, BEGIN/END balanced, comment lines
+    excluded); (c) the sans-IO core stays I/O-import-free under both
+    backends (check 15's import scan, re-run). Returns
+    ``(binding_hits, gil_hits, import_hits)``. ``root``/``wire_cc``
+    override the scanned tree (tests exercise the semantics on
+    fixtures)."""
+    root = root or TARGET.parent.parent     # sharetrade_tpu/
+    wire_cc = pathlib.Path(wire_cc) if wire_cc is not None \
+        else NATIVE_WIRE_CC
+    binding_bad: list[tuple[str, int, str]] = []
+    for path in sorted(pathlib.Path(root).rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if rel == NATIVE_WIRE_MODULE:
+            continue
+        lines = path.read_text().splitlines()
+        for ln, text in enumerate(lines, 1):
+            if text.lstrip().startswith("#"):
+                continue
+            if not NATIVE_WIRE_BINDING_PATTERN.search(text):
+                continue
+            window = lines[max(0, ln - 3):ln]
+            if any(NATIVE_WIRE_MARKER in w for w in window):
+                continue
+            binding_bad.append((rel, ln, text.strip()))
+    gil_bad: list[tuple[str, int, str]] = []
+    if not wire_cc.exists():
+        gil_bad.append((wire_cc.name, 0, "native/wire.cc is missing"))
+    else:
+        begins = ends = 0
+        for line in wire_cc.read_text().splitlines():
+            code = line.split("//", 1)[0]    # prose mentions don't count
+            begins += code.count(GIL_BEGIN)
+            ends += code.count(GIL_END)
+        if begins == 0:
+            gil_bad.append((wire_cc.name, 0,
+                            f"no {GIL_BEGIN} — parse/render hold the GIL"))
+        elif begins != ends:
+            gil_bad.append(
+                (wire_cc.name, 0,
+                 f"{GIL_BEGIN} x{begins} vs {GIL_END} x{ends} — "
+                 "unbalanced pairing"))
+    _, import_bad = lint_evloop_sansio(root)
+    return binding_bad, gil_bad, import_bad
+
+
 def lint_dispatcher_blocking() -> tuple[list[tuple[str, int, str]], set[str]]:
     """Check 4: no unmarked blocking host calls in the dispatcher section;
     the consumer-side functions must still exist. Returns (hits, found
@@ -1247,6 +1326,38 @@ def main() -> int:
               f"lives>' or the line '# {SERVE_MARKER}: <why this host "
               "op rides dispatch>'")
         return 1
+    nw_binding_bad, nw_gil_bad, nw_import_bad = lint_native_wire()
+    if nw_binding_bad:
+        print("native-wire binding confinement lint FAILED:")
+        for rel, ln, text in nw_binding_bad:
+            print(f"  sharetrade_tpu/{rel}:{ln}: {text}")
+        print("the stwire extension is loaded through fleet/proto.py's "
+              "backend dispatch ONLY — a second binding site forks the "
+              "wire semantics away from the differential oracle; go "
+              "through proto.set_backend()/proto.RequestParser, or tag "
+              f"the line (or the two above) '# {NATIVE_WIRE_MARKER}: "
+              "<why this binding site must exist>'")
+        return 1
+    if nw_gil_bad:
+        print("native-wire GIL-release lint FAILED:")
+        for rel, ln, text in nw_gil_bad:
+            print(f"  native/{rel}:{ln}: {text}")
+        print("native/wire.cc must frame bytes with the GIL released "
+              f"({GIL_BEGIN}/{GIL_END} pairs around the C parse/render "
+              "cores) — a native parser that holds the GIL serializes "
+              "against engine callbacks exactly like the Python one it "
+              "replaces, which is the whole regression the check "
+              "guards")
+        return 1
+    if nw_import_bad:
+        print("native-wire sans-IO import lint FAILED:")
+        for rel, ln, text in nw_import_bad:
+            print(f"  sharetrade_tpu/{rel}:{ln}: {text}")
+        print("fleet/proto.py must stay I/O-import-free under BOTH "
+              "backends — the native loader runs at proto import time, "
+              "so an I/O import there couples every parser (C and "
+              "Python alike) to a transport")
+        return 1
     dur_bad = lint_durable_replace()
     if dur_bad:
         print("durable-rename fsync lint FAILED:")
@@ -1279,6 +1390,8 @@ def main() -> int:
           f"span-emission lint OK ({', '.join(SPAN_EMIT_FILES)}); "
           f"warm-tier lint OK ({SERVE_WARM_CLASS}, "
           f"{', '.join(SERVE_PAGE_FUNCS)}); "
+          f"native-wire lint OK ({NATIVE_WIRE_MODULE} seam, "
+          f"GIL released in wire.cc); "
           f"durable-rename fsync lint OK ({', '.join(DURABLE_WRITE_FILES)})")
     return 0
 
