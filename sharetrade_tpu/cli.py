@@ -407,6 +407,18 @@ def cmd_serve(args) -> int:
         stopped_clean = engine.stop(
             drain=False,
             timeout_s=min(max(grace / 8.0, 1.0), grace / 6.0))
+        # Warm handoff (ISSUE 20): with the worker threads stopped, seal
+        # every surviving carry into the spill arena so the engines this
+        # one's sessions land on adopt them warm. Strictly AFTER stop()
+        # (page_out_all refuses otherwise) and never allowed to sink a
+        # clean shutdown — a failed page-out only costs adoptions.
+        spill_pageout = None
+        if stopped_clean:
+            try:
+                spill_pageout = engine.page_out_all()
+            except Exception:   # noqa: BLE001 — degraded, not dead
+                log.exception("drain page-out failed; this engine's "
+                              "sessions will cold-restart elsewhere")
         engine_failed = engine.failed is not None
         obs_bundle.flush()
         counters = registry.counters()
@@ -444,6 +456,21 @@ def cmd_serve(args) -> int:
             summary["warm_misses"] = warm_misses
             summary["warm_demotions"] = int(
                 counters.get("serve_warm_demotions_total", 0))
+        # Spill-tier counters (ISSUE 20): gated the same way — only
+        # meaningful with a spill arena configured.
+        if spill_pageout is not None and any(spill_pageout.values()):
+            summary["spill_pageout"] = spill_pageout
+        spill_puts = int(counters.get("serve_spill_puts_total", 0))
+        spill_hits = int(counters.get("serve_spill_hits_total", 0))
+        if spill_puts or spill_hits:
+            summary["spill_puts"] = spill_puts
+            summary["spill_hits"] = spill_hits
+            summary["adopt_warm"] = int(
+                counters.get("serve_adopt_warm_total", 0))
+            summary["adopt_cold"] = int(
+                counters.get("serve_adopt_cold_total", 0))
+            summary["spill_corrupt"] = int(
+                counters.get("serve_spill_corrupt_total", 0))
         # Stage-decomposition tail (the ISSUE-11 observability surface):
         # histogram-derived per-stage p99s plus the slowest exemplars —
         # the "which stage owns the tail" answer in the run summary.

@@ -538,6 +538,23 @@ class ServeConfig:
     # suspenders; both are enforced — lint check 17 requires the tier
     # to be bounded in code).
     warm_max_sessions: int = 4096
+    # --- Disk spill tier (ISSUE 20: sessions survive their engine) ---
+    # Directory of the crash-consistent parked-carry arena
+    # (serve/spill.py): carries demoted past the warm-RAM budget — and
+    # every live/parked carry at drain — are sealed to per-session
+    # records here (CRC + step stamp + atomic rename), so a carry
+    # survives its writer's SIGKILL and a DIFFERENT engine sharing the
+    # directory can adopt it warm. fleet/pool.py points every worker of
+    # a fleet at <pool.dir>/spill; a standalone engine may set it
+    # directly. Empty (default) disables the tier: past warm_bytes a
+    # session demotes straight to cold, the ISSUE-18 contract unchanged.
+    spill_dir: str = ""
+    # Byte budget for THIS engine's view of the arena (puts past the
+    # budget are refused and the session stays cold — bounded like
+    # warm_bytes; the tier is never an unbounded disk leak). 0 with a
+    # spill_dir set means "adopt-only": the engine reads records peers
+    # wrote but never spills its own.
+    spill_bytes: int = 0
     # Hot-swap circuit breaker: this many CONSECUTIVE verified-restore
     # failures (distinct corrupt/mismatched candidates) stop the watcher
     # from polling the wedged tag for swap_breaker_cooldown_s (exported

@@ -29,6 +29,12 @@ applied to membership:
   [``fleet.min_engines``, ``fleet.max_engines`` (0 = num_engines)].
   The autoscaler can never spawn past what the operator allowed nor
   drain the fleet below its floor.
+- **scale-down is state-preserving** (ISSUE 20): a retired engine
+  drains through SIGTERM → page-out-all → exit 75, sealing every live
+  and parked carry into the fleet-shared spill arena before the
+  process dies — survivors ADOPT those sessions warm (step-stamp
+  validated) instead of cold-restarting them through prefill, so
+  shrinking the fleet no longer massacres its session population.
 
 What the autoscaler may ASSUME about the history ring (README "Session
 tiers & fleet autoscaling"): rows are appended oldest-to-newest at the

@@ -608,12 +608,17 @@ class EvloopFrontend:
                 if self.tracer is not None else None)
         conn.cur_tctx = tctx
         deadline_raw = request.headers.get("x-deadline-ms")
+        clock_raw = request.headers.get("x-session-clock")
         if self._relay is not None:
+            # The relay re-derives the clock from the router's own
+            # affinity table per hop — an inbound header is not trusted.
             self._relay.start(conn, request.body, deadline_raw, tctx)
         elif getattr(self.backend, "submit_async", None) is not None:
-            self._dispatch_engine(conn, request.body, deadline_raw, tctx)
+            self._dispatch_engine(conn, request.body, deadline_raw,
+                                  clock_raw, tctx)
         else:
-            self._dispatch_inline(conn, request.body, deadline_raw, tctx)
+            self._dispatch_inline(conn, request.body, deadline_raw,
+                                  clock_raw, tctx)
 
     def _do_get(self, conn: _ServerConn, request: proto.Request) -> None:
         if request.target == wire.HEALTH_PATH:
@@ -635,9 +640,10 @@ class EvloopFrontend:
             self.reply(conn, 404, {"error": "not_found"})
 
     def _parse_submit(self, conn: _ServerConn, raw: bytes,
-                      deadline_raw: str | None):
-        """Shared JSON/deadline validation for the non-proxy paths;
-        None means the 400 already went out."""
+                      deadline_raw: str | None,
+                      clock_raw: str | None = None):
+        """Shared JSON/deadline/clock validation for the non-proxy
+        paths; None means the 400 already went out."""
         try:
             payload = json.loads(raw)
             session = payload["session"]
@@ -655,14 +661,25 @@ class EvloopFrontend:
                     f"malformed {wire.DEADLINE_HEADER}: "
                     f"{deadline_raw!r}"), counted=False)
                 return None
-        return session, obs, deadline_ms
+        clock = None
+        if clock_raw is not None and getattr(self.backend, "wire_clocked",
+                                             False):
+            try:
+                clock = int(clock_raw) or None
+            except ValueError:
+                self.reply_error(conn, ValueError(
+                    f"malformed {wire.CLOCK_HEADER}: "
+                    f"{clock_raw!r}"), counted=False)
+                return None
+        return session, obs, deadline_ms, clock
 
     def _dispatch_engine(self, conn: _ServerConn, raw: bytes,
-                         deadline_raw: str | None, tctx=None) -> None:
-        parsed = self._parse_submit(conn, raw, deadline_raw)
+                         deadline_raw: str | None,
+                         clock_raw: str | None = None, tctx=None) -> None:
+        parsed = self._parse_submit(conn, raw, deadline_raw, clock_raw)
         if parsed is None:
             return
-        session, obs, deadline_ms = parsed
+        session, obs, deadline_ms, clock = parsed
         self.registry.inc("frontend_requests_total")
         timeout_s = (max(float(deadline_ms) / 1e3 * 4, 5.0)
                      if deadline_ms else self.backend.request_timeout_s)
@@ -670,11 +687,13 @@ class EvloopFrontend:
                   and getattr(self.backend, "wire_traced", False))
         call = _EngineCall(self, conn, timeout_s)
         call.tctx = tctx if traced else None
+        kwargs = {"clock": clock} if clock is not None else {}
         try:
             call.handle = (self.backend.submit_async(
-                session, obs, deadline_ms, call.signal, tctx=tctx)
+                session, obs, deadline_ms, call.signal, tctx=tctx,
+                **kwargs)
                 if traced else self.backend.submit_async(
-                    session, obs, deadline_ms, call.signal))
+                    session, obs, deadline_ms, call.signal, **kwargs))
         except Exception as exc:    # noqa: BLE001 — every serving
             # outcome maps to a wire status; the loop never dies.
             self.reply_error(conn, exc)
@@ -682,20 +701,23 @@ class EvloopFrontend:
         call.timer = self.loop.call_later(timeout_s, call.on_timeout)
 
     def _dispatch_inline(self, conn: _ServerConn, raw: bytes,
-                         deadline_raw: str | None, tctx=None) -> None:
-        parsed = self._parse_submit(conn, raw, deadline_raw)
+                         deadline_raw: str | None,
+                         clock_raw: str | None = None, tctx=None) -> None:
+        parsed = self._parse_submit(conn, raw, deadline_raw, clock_raw)
         if parsed is None:
             return
-        session, obs, deadline_ms = parsed
+        session, obs, deadline_ms, clock = parsed
         self.registry.inc("frontend_requests_total")
         traced = (tctx is not None
                   and getattr(self.backend, "wire_traced", False))
+        kwargs = {"clock": clock} if clock is not None else {}
         try:
             result = (self.backend.serve_request(session, obs,
-                                                 deadline_ms, tctx=tctx)
+                                                 deadline_ms, tctx=tctx,
+                                                 **kwargs)
                       if traced else
                       self.backend.serve_request(session, obs,
-                                                 deadline_ms))
+                                                 deadline_ms, **kwargs))
         except Exception as exc:    # noqa: BLE001
             self.reply_error(conn, exc)
             return
@@ -906,6 +928,12 @@ class _RelayCall:
         headers = {}
         if self.deadline_raw is not None:
             headers[wire.DEADLINE_HEADER] = self.deadline_raw
+        clock = self.router.session_clock(self.session)
+        if clock > 0:
+            # The router-observed session clock rides every hop so an
+            # adopting engine can validate a spill record's step stamp
+            # (the same header the blocking proxy path sends).
+            headers[wire.CLOCK_HEADER] = str(clock)
         if self.attempt_span:
             # This attempt's span id is the downstream parent — each
             # retry/migration hands the engine a fresh parent.

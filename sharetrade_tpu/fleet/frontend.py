@@ -76,6 +76,11 @@ class EngineBackend:
     #: Frontends pass the parsed wire trace context (``tctx``) only to
     #: backends that declare it — test stubs never see the kwarg.
     wire_traced = True
+    #: Frontends pass the parsed ``X-Session-Clock`` header (the
+    #: router-observed completed-response count, ISSUE 20) only to
+    #: backends that declare it — the engine validates a spill record's
+    #: step stamp against it before adopting the carry.
+    wire_clocked = True
 
     def __init__(self, engine, *, request_timeout_s: float = 30.0,
                  spans=None):
@@ -137,22 +142,25 @@ class EngineBackend:
                 self.spans.span(trace_id, self.spans.new_span_id(), env,
                                 name, a, b)
 
-    def _submit(self, session: str, obs, deadline_ms, tctx, callback=None):
+    def _submit(self, session: str, obs, deadline_ms, tctx, callback=None,
+                clock=None):
         """Shared enqueue: recv span, submit, thread the trace identity
         into the request's :class:`RequestTrace` (the ISSUE-17 stitch
         key the engine's own chrome-trace spans carry)."""
         self.trace_recv(tctx)
         handle = self.engine.submit(session, obs, callback=callback,
-                                    deadline_ms=deadline_ms or 0.0)
+                                    deadline_ms=deadline_ms or 0.0,
+                                    session_clock=clock)
         if tctx is not None:
             handle.trace.trace_id = tctx[0]
             handle.trace.parent_span = tctx[2] or tctx[1]
         return handle
 
     def serve_request(self, session: str, obs,
-                      deadline_ms: float | None, tctx=None) -> dict:
+                      deadline_ms: float | None, tctx=None,
+                      clock: int | None = None) -> dict:
         obs = self.validate_obs(obs)
-        handle = self._submit(session, obs, deadline_ms, tctx)
+        handle = self._submit(session, obs, deadline_ms, tctx, clock=clock)
         # A deadline'd request resolves engine-side well inside
         # deadline + one batch; the no-deadline wait is bounded by the
         # configured front-end budget so a wedged engine surfaces as a
@@ -172,18 +180,26 @@ class EngineBackend:
             self.trace_complete(tctx, handle)
 
     def submit_async(self, session: str, obs, deadline_ms: float | None,
-                     signal_done, tctx=None):
+                     signal_done, tctx=None, clock: int | None = None):
         """The evloop front-end's dispatch: validate and enqueue, then
         return the request handle WITHOUT waiting — ``signal_done()``
         fires (from the engine's consumer thread) once the handle
         completes; read ``handle.result`` / ``handle.error`` after."""
         obs = self.validate_obs(obs)
         return self._submit(session, obs, deadline_ms, tctx,
-                            callback=lambda _result: signal_done())
+                            callback=lambda _result: signal_done(),
+                            clock=clock)
 
     def health(self) -> dict:
         engine = self.engine
         reg = engine.registry
+        refresh = getattr(engine, "refresh_spill_gauges", None)
+        if refresh is not None:
+            # The scrape IS the stats clock while the engine idles: the
+            # router reads health then /metrics each poll, and the spill
+            # census must be live in that same poll even with no batch
+            # completing (cadence-gated inside — one bounded scandir).
+            refresh()
         return {
             "ok": engine.failed is None,
             "failed": engine.failed is not None,
@@ -316,13 +332,26 @@ class _Handler(BaseHTTPRequestHandler):
                         f"malformed {wire.DEADLINE_HEADER}: "
                         f"{deadline_raw!r}")))
                     return
+            clock = None
+            clock_raw = self.headers.get(wire.CLOCK_HEADER)
+            if clock_raw is not None and getattr(
+                    fe.backend, "wire_clocked", False):
+                try:
+                    clock = int(clock_raw) or None
+                except ValueError:
+                    self._reply(*wire.error_to_status(ValueError(
+                        f"malformed {wire.CLOCK_HEADER}: "
+                        f"{clock_raw!r}")))
+                    return
             fe.registry.inc("frontend_requests_total")
+            kwargs = {"clock": clock} if clock is not None else {}
             try:
                 result = (fe.backend.serve_request(session, obs,
-                                                   deadline_ms, tctx=tctx)
+                                                   deadline_ms, tctx=tctx,
+                                                   **kwargs)
                           if traced else
                           fe.backend.serve_request(session, obs,
-                                                   deadline_ms))
+                                                   deadline_ms, **kwargs))
             except Exception as exc:    # noqa: BLE001 — every serving
                 # outcome maps to a wire status; the handler never dies.
                 status, body = wire.error_to_status(exc)

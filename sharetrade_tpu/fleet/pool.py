@@ -32,10 +32,17 @@ and shared verbatim between the two:
   honest.
 
 A healthy engine that gets SIGKILLed respawns FRESH: empty slot pool,
-so every session that was warm on the corpse re-enters COLD through the
-batched prefill on whichever engine the router re-routes it to — the
-documented migration story (bitwise-equal to a fresh session, the PR-8
-eviction contract the fleet tests re-pin over the wire).
+empty warm store. What SURVIVES the corpse is the shared spill arena
+(ISSUE 20): when ``serve.spill_bytes`` is configured the pool hands
+every worker the same ``<dir>/spill`` directory, so carries the dead
+engine parked/spilled there are ADOPTED warm by whichever engine the
+router re-routes each session to — iff the record's step stamp matches
+the router's session clock; anything stale, torn, or CRC-bad re-enters
+COLD through the batched prefill, bitwise-equal to a fresh session (the
+PR-8 eviction contract the fleet tests re-pin over the wire). The pool
+also sweeps dead incarnations' unsealed ``.tmp`` debris out of the
+arena: at boot (nothing is running — all debris is dead) and on every
+crash reap (the corpse's pid-stamped leftovers).
 """
 
 from __future__ import annotations
@@ -63,6 +70,7 @@ from sharetrade_tpu.distrib.ladder import (
     crash_step,
 )
 from sharetrade_tpu.fleet.wire import FleetClient
+from sharetrade_tpu.serve.spill import sweep_debris
 from sharetrade_tpu.utils.logging import get_logger
 
 log = get_logger("fleet.pool")
@@ -148,11 +156,31 @@ class EnginePool:
         #: Host core inventory for fleet.engine_cpus slices (stable
         #: round-robin assignment by spawn index).
         self._host_cpus = sorted(os.sched_getaffinity(0))
+        #: The fleet-shared spill arena directory (ISSUE 20), or None
+        #: with the spill tier off. An explicit serve.spill_dir wins;
+        #: otherwise spill_bytes > 0 (and a live warm tier — the engine
+        #: refuses spill-without-warm) roots the arena under the pool's
+        #: own dir so every worker — and every respawn — shares it.
+        sc = cfg.serve
+        self.arena_dir: str | None = None
+        if sc.spill_dir:
+            self.arena_dir = sc.spill_dir
+        elif sc.spill_bytes > 0 and sc.warm_bytes > 0:
+            self.arena_dir = os.path.join(self.dir, "spill")
 
     # ---- membership -------------------------------------------------
 
     def start(self, n: int | None = None) -> "EnginePool":
         n = self.cfg.fleet.num_engines if n is None else n
+        if self.arena_dir is not None:
+            # Nothing is running yet, so EVERY unsealed temp file in the
+            # arena is a dead incarnation's torn write — sealed records
+            # are untouched (they are the previous fleet's adoptable
+            # carries, exactly what the spill tier exists to preserve).
+            swept = sweep_debris(self.arena_dir)
+            if swept:
+                log.info("swept %d stale spill temp file(s) from %s",
+                         swept, self.arena_dir)
         with self._lock:
             self.target = n
             for _ in range(n):
@@ -216,6 +244,10 @@ class EnginePool:
                    "data.journal_dir="
                    + os.path.join(self.dir, f"{handle.engine_id}-data"),
                    "--symbol", self._symbol]
+            if self.arena_dir is not None:
+                # Every worker shares ONE arena (and a respawn rejoins
+                # it): the handoff half of warm-carry migration.
+                cmd += ["--set", f"serve.spill_dir={self.arena_dir}"]
             span_dir = getattr(self.cfg.obs, "span_dir", "")
             if span_dir:
                 # ISSUE-17 span journaling: each worker appends wire
@@ -345,6 +377,11 @@ class EnginePool:
                 continue
             h.last_rc = rc
             h.port = None
+            if self.arena_dir is not None and h.pid is not None:
+                # The corpse can never finish a write: its pid-stamped
+                # unsealed temp files are debris now (sealed records
+                # stay — they are the adoption inventory).
+                sweep_debris(self.arena_dir, pid=h.pid)
             if h.state == RETIRING or self._quiesced.is_set():
                 h.state = RETIRED
                 log.info("engine %s retired (rc=%s)", h.engine_id, rc)

@@ -9,12 +9,17 @@ surface PR 10 deliberately built and PR 11 made mergeable:
   ``fleet.telemetry_poll_s``): an engine's live queue depth plus a large
   penalty while its ``serve_overload`` gauge is up — new sessions land
   on the least-loaded live engine (round-robin tiebreak);
-- **session affinity**: a session sticks to the engine holding its
-  slot-pool carry (LRU table bounded at ``fleet.affinity_max_sessions``)
-  — the warm path. When its engine drains, dies, or deploys, the next
-  request re-routes to a survivor and the session re-enters COLD through
-  the batched prefill there (``fleet_migrations_total``) — bitwise a
-  fresh session, the PR-8 eviction contract stretched across machines;
+- **session affinity + clock**: a session sticks to the engine holding
+  its slot-pool carry (LRU table bounded at
+  ``fleet.affinity_max_sessions``) — the warm path. The affinity entry
+  also carries the session's completed-response CLOCK, forwarded on
+  every proxy hop as ``X-Session-Clock`` (ISSUE 20): when the engine
+  drains, dies, or deploys, the next request re-routes to a survivor,
+  which ADOPTS the carry from the shared spill arena iff the record's
+  step stamp matches that clock (``fleet_adopt_warm_total``) and
+  re-enters cold through the batched prefill otherwise
+  (``fleet_adopt_cold_total`` / ``fleet_migrations_total``) — a stale,
+  torn, or CRC-bad record can cost latency, never bytes;
 - **exact fleet quantiles**: the poller scrapes every engine's
   ``/metrics``, reconstructs the ``serve_request_ms`` histogram from its
   ``_bucket`` exposition (obs/hist.py ``from_prom_buckets`` — exact
@@ -69,6 +74,17 @@ UNROUTED_DETAIL = ("no live engines: the whole fleet is failed, "
 _BAD_COUNTERS = ("serve_shed_total", "serve_queue_rejected_total",
                  "serve_deadline_expired_total")
 _TOTAL_COUNTER = "serve_requests_total"
+
+#: Engine-side spill/adoption counters folded (as window deltas) into
+#: the same-named ``fleet_``-prefixed counters — the soak reconciles
+#: these exactly against injected kills (ISSUE 20).
+_SPILL_COUNTERS = ("serve_adopt_warm_total", "serve_adopt_cold_total",
+                   "serve_spill_hits_total", "serve_spill_misses_total",
+                   "serve_spill_stale_total", "serve_spill_corrupt_total",
+                   "serve_spill_puts_total")
+
+#: Engine-side spill gauges summed fleet-wide each poll.
+_SPILL_GAUGES = ("serve_spill_bytes", "serve_spill_sessions")
 
 
 class _EngineView:
@@ -125,8 +141,13 @@ class FleetRouter:
             self._history = TsdbRing(
                 os.path.join(self.dir, HISTORY_FILE),
                 max_rows=history_rows)
-        #: Session → engine_id affinity, LRU-bounded.
-        self._affinity: OrderedDict[str, str] = OrderedDict()
+        #: Session → (engine_id | None, completed-response clock),
+        #: LRU-bounded. The engine id is None while the session is
+        #: between engines (its last engine died/drained) — the CLOCK
+        #: must survive that gap, it is what lets the next engine
+        #: validate a spill-arena record before adopting the carry.
+        self._affinity: OrderedDict[str, tuple[str | None, int]] = \
+            OrderedDict()
         self._aff_lock = threading.Lock()
         self._views: dict[str, _EngineView] = {}
         self._views_lock = threading.Lock()
@@ -213,8 +234,15 @@ class FleetRouter:
         ``upstream_io`` child brackets the raw write/read — the same
         span shapes the evloop relay emits (tests hold them to it)."""
         self.registry.inc("fleet_requests_total")
-        headers = ({wire.DEADLINE_HEADER: deadline_raw}
-                   if deadline_raw is not None else None)
+        headers: dict | None = ({wire.DEADLINE_HEADER: deadline_raw}
+                                if deadline_raw is not None else None)
+        clock = self.session_clock(session)
+        if clock > 0:
+            # The adoption contract's router half (ISSUE 20): the engine
+            # only pages a spilled carry in when its step stamp matches
+            # this completed-response count.
+            headers = dict(headers or {})
+            headers[wire.CLOCK_HEADER] = str(clock)
         timeout_s = self.relay_timeout_s(deadline_raw)
         tried: set[str] = set()
         migrated = False
@@ -373,12 +401,15 @@ class FleetRouter:
     def finish_relay(self, session: str, engine_id: str, migrated: bool,
                      status: int, reply: bytes) -> tuple[int, bytes]:
         """Terminal accounting for a relayed reply: migration counter,
-        affinity, completion/refusal counters, and the engine-id splice
-        into a 200's bytes (before the object's closing brace — naming
-        the serving engine without a JSON round-trip)."""
+        affinity (the session clock ticks on a 200 — the router's half
+        of the spill-adoption stamp contract), completion/refusal
+        counters, and the engine-id splice into a 200's bytes (before
+        the object's closing brace — naming the serving engine without
+        a JSON round-trip)."""
         if migrated:
             self.registry.inc("fleet_migrations_total")
-        self._note_affinity(session, engine_id)
+        self._note_affinity(session, engine_id,
+                            bump=status == wire.STATUS_OK)
         if status == wire.STATUS_OK:
             self.registry.inc("fleet_completed_total")
             cut = reply.rfind(b"}")
@@ -409,7 +440,8 @@ class FleetRouter:
                 return view is None or view.healthy
 
             with self._aff_lock:
-                sticky = self._affinity.get(session)
+                entry = self._affinity.get(session)
+            sticky = entry[0] if entry is not None else None
             if sticky is not None and usable(sticky):
                 return sticky, endpoints[sticky]
             candidates = [eid for eid in endpoints if usable(eid)]
@@ -428,29 +460,44 @@ class FleetRouter:
             chosen = pool[self._rr % len(pool)]
             return chosen, endpoints[chosen]
 
-    def _note_affinity(self, session: str, engine_id: str) -> None:
+    def session_clock(self, session: str) -> int:
+        """The session's completed-response count as this router has
+        observed it (0 for an unknown session) — what the engine
+        validates a spill record's step stamp against before adopting."""
+        with self._aff_lock:
+            entry = self._affinity.get(session)
+        return entry[1] if entry is not None else 0
+
+    def _note_affinity(self, session: str, engine_id: str, *,
+                       bump: bool) -> None:
         with self._aff_lock:
             existing = self._affinity.pop(session, None)
-            if existing is not None and existing != engine_id:
-                # Shouldn't normally happen (affinity is honored above),
-                # but a concurrent migration wins — last writer is truth.
-                pass
-            self._affinity[session] = engine_id
+            clock = existing[1] if existing is not None else 0
+            # A 200 means the engine committed one more carry step for
+            # this session — tick the clock; protocol refusals
+            # (429/504/4xx) never touched the carry.
+            self._affinity[session] = (engine_id, clock + 1 if bump
+                                       else clock)
             while len(self._affinity) > self.cfg.affinity_max_sessions:
                 self._affinity.popitem(last=False)
 
     def _drop_affinity(self, session: str) -> None:
+        """Detach the session from its engine but KEEP its clock: the
+        engine is gone, the session's history is not — the clock is the
+        key that unlocks warm adoption from the spill arena."""
         with self._aff_lock:
-            self._affinity.pop(session, None)
+            entry = self._affinity.get(session)
+            if entry is not None:
+                self._affinity[session] = (None, entry[1])
 
     def _drop_engine_affinity(self, engine_id: str) -> None:
-        """Forget every session stuck to a dead engine so the NEXT
-        request of each re-routes without paying a transport error."""
+        """Detach every session stuck to a dead engine (clock kept —
+        see :meth:`_drop_affinity`) so the NEXT request of each
+        re-routes without paying a transport error."""
         with self._aff_lock:
-            stale = [sid for sid, eid in self._affinity.items()
-                     if eid == engine_id]
-            for sid in stale:
-                del self._affinity[sid]
+            for sid, (eid, clk) in list(self._affinity.items()):
+                if eid == engine_id:
+                    self._affinity[sid] = (None, clk)
 
     def _mark_unreachable(self, engine_id: str) -> None:
         with self._views_lock:
@@ -504,6 +551,8 @@ class FleetRouter:
         window_bad = 0.0
         window_total = 0.0
         dead_engines = []
+        spill_sums = {name: 0.0 for name in _SPILL_GAUGES}
+        spill_seen = False
         with self._views_lock:
             for engine_id, endpoint in endpoints.items():
                 view = self._views.get(engine_id)
@@ -537,6 +586,12 @@ class FleetRouter:
                     bad, total = self._counter_deltas(view, metrics)
                     window_bad += bad
                     window_total += total
+                    mg = metrics.get("gauges") or {}
+                    for name in _SPILL_GAUGES:
+                        v = mg.get(f"sharetrade_{name}")
+                        if v is not None:
+                            spill_seen = True
+                            spill_sums[name] += float(v)
             # Engines the pool no longer lists (retired/failed corpses)
             # drop out of the view entirely.
             for gone in set(self._views) - set(endpoints):
@@ -578,6 +633,15 @@ class FleetRouter:
             # Swap-propagation lag: how far the slowest live engine
             # trails the freshest published weights, in checkpoint steps.
             gauges["fleet_swap_lag_steps"] = float(max(steps) - min(steps))
+        if spill_seen:
+            # Fleet-wide spill-tier footprint: engines sharing one arena
+            # each report the whole directory, so these sums over-count
+            # by the sharing factor — they are a LOAD signal (how much
+            # parked state a kill would put in play), not an exact
+            # byte census; the counters above are the exact side.
+            gauges["fleet_spill_bytes"] = spill_sums["serve_spill_bytes"]
+            gauges["fleet_spill_sessions"] = \
+                spill_sums["serve_spill_sessions"]
         with self._aff_lock:
             gauges["fleet_affinity_sessions"] = float(len(self._affinity))
         gauges.update(self._slo_burn(window_bad, window_total))
@@ -630,16 +694,26 @@ class FleetRouter:
         counters = metrics.get("counters") or {}
         bad = total = 0.0
         cur: dict[str, float] = {}
-        for name in _BAD_COUNTERS + (_TOTAL_COUNTER,):
+        for name in _BAD_COUNTERS + (_TOTAL_COUNTER,) + _SPILL_COUNTERS:
             cur[name] = float(counters.get(f"sharetrade_{name}", 0.0))
         prev = view.prev_counters
         view.prev_counters = cur
-        if prev and cur.get(_TOTAL_COUNTER, 0) >= prev.get(
-                _TOTAL_COUNTER, 0):
+        restarted = bool(prev) and cur.get(_TOTAL_COUNTER, 0) < prev.get(
+            _TOTAL_COUNTER, 0)
+        if prev and not restarted:
             for name in _BAD_COUNTERS:
                 bad += max(0.0, cur[name] - prev.get(name, 0.0))
             total = max(0.0, cur[_TOTAL_COUNTER]
                         - prev.get(_TOTAL_COUNTER, 0.0))
+        # Spill/adoption deltas fold into same-named fleet_ counters the
+        # soak reconciles EXACTLY against injected kills. A restarted
+        # engine's fresh counters ARE its window (rebase at zero); the
+        # first scrape of a new engine folds everything since its boot.
+        base = {} if restarted else (prev or {})
+        for name in _SPILL_COUNTERS:
+            d = cur[name] - base.get(name, 0.0)
+            if d > 0:
+                self.registry.inc("fleet_" + name[len("serve_"):], d)
         return bad, total
 
     def _slo_burn(self, window_bad: float,

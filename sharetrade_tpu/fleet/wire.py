@@ -66,6 +66,12 @@ SUBMIT_PATH = "/v1/submit"
 HEALTH_PATH = "/healthz"
 METRICS_PATH = "/metrics"
 DEADLINE_HEADER = "X-Deadline-Ms"
+#: The session's completed-response count as the ROUTER has observed it
+#: (ISSUE 20): forwarded on every proxy hop so an adopting engine can
+#: validate a spill record's step stamp against the session's expected
+#: clock — a stale record demotes to cold prefill instead of serving a
+#: rolled-back carry.
+CLOCK_HEADER = "X-Session-Clock"
 
 STATUS_OK = 200
 STATUS_BAD_REQUEST = 400

@@ -426,6 +426,38 @@ def summarize_run_dir(run_dir: str) -> dict:
                 "econ_ms_per_mb": gauges.get(
                     "serve_warm_econ_ms_per_mb"),
             }
+            if (gauges.get("serve_spill_budget_bytes")
+                    or counters.get("serve_spill_puts_total")):
+                # The 4th rung (ISSUE 20): the crash-consistent disk
+                # arena under the warm tier — how many carries sit
+                # spilled, the adoption split after a migration (warm =
+                # step stamp matched, cold = stale/torn/CRC-bad record
+                # demoted to prefill), and how often records were
+                # refused/corrupt. econ_ms_per_mb above already prices
+                # spill hits — an adoption re-enters through the warm
+                # store, so its saved prefill lands in warm_hits_total.
+                out["sessions"]["spill"] = {
+                    "sessions": gauges.get("serve_spill_sessions"),
+                    "bytes": gauges.get("serve_spill_bytes"),
+                    "budget_bytes": gauges.get(
+                        "serve_spill_budget_bytes"),
+                    "puts_total": counters.get(
+                        "serve_spill_puts_total", 0.0),
+                    "put_refusals_total": counters.get(
+                        "serve_spill_put_refusals_total", 0.0),
+                    "hits_total": counters.get(
+                        "serve_spill_hits_total", 0.0),
+                    "misses_total": counters.get(
+                        "serve_spill_misses_total", 0.0),
+                    "stale_total": counters.get(
+                        "serve_spill_stale_total", 0.0),
+                    "corrupt_total": counters.get(
+                        "serve_spill_corrupt_total", 0.0),
+                    "adopt_warm_total": counters.get(
+                        "serve_adopt_warm_total", 0.0),
+                    "adopt_cold_total": counters.get(
+                        "serve_adopt_cold_total", 0.0),
+                }
         if (manifest_tuning
                 or any(k.startswith(("serve_knob_", "serve_controller_",
                                      "ingest_"))
@@ -493,6 +525,15 @@ def summarize_run_dir(run_dir: str) -> dict:
                 "swap_lag_steps": fgauges.get("fleet_swap_lag_steps"),
                 "slo_availability_burn": fgauges.get(
                     "fleet_slo_availability_burn"),
+                # Spill-tier migration outcomes (ISSUE 20): fleet-wide
+                # parked-on-disk footprint plus the warm-vs-cold
+                # adoption split after engine deaths/drains.
+                "spill_sessions": fgauges.get("fleet_spill_sessions"),
+                "spill_bytes": fgauges.get("fleet_spill_bytes"),
+                "adopt_warm_total": (fs.get("counters") or {}).get(
+                    "fleet_adopt_warm_total", 0.0),
+                "adopt_cold_total": (fs.get("counters") or {}).get(
+                    "fleet_adopt_cold_total", 0.0),
                 "counters": fs.get("counters"),
                 # Selector-thread internals (ISSUE 19): which HTTP
                 # parse path is live (native C vs Python), open

@@ -124,15 +124,34 @@ session POPULATION far larger than its device arena:
   (``device_put`` + one jitted donated scatter) and the session
   continues BITWISE-identically to one that was never evicted — the
   round trip is an exact byte copy, the tier's acceptance oracle.
+- **spill** (ISSUE 20; ``serve.spill_dir``): the warm store's overflow
+  — and every live/parked carry at drain — seals into a
+  crash-consistent on-disk parked-carry arena (serve/spill.py: CRC +
+  step stamp + atomic rename), so RAM stops being the warm bound and a
+  carry survives its writer's SIGKILL. The arena directory is SHARED
+  across a fleet (fleet/pool.py): after an engine dies or drains, the
+  engine the router reassigns a session to ADOPTS its carry — paged in
+  iff the record's step stamp equals the session's expected clock (the
+  router-forwarded completed-response count; an engine-local take with
+  no clock accepts only its own incarnation's records). A stale, torn,
+  or CRC-bad record demotes to cold — injected corruption can change
+  latency, never bytes. Spill disk I/O rides the CONSUMER thread like
+  page-out readback does (the dispatcher enqueues put/take/delete ops
+  and only ever pays one ``os.stat`` probe); an adopted carry lands in
+  the warm store and re-enters through the same batched scatter path,
+  so an adopted session is bitwise an uninterrupted one.
 - **cold**: everything else — the pre-existing
   restart-through-batched-prefill path, unchanged, and still what a
-  warm-tier overflow demotes to (stalest parked carry first).
+  warm-tier overflow demotes to (stalest parked carry first) when the
+  spill tier is off or refuses the record.
 
 ``warm_bytes=0`` (default) disables the tier: every eviction is a cold
 restart, bitwise-identical to the PR-8 contract. Eviction economics is
 a live gauge: ``serve_warm_econ_ms_per_mb`` — prefill-recompute
 milliseconds avoided by warm hits this stats window, per MB of carry
-bytes held (EWMA'd cold device time × window hits / held MB).
+bytes held (EWMA'd cold device time × window hits / held MB). A spill
+adoption flows through the warm store and counts as a warm hit at
+admission, so the econ gauge prices spill hits too.
 
 With obs enabled (``obs.request_trace``), the lifecycle additionally
 emits through obs/trace.py as nested ASYNC spans keyed by
@@ -165,12 +184,18 @@ from sharetrade_tpu.models.core import apply_batched
 from sharetrade_tpu.obs import SERVE_STAGES
 from sharetrade_tpu.obs.hist import Histogram
 from sharetrade_tpu.precision import FP32, PrecisionPolicy
+from sharetrade_tpu.serve.spill import SpillArena
 from sharetrade_tpu.utils.logging import get_logger
 from sharetrade_tpu.utils.metrics import MetricsRegistry
 
 log = get_logger("serve")
 
 _SHUTDOWN = object()
+#: Done-queue nudge: the dispatcher enqueues spill ops for the consumer
+#: and pokes this sentinel (put_nowait — best-effort; a full queue means
+#: the consumer is already awake) so an IDLE consumer executes the disk
+#: ops now instead of after its 200 ms poll.
+_SPILL_TICK = object()
 
 #: Session ids made only of these characters embed into trace JSON
 #: without escaping (the fast path — harness/CLI ids are all of this
@@ -318,13 +343,20 @@ class _Request:
     outcome)."""
 
     __slots__ = ("session_id", "obs", "t_enq", "t_deadline", "callback",
-                 "_event", "result", "error", "trace")
+                 "_event", "result", "error", "trace", "clock")
 
     def __init__(self, session_id: Any, obs: np.ndarray,
                  callback: Callable[[ServeResult | None], None] | None,
-                 deadline_ms: float = 0.0, rid: int = 0):
+                 deadline_ms: float = 0.0, rid: int = 0,
+                 clock: int | None = None):
         self.session_id = session_id
         self.obs = obs
+        #: The session's EXPECTED step clock (ISSUE 20): the router's
+        #: completed-response count, forwarded over the wire on
+        #: migration so the adopting engine accepts a spilled carry iff
+        #: its step stamp matches. None = local submit, no fleet clock —
+        #: adoption falls back to the engine's own incarnation check.
+        self.clock = clock
         self.t_enq = time.perf_counter()
         #: Lifecycle stamps (always kept — the per-stage histograms' and
         #: SLO gauges' source; the async trace spans ride them when obs
@@ -373,6 +405,11 @@ class _DoneBatch(NamedTuple):
     #: host copies back through the dispatcher's park inbox.
     parked_sids: tuple = ()
     parked_rows: Any = None
+    #: The victims' dispatched-step stamps (parallel to parked_sids):
+    #: popped by the dispatcher at eviction time and carried through the
+    #:  readback so the committed warm entry — and any spill record it
+    #: later demotes into — is sealed with the right adoption clock.
+    parked_steps: tuple = ()
 
 
 class SlotPool:
@@ -448,7 +485,11 @@ class WarmStore:
     def __init__(self, max_bytes: int, max_sessions: int):
         self.max_bytes = int(max_bytes)
         self.max_sessions = max(1, int(max_sessions))
-        self._lru: OrderedDict[Any, tuple[Any, int]] = OrderedDict()
+        #: session -> (rows, nbytes, steps): the carry, its footprint,
+        #: and the session's dispatched-step stamp at park time (ISSUE
+        #: 20 — the stamp travels with the carry so a demotion to the
+        #: spill tier seals the right adoption clock into the record).
+        self._lru: OrderedDict[Any, tuple[Any, int, int]] = OrderedDict()
         self.bytes = 0
         # Event totals (dispatcher-thread writes; readers see ints).
         self.demotions = 0
@@ -458,26 +499,35 @@ class WarmStore:
     def __len__(self) -> int:
         return len(self._lru)
 
-    def pop(self, session_id: Any) -> Any | None:
-        """Remove and return a parked carry (the warm HIT — unpark);
-        None on a miss (never parked, demoted, or page-out still in
-        flight — cold either way)."""
+    def contains(self, session_id: Any) -> bool:
+        """Membership WITHOUT a recency refresh or removal — the
+        dispatcher's spill-probe gate (a RAM-parked session never needs
+        a disk take)."""
+        return session_id in self._lru
+
+    def pop(self, session_id: Any) -> tuple[Any, int] | None:
+        """Remove and return a parked ``(carry, steps)`` (the warm HIT —
+        unpark); None on a miss (never parked, demoted, or page-out
+        still in flight — cold either way)."""
         entry = self._lru.pop(session_id, None)
         if entry is None:
             return None
-        rows, nbytes = entry
+        rows, nbytes, steps = entry
         self.bytes -= nbytes
-        return rows
+        return rows, steps
 
     def discard(self, session_id: Any) -> None:
         """Forget a parked carry without returning it (poisoned/dropped
         sessions must not resurrect an old episode state)."""
         self.pop(session_id)
 
-    def put(self, session_id: Any, rows: Any, nbytes: int) -> list:
-        """Park one carry; returns the sessions DEMOTED to cold to make
-        room (stalest first). A carry that cannot fit the budget at all
-        is refused — the caller's session simply stays cold."""
+    def put(self, session_id: Any, rows: Any, nbytes: int,
+            steps: int = 0) -> list:
+        """Park one carry; returns the ENTRIES demoted to make room
+        (stalest first, as ``(session, rows, nbytes, steps)`` tuples —
+        the caller spills them to disk when the spill tier is on, or
+        lets them fall to cold). A carry that cannot fit the budget at
+        all is refused — the caller's session simply stays cold."""
         nbytes = int(nbytes)
         if nbytes <= 0 or nbytes > self.max_bytes:
             self.refusals += 1
@@ -485,7 +535,7 @@ class WarmStore:
         old = self._lru.pop(session_id, None)
         if old is not None:
             self.bytes -= old[1]
-        self._lru[session_id] = (rows, nbytes)
+        self._lru[session_id] = (rows, nbytes, int(steps))
         self.bytes += nbytes
         demoted = []
         # The boundedness contract: demote stalest-first until both the
@@ -493,10 +543,10 @@ class WarmStore:
         # just parked fits the budget on its own).
         while (self.bytes > self.max_bytes
                or len(self._lru) > self.max_sessions):
-            victim, (_, vbytes) = self._lru.popitem(last=False)
+            victim, (vrows, vbytes, vsteps) = self._lru.popitem(last=False)
             self.bytes -= vbytes
             self.demotions += 1
-            demoted.append(victim)
+            demoted.append((victim, vrows, vbytes, vsteps))
         return demoted
 
 
@@ -555,6 +605,16 @@ class ServeEngine:
             raise ConfigError(
                 f"serve.warm_max_sessions must be >= 1, got "
                 f"{cfg.warm_max_sessions}")
+        if cfg.spill_bytes < 0:
+            raise ConfigError(
+                f"serve.spill_bytes must be >= 0 (the spill tier is "
+                f"byte-bounded like warm_bytes), got {cfg.spill_bytes}")
+        if cfg.spill_dir and cfg.warm_bytes <= 0:
+            raise ConfigError(
+                "serve.spill_dir requires the warm tier "
+                "(serve.warm_bytes > 0): the spill arena is the warm "
+                "store's overflow and an adopted carry re-enters through "
+                "it")
         self.model = model
         self.cfg = cfg
         self._precision = precision
@@ -576,6 +636,9 @@ class ServeEngine:
         #: nothing a warm tier could preserve).
         self._warm_enabled = (cfg.warm_bytes > 0
                               and self._carry_nbytes > 0)
+        #: Spill tier on only with a configured arena directory AND a
+        #: live warm tier to overflow from / adopt into.
+        self._spill_enabled = bool(cfg.spill_dir) and self._warm_enabled
         self._build_arena_and_programs()
 
         # Live tunable knobs (tuned-knob-ok: seeded from config — the
@@ -776,6 +839,47 @@ class ServeEngine:
         # trace-buffer-ok: bounded by in-flight batches
         # (done_depth * max_batch entries at most)
         self._park_inbox: deque = deque()
+        # ---- spill tier (ISSUE 20) ----------------------------------
+        #: Per-session dispatched-step counts for HOT sessions (the
+        #: adoption-clock source; travels into WarmStore entries and
+        #: spill records at park time). Dispatcher-owned; bounded by
+        #: the slot-pool capacity — entries are popped at eviction.
+        self._steps: dict[Any, int] = {}
+        #: Disk-op FIFO dispatcher -> consumer ("put"/"del"/"take"
+        #: tuples): the dispatcher NEVER touches the arena files beyond
+        #: an os.stat probe — all real I/O rides the consumer, like
+        #: page-out readback (lint checks 8/17/19).
+        # Puts are warm-store demotions (bounded by the park inbox);
+        # takes are capped by _spill_inflight — one per distinct
+        # deferred session, itself capped by the ingress bound.
+        # trace-buffer-ok: bounded by park inbox + _spill_inflight
+        self._spill_ops: deque = deque()
+        #: Completed takes consumer -> dispatcher: (sid, rows|None,
+        #: steps, reason) — drained at the top of batch collection.
+        # trace-buffer-ok: bounded by _spill_inflight
+        self._spill_inbox: deque = deque()
+        #: Sessions with a take in flight: their requests DEFER (the
+        #: carry is coming — admitting them cold would fork the
+        #: episode). Dispatcher-owned.
+        self._spill_inflight: set = set()
+        if self._spill_enabled:
+            # A fresh incarnation per (re)build: an engine-local take
+            # with no fleet clock accepts only same-incarnation records,
+            # so the supervised-restart contract (a rebuilt engine
+            # serves only cold re-entries) survives the spill tier —
+            # every pre-fault record reads as stale to the rebuilt
+            # engine, while a CLOCKED fleet take can still adopt it.
+            self._incarnation = os.urandom(8).hex()
+            self._arena: SpillArena | None = SpillArena(
+                cfg.spill_dir, max_bytes=cfg.spill_bytes,
+                record_nbytes=self._carry_nbytes,
+                incarnation=self._incarnation)
+        else:
+            self._arena = None
+        #: Last spill-gauge re-anchor (perf_counter): shared cadence
+        #: between the consumer's stats publish and the health-probe
+        #: refresh, so the two never double-scan one window.
+        self._spill_scan_t = 0.0
         n_arena = cfg.slots + cfg.max_batch
         self._pool = jax.tree.map(
             lambda x: jnp.repeat(jnp.asarray(x)[None], n_arena, axis=0),
@@ -854,7 +958,8 @@ class ServeEngine:
 
     def submit(self, session_id: Any, obs: Any,
                callback: Callable[[ServeResult], None] | None = None,
-               *, deadline_ms: float | None = None) -> _Request:
+               *, deadline_ms: float | None = None,
+               session_clock: int | None = None) -> _Request:
         """Enqueue one ``(window, portfolio)`` query; thread-safe. Returns
         a handle whose :meth:`_Request.wait` blocks for the response;
         ``callback(result)`` additionally fires on the consumer thread.
@@ -862,6 +967,12 @@ class ServeEngine:
         ``deadline_ms`` bounds how long the request may wait before it is
         completed with a :class:`ServeDeadlineExceeded` error instead of
         being served (None = ``serve.default_deadline_ms``; 0 = none).
+
+        ``session_clock`` (ISSUE 20) is the session's expected
+        completed-response count, forwarded by the fleet router on
+        migration: a spilled carry is adopted warm iff its step stamp
+        matches this; None (local submits) restricts adoption to records
+        this engine incarnation wrote.
 
         NEVER blocks on a full queue: past ``serve.max_queue`` the
         request is refused (``shed_policy="reject"``) or the oldest
@@ -880,7 +991,9 @@ class ServeEngine:
         if deadline_ms is None:
             deadline_ms = self.cfg.default_deadline_ms
         req = _Request(session_id, np.asarray(obs, np.float32), callback,
-                       deadline_ms=deadline_ms, rid=next(self._rid))
+                       deadline_ms=deadline_ms, rid=next(self._rid),
+                       clock=(int(session_clock)
+                              if session_clock is not None else None))
         with self._pending_lock:
             self._pending += 1
         self._registry.inc("serve_requests_total")
@@ -1181,6 +1294,76 @@ class ServeEngine:
         self._publish_stats(force=True)
         return ok
 
+    def page_out_all(self) -> dict[str, int]:
+        """Drain-time warm handoff (ISSUE 20): seal EVERY surviving
+        carry — RAM-parked, hot slot rows, and in-flight page-outs/
+        adoptions — into the spill arena, so the engines this one's
+        sessions are reassigned to adopt them warm instead of paying the
+        cold-restart prefill for the whole population.
+
+        ORDERING CONTRACT (the drain test asserts it): drain →
+        ``stop()`` → ``page_out_all()`` → exit 75. This method REFUSES
+        while either worker thread is alive — a live dispatcher still
+        mutates the stores and a live consumer still owes page-out
+        readbacks; only after ``stop()`` does the caller's thread own
+        every structure (and may block on device readback freely).
+
+        Returns ``{"written", "refused", "skipped_takes"}`` for the cli
+        shutdown summary; all-zero without a spill arena."""
+        if self._dispatcher.is_alive() or self._consumer.is_alive():
+            raise RuntimeError(
+                "page_out_all() before stop(): the dispatcher/consumer "
+                "threads still own the session stores — the drain "
+                "ordering is drain -> stop() -> page_out_all() -> exit")
+        counts = {"written": 0, "refused": 0, "skipped_takes": 0}
+        arena = self._arena
+        if arena is None:
+            return counts
+        counts["skipped_takes"] = sum(
+            1 for op in self._spill_ops if op[0] == "take")
+        # Settle queued ops first: puts seal, deletes tombstone, takes
+        # skip (stop_event is set — the records stay for adopters).
+        self._drain_spill_ops()
+
+        def _seal(sid: Any, rows: Any, steps: int) -> None:
+            if arena.put(sid, jax.tree.leaves(rows), steps):
+                counts["written"] += 1
+                self._registry.inc("serve_spill_puts_total")
+            else:
+                counts["refused"] += 1
+                self._registry.inc("serve_spill_put_refusals_total")
+
+        # Page-outs the consumer read back that never committed, and
+        # adopted takes that never reached a batch: their state exists
+        # ONLY in these inboxes now — seal or the carry dies here.
+        while self._park_inbox:
+            sid, rows, steps = self._park_inbox.popleft()
+            if not self._slots.contains(sid):
+                _seal(sid, rows, steps)
+        while self._spill_inbox:
+            sid, rows, steps, _reason = self._spill_inbox.popleft()
+            if rows is not None and not self._slots.contains(sid):
+                _seal(sid, rows, steps)
+        # The RAM-warm population (single-owner map — the dispatcher
+        # that owned it is provably dead).
+        for sid, (rows, _nbytes, steps) in list(self._warm._lru.items()):
+            _seal(sid, rows, steps)
+        # The hot population: ONE bulk arena readback, then per-session
+        # row copies. serve-host-ok: post-stop, the caller's thread.
+        if len(self._slots):
+            host_pool = jax.device_get(self._pool)
+            for sid, slot in self._slots._lru.items():
+                rows = jax.tree.map(
+                    lambda x: np.asarray(x[slot]).copy(), host_pool)
+                _seal(sid, rows, self._steps.get(sid, 0))
+        log.info(
+            "drain page-out sealed %d carr%s to the spill arena "
+            "(%d refused, %d takes left for adopters)",
+            counts["written"], "y" if counts["written"] == 1 else "ies",
+            counts["refused"], counts["skipped_takes"])
+        self._publish_stats(force=True)
+        return counts
+
     # -- dispatcher thread ------------------------------------------------
 
     def _serve_loop(self) -> None:
@@ -1198,7 +1381,9 @@ class ServeEngine:
             # in flight this tick may still read the advanced carry; the
             # supervision rebuild (max_restarts > 0) resets even that.
             while self._poisoned:
-                self._slots.drop(self._poisoned.popleft())
+                sid = self._poisoned.popleft()
+                self._slots.drop(sid)
+                self._steps.pop(sid, None)
             if self._restart_requested.is_set():
                 self._restart_requested.clear()
                 # Epoch-gate: a fault from a batch dispatched before the
@@ -1266,6 +1451,7 @@ class ServeEngine:
             # the failure as a None result, or the session silently leaks
             # out of their bookkeeping.
             self._slots.drop(req.session_id)
+            self._steps.pop(req.session_id, None)
             self._finish_failed(req, exc)
 
     # -- dispatch supervision (serve.max_restarts > 0) --------------------
@@ -1396,6 +1582,16 @@ class ServeEngine:
         # ONE knob read per tick (the _Live atomicity pattern): a
         # mid-collection set_knobs never hands this tick a mixed vector.
         knobs = self._knobs
+        # Commit parked rows BEFORE adopted disk takes: both land in the
+        # WarmStore, and when the warm budget overflows the store demotes
+        # its stalest entry — a carry adopted this tick must be the
+        # freshest so the park-inbox commit can never demote it back to
+        # disk before its deferred request re-collects.
+        self._drain_park_inbox()
+        # Commit any completed disk takes next: their sessions' deferred
+        # requests un-defer this very tick (and the drain below must see
+        # an up-to-date _spill_inflight).
+        self._drain_spill_inbox()
         batch: list[_Request] = []
         seen: set = set()
         kept: deque[_Request] = deque()  # trace-buffer-ok: re-queued subset
@@ -1405,7 +1601,8 @@ class ServeEngine:
             req = self._deferred.popleft()
             if self._expire_if_dead(req, now):
                 continue
-            if req.session_id in seen or len(batch) >= cfg.max_batch:
+            if (req.session_id in seen or len(batch) >= cfg.max_batch
+                    or self._maybe_begin_spill_take(req)):
                 req.trace.deferrals += 1
                 kept.append(req)
             else:
@@ -1414,11 +1611,22 @@ class ServeEngine:
                 seen.add(req.session_id)
         self._deferred = kept
         if not batch:
+            # Idle poll — EXCEPT while a disk take is in flight: the
+            # consumer resolves one in µs, and sleeping the full idle
+            # interval would bill that 50ms to the adopting session's
+            # first response (the spill soak's recovery p99 would eat
+            # it whole). _spill_inflight is dispatcher-owned state, so
+            # this read races nothing.
+            timeout = 0.002 if self._spill_inflight else 0.05
             try:
-                req = self._q.get(timeout=0.05)
+                req = self._q.get(timeout=timeout)
             except queue.Empty:
                 return []
             if self._expire_if_dead(req, time.perf_counter()):
+                return []
+            if self._maybe_begin_spill_take(req):
+                req.trace.deferrals += 1
+                self._deferred.append(req)
                 return []
             req.trace.t_collected = time.perf_counter()
             batch.append(req)
@@ -1465,6 +1673,10 @@ class ServeEngine:
                 req.trace.deferrals += 1
                 self._deferred.append(req)
             else:
+                if self._maybe_begin_spill_take(req):
+                    req.trace.deferrals += 1
+                    self._deferred.append(req)
+                    continue
                 req.trace.t_collected = time.perf_counter()
                 batch.append(req)
                 seen.add(req.session_id)
@@ -1478,9 +1690,21 @@ class ServeEngine:
         """Admit, partition cold/warm, dispatch the tick's program(s).
         Runs on the dispatch critical path: NO blocking host ops here
         (tools/lint_hot_loop.py check 8) — jit calls return asynchronously
-        and readback belongs to ``_complete_batch``."""
-        self._drain_park_inbox()
+        and readback belongs to ``_complete_batch``.  Park-inbox rows are
+        committed twice per tick: by ``_collect_batch`` BEFORE the
+        spill-inbox drain (so a carry adopted from disk lands freshest in
+        the WarmStore and cannot be demoted by an older park), and again
+        here for any readback that completed during the collection wait —
+        otherwise a session evicted last tick could miss its own parked
+        carry at admission and restart cold.  The order keeps both
+        invariants: every pre-admission park is committed, and adopted
+        takes (committed between the two park drains) stay ahead of every
+        park that was pending when they landed."""
         pinned = {r.session_id for r in batch}
+        # Batch-pinned carries this drain's commits pushed out of the
+        # warm budget come back here — admission consumes them below in
+        # place of a warm pop (see _drain_park_inbox).
+        rescued = self._drain_park_inbox(pinned=pinned)
         cold_reqs: list[_Request] = []
         cold_idx: list[int] = []
         warm_reqs: list[_Request] = []
@@ -1488,17 +1712,37 @@ class ServeEngine:
         evicted = 0
         park_sids: list[Any] = []       # this tick's eviction victims …
         park_slots: list[int] = []      # … and the arena rows they held
+        park_steps: list[int] = []      # … and their step stamps
         unpark_slots: list[int] = []    # slots taking a parked carry back
         unpark_rows: list[Any] = []     # the parked host carries
         warm_on = self._warm_enabled
         for req in batch:
-            slot = self._slots.lookup(req.session_id)
+            sid = req.session_id
+            slot = self._slots.lookup(sid)
             if slot is not None:
+                if warm_on:
+                    # Dispatched-step clock of a hot session: +1 per
+                    # dispatch, so a later park stamps the record with
+                    # exactly the completed-response count the router
+                    # tracks for the session (the adoption rendezvous).
+                    self._steps[sid] = self._steps.get(sid, 0) + 1
                 warm_reqs.append(req)
                 warm_idx.append(slot)
                 continue
-            parked = self._warm.pop(req.session_id) if warm_on else None
-            slot, victim = self._slots.admit(req.session_id, pinned)
+            parked = rescued.pop(sid, None) if warm_on else None
+            if parked is None and warm_on:
+                parked = self._warm.pop(sid)
+            if (parked is not None and req.clock is not None
+                    and parked[1] != req.clock):
+                # RAM-parked carry from an earlier stint of this session
+                # on THIS engine, superseded while the session lived
+                # elsewhere (the router's clock outran the stamp):
+                # serving it warm would change bytes — drop it and
+                # restart cold, the same stale demotion disk records get.
+                self._warm.stale_drops += 1
+                self._registry.inc("serve_warm_stale_drops_total")
+                parked = None
+            slot, victim = self._slots.admit(sid, pinned)
             if victim is not None:
                 evicted += 1
                 if warm_on:
@@ -1508,20 +1752,50 @@ class ServeEngine:
                     # program or install writes the row).
                     park_sids.append(victim)
                     park_slots.append(slot)
+                    park_steps.append(self._steps.pop(victim, 0))
             if parked is not None:
                 # Warm HIT: the parked carry reinstalls into the new
                 # slot and the session continues through the warm path,
-                # bitwise as if never evicted.
+                # bitwise as if never evicted. (A spill-adopted carry
+                # landed in the warm store first, so it arrives here —
+                # the econ gauge prices spill hits for free.)
+                rows, psteps = parked
                 self._registry.inc("serve_warm_hits_total")
+                self._steps[sid] = psteps + 1
                 unpark_slots.append(slot)
-                unpark_rows.append(parked)
+                unpark_rows.append(rows)
                 warm_reqs.append(req)
                 warm_idx.append(slot)
             else:
                 if warm_on:
                     self._registry.inc("serve_warm_misses_total")
+                    # Cold (re)start: re-anchor the step clock to the
+                    # router's view when one was forwarded — the carry
+                    # built from here on corresponds to clock+1 completed
+                    # responses, so later spills stamp adoptably even
+                    # after a mid-life cold restart.
+                    self._steps[sid] = (req.clock + 1
+                                        if req.clock is not None else 1)
+                    if req.clock:
+                        # A session the fleet believes has history is
+                        # restarting through prefill: a COLD adoption
+                        # (counted against warm ones per migration).
+                        self._registry.inc("serve_adopt_cold_total")
+                    if self._spill_enabled:
+                        # Unconditional tombstone: a cold (re)start
+                        # invalidates any record the arena still holds
+                        # for this session (e.g. one sealed by a racing
+                        # put after our probe missed) — stale episode
+                        # state must never outlive the restart.
+                        self._spill_ops.append(("del", sid))
+                        self._kick_consumer()
                 cold_reqs.append(req)
                 cold_idx.append(slot)
+        for sid, (rows, psteps) in rescued.items():
+            # Defensive: a rescued carry whose session somehow took the
+            # hot path (slots and warm store are disjoint, so this
+            # should be unreachable) re-parks instead of silently dying.
+            self._commit_warm(sid, rows, psteps)
         parked_rows = None
         if park_sids:
             # Page-out step 1 (dispatch side): ONE batched gather of the
@@ -1580,7 +1854,8 @@ class ServeEngine:
                           cold=len(cold_reqs), evicted=evicted,
                           epoch=self._fault_epoch,
                           parked_sids=tuple(park_sids),
-                          parked_rows=parked_rows)
+                          parked_rows=parked_rows,
+                          parked_steps=tuple(park_steps))
 
     def _pad(self, reqs: list[_Request],
              idx: list[int]) -> tuple[np.ndarray, np.ndarray]:
@@ -1600,23 +1875,116 @@ class ServeEngine:
 
     # -- session paging (dispatch side) -----------------------------------
 
-    def _drain_park_inbox(self) -> None:
+    def _drain_park_inbox(self, pinned: set | None = None
+                          ) -> dict[Any, tuple[Any, int]]:
         """Commit consumer-read-back page-outs into the warm store.
         Dispatcher-only, so ALL admission state (slot pool + warm store)
         has one owner and no insert can race an unpark. An entry whose
         session re-entered the slot pool before its page-out committed
         is STALE — that session already restarted cold and its old
-        episode state must never resurrect — and is dropped."""
+        episode state must never resurrect — and is dropped.
+
+        ``pinned`` is the pre-admission call's batch membership: a
+        commit here may overflow the warm budget and demote a carry
+        whose session is about to be admitted THIS tick (with a 1-carry
+        budget, any park between a spill-take commit and its deferred
+        request's admission would bounce the adopted carry straight
+        back out). Such victims are RESCUED — returned as
+        ``{sid: (rows, steps)}`` for admission to consume directly —
+        instead of spilled/dropped; everyone else demotes normally."""
+        rescued: dict[Any, tuple[Any, int]] = {}
         while self._park_inbox:
-            sid, rows = self._park_inbox.popleft()
+            sid, rows, steps = self._park_inbox.popleft()
             if self._slots.contains(sid):
                 self._warm.stale_drops += 1
                 self._registry.inc("serve_warm_stale_drops_total")
                 continue
-            demoted = self._warm.put(sid, rows, self._carry_nbytes)
-            if demoted:
-                self._registry.inc("serve_warm_demotions_total",
-                                   len(demoted))
+            self._commit_warm(sid, rows, steps, pinned=pinned,
+                              rescued=rescued)
+        return rescued
+
+    def _commit_warm(self, sid: Any, rows: Any, steps: int, *,
+                     pinned: set | None = None,
+                     rescued: dict | None = None) -> None:
+        """Park one host carry in the warm store; overflow demotes to
+        the spill arena (tier on) or to cold (off — the ISSUE-18
+        contract, unchanged), except batch-pinned victims, which land
+        in ``rescued`` for this tick's admission. Dispatcher-only."""
+        demoted = self._warm.put(sid, rows, self._carry_nbytes, steps)
+        if demoted and pinned:
+            kept = []
+            for victim, vrows, _vnbytes, vsteps in demoted:
+                if victim in pinned and rescued is not None:
+                    rescued[victim] = (vrows, vsteps)
+                    # Not a real demotion — admission consumes it in a
+                    # moment, exactly as a warm pop would have.
+                    self._warm.demotions -= 1
+                else:
+                    kept.append((victim, vrows, _vnbytes, vsteps))
+            demoted = kept
+        if demoted:
+            self._registry.inc("serve_warm_demotions_total",
+                               len(demoted))
+            self._spill_demoted(demoted)
+
+    def _spill_demoted(self, demoted: list) -> None:
+        """Route warm-store overflow toward the disk arena: enqueue one
+        put op per demoted entry for the CONSUMER to seal (dispatch
+        never touches the files). With the spill tier off the entries
+        simply fall to cold."""
+        if not self._spill_enabled:
+            return
+        for sid, rows, _nbytes, steps in demoted:
+            self._spill_ops.append(("put", sid, rows, steps))
+        self._kick_consumer()
+
+    def _kick_consumer(self) -> None:
+        """Nudge an idle consumer to run the queued spill ops now
+        (best-effort: a full done queue means it is already awake and
+        drains the op FIFO after its current batch)."""
+        try:
+            self._done_q.put_nowait(_SPILL_TICK)
+        except queue.Full:
+            pass
+
+    def _drain_spill_inbox(self) -> None:
+        """Commit completed disk takes into the warm store and release
+        their sessions from the deferral set. Dispatcher-only (the
+        admission-state single-owner rule); the consumer only appends.
+        A hit whose session somehow re-entered the pool meanwhile is
+        dropped like a stale page-out — never overwrite a live episode."""
+        while self._spill_inbox:
+            sid, rows, steps, _reason = self._spill_inbox.popleft()
+            self._spill_inflight.discard(sid)
+            if rows is None:
+                continue        # miss/stale/corrupt: the session lands cold
+            if self._slots.contains(sid):
+                self._warm.stale_drops += 1
+                self._registry.inc("serve_warm_stale_drops_total")
+                continue
+            self._commit_warm(sid, rows, steps)
+
+    def _maybe_begin_spill_take(self, req: _Request) -> bool:
+        """Collection-time spill gate: True when the request must DEFER
+        (the caller re-queues it) behind a disk take — either one
+        already in flight for its session, or the one this call just
+        enqueued. The only dispatch-side arena touch is probe()'s
+        ``os.stat`` (µs — the read itself rides the consumer, lint
+        checks 8/19); sessions with no sealed record admit cold on this
+        very tick and pay nothing."""
+        if not self._spill_enabled:
+            return False
+        sid = req.session_id
+        if sid in self._spill_inflight:
+            return True
+        if self._slots.contains(sid) or self._warm.contains(sid):
+            return False        # hot or RAM-warm: no disk involved
+        if not self._arena.probe(sid):
+            return False
+        self._spill_ops.append(("take", sid, req.clock))
+        self._spill_inflight.add(sid)
+        self._kick_consumer()
+        return True
 
     def _install_parked(self, rows: list[Any], slots: list[int]) -> Any:
         """Unpark: stack the tick's parked host carries, pad to the
@@ -1660,13 +2028,27 @@ class ServeEngine:
                         try:
                             item = self._done_q.get_nowait()
                         except queue.Empty:
+                            # Exit debt: queued spill PUTS still seal
+                            # (demoted carries must not die with the
+                            # process); takes skip — their requesters
+                            # were failed, and a consumed record would
+                            # be lost to the adopting engine.
+                            self._drain_spill_ops()
                             return
-                        if item is not _SHUTDOWN:
+                        if (item is not _SHUTDOWN
+                                and item is not _SPILL_TICK):
                             self._consume_done(item)
                 continue
             if item is _SHUTDOWN:
+                self._drain_spill_ops()
                 return
+            if item is _SPILL_TICK:
+                self._drain_spill_ops()
+                continue
             self._consume_done(item)
+            # Safety net behind the best-effort _kick_consumer: ops
+            # enqueued while the done queue was full drain here.
+            self._drain_spill_ops()
 
     def _consume_done(self, item: _DoneBatch) -> None:
         try:
@@ -1712,6 +2094,69 @@ class ServeEngine:
             self._consumer_fault_epoch = item.epoch
             self._restart_requested.set()
 
+    #: Arena take verdicts -> registry counters (the fleet router folds
+    #: these per engine into fleet_spill_* — ISSUE 20 observability).
+    _SPILL_REASON_COUNTERS = {
+        "hit": "serve_spill_hits_total",
+        "miss": "serve_spill_misses_total",
+        "stale": "serve_spill_stale_total",
+        "corrupt": "serve_spill_corrupt_total",
+    }
+
+    def _drain_spill_ops(self) -> None:
+        """Execute queued arena ops — the ONLY place spill disk I/O
+        happens while the engine runs (consumer thread; dispatch only
+        enqueues, lint checks 8/17/19). Once the stop event is set,
+        takes are SKIPPED instead of executed: their requesters are
+        being failed, and consuming the record here would steal the
+        carry from whichever engine adopts the session next."""
+        arena = self._arena
+        if arena is None:
+            return
+        reg = self._registry
+        skip_takes = self._stop_event.is_set()
+        while self._spill_ops:
+            op = self._spill_ops.popleft()
+            kind = op[0]
+            if kind == "put":
+                _, sid, rows, steps = op
+                ok = arena.put(sid, jax.tree.leaves(rows), steps)
+                reg.inc("serve_spill_puts_total" if ok
+                        else "serve_spill_put_refusals_total")
+            elif kind == "del":
+                arena.delete(op[1])
+            elif skip_takes:
+                self._spill_inbox.append((op[1], None, 0, "skipped"))
+            else:
+                _, sid, clock = op
+                payload, steps, reason, foreign = arena.take(sid, clock)
+                reg.inc(self._SPILL_REASON_COUNTERS[reason])
+                if reason == "hit" and clock is not None and foreign:
+                    # A clocked hit on ANOTHER incarnation's record is
+                    # a cross-engine warm ADOPTION (this engine's own
+                    # re-reads — spill thrash — deliberately don't
+                    # count; the soak reconciles this exactly).
+                    reg.inc("serve_adopt_warm_total")
+                rows = (self._rows_from_payload(payload)
+                        if payload is not None else None)
+                self._spill_inbox.append((sid, rows, steps, reason))
+
+    def _rows_from_payload(self, payload: bytes) -> Any:
+        """Rebuild a carry tree from a spill record's raw payload: split
+        against this engine's carry template in ``jax.tree`` order (the
+        order the writer concatenated; the arena already validated the
+        total byte length, so a foreign-model record never reaches
+        here)."""
+        leaves, treedef = jax.tree.flatten(self._carry0)
+        out, off = [], 0
+        for leaf in leaves:
+            n = int(leaf.size)
+            arr = np.frombuffer(payload, dtype=leaf.dtype, count=n,
+                                offset=off)
+            out.append(arr.reshape(leaf.shape).copy())
+            off += n * leaf.dtype.itemsize
+        return jax.tree.unflatten(treedef, out)
+
     def _complete_batch(self, done: _DoneBatch) -> None:
         """Readback + request completion + SLO accounting — the consumer
         side of the split; blocking host work is EXPECTED here. The
@@ -1732,7 +2177,7 @@ class ServeEngine:
             for i, sid in enumerate(done.parked_sids):
                 row = jax.tree.map(lambda x: np.asarray(x[i]).copy(),
                                    host_rows)
-                self._park_inbox.append((sid, row))
+                self._park_inbox.append((sid, row, done.parked_steps[i]))
             self._registry.inc("serve_warm_parks_total",
                                len(done.parked_sids))
         # Batch-level trace buffer: one bulk tracer append per completed
@@ -1885,6 +2330,28 @@ class ServeEngine:
             merged = list(self._exemplars) + list(self._window_slowest)
         return sorted(merged, key=lambda e: -e["latency_ms"])
 
+    def refresh_spill_gauges(self) -> None:
+        """Health-probe hook (the fleet scrape path calls this): re-
+        anchor and republish the spill-arena census gauges even while
+        no batch is completing. The stats cadence rides batch
+        completions, so an idle engine's last in-traffic publish would
+        otherwise freeze ``serve_spill_bytes/sessions`` exactly when a
+        drain or kill decision wants them (the population quiesces,
+        THEN someone reads the fleet sums). One bounded scandir at the
+        stats cadence, callable from any scrape thread — the same
+        budget class as the dispatcher's admission-time ``probe``."""
+        arena = self._arena
+        if arena is None:
+            return
+        now = time.perf_counter()
+        if now - self._spill_scan_t < self.cfg.stats_interval_s:
+            return
+        self._spill_scan_t = now
+        arena.scan_usage()
+        self._registry.record_many({
+            "serve_spill_bytes": float(arena.bytes),
+            "serve_spill_sessions": float(arena.sessions)})
+
     def _publish_stats(self, *, force: bool = False,
                        io_ok: bool = True) -> None:
         """SLO gauges at ``stats_interval_s`` cadence. Callers: the
@@ -1970,9 +2437,24 @@ class ServeEngine:
             d_hits = max(0.0, hits - self._prev_warm_hits)
             self._prev_warm_hits = hits
             held_mb = warm.bytes / 2**20
+            # serve_warm_hits_total counts SPILL hits too (an adopted
+            # carry re-enters through the warm store), so the econ
+            # gauge prices the whole warm+spill tier per RAM MB held.
             row["serve_warm_econ_ms_per_mb"] = (
                 d_hits * self._ewma_prefill_ms / held_mb
                 if held_mb > 0 else 0.0)
+        if self._arena is not None:
+            arena = self._arena
+            if io_ok:
+                # Re-anchor the approximate usage counters with one
+                # bounded scandir — consumer/stop threads only (io_ok
+                # keeps the failure-path publishes, which run on submit/
+                # dispatcher threads, off the filesystem).
+                arena.scan_usage()
+                self._spill_scan_t = now
+            row["serve_spill_bytes"] = float(arena.bytes)
+            row["serve_spill_sessions"] = float(arena.sessions)
+            row["serve_spill_budget_bytes"] = float(arena.max_bytes)
         row.update(self._slo_burn(now, term))
         self._registry.record_many(row)
         self._fold_exemplars(overloaded, io_ok)

@@ -12,6 +12,12 @@ learner, a fresh ``tag_best`` hot-swapped into EVERY engine — healthz
 gauges are live, router counters balance exactly, and SIGTERM drains
 the whole tier with exit 75. The full soak — >=3 engines, >=3 kills —
 is the ``slow``-marked variant (also ``make fleet-soak``).
+
+The spill soak (ISSUE 20) kills an engine UNDER a populated spill
+arena: survivors must adopt the victim's sessions warm from disk (the
+majority — only the injected-corruption record and the in-memory tail
+restart cold), the fleet adoption counters must reconcile exactly, and
+the drain must seal the entire population for the next incarnation.
 """
 
 import os
@@ -73,6 +79,35 @@ class TestAutoscaleSoak:
         assert summary["traffic"]["failed"] == 0
         assert summary["traffic"]["completed"] > 0
         assert summary["drain_rc"] == 75
+
+
+class TestSpillSoak:
+    def test_kill_under_population_warm_majority(self, tmp_path):
+        """SIGKILL the engine holding the most spilled carries while the
+        arena holds a populated session census (one record injected with
+        corruption): survivors adopt the MAJORITY warm, the fleet
+        adoption/corruption counters reconcile EXACTLY against the
+        census, and the final drain seals every session's carry for the
+        next incarnation (the warm-handoff half of ISSUE 20)."""
+        summary = fleet_soak.run_spill_soak(
+            engines=2, sessions=24, rounds=2, workdir=str(tmp_path))
+        assert summary["ok"] is True
+        recon = summary["recon"]
+        census = summary["census"]
+        # Exact reconciliation: every spilled victim session adopted
+        # warm except the one corrupted record; every in-memory victim
+        # session (plus the corrupt one) restarted cold.
+        assert recon["fleet_adopt_warm_total"] == \
+            census["victim_spilled"] - 1
+        assert recon["fleet_adopt_cold_total"] == \
+            census["victim_memory"] + 1
+        assert recon["fleet_spill_corrupt_total"] == 1
+        assert recon["fleet_spill_stale_total"] == 0
+        assert recon["fleet_adopt_warm_total"] > \
+            recon["fleet_adopt_cold_total"]
+        assert summary["drain_rc"] == 75
+        # Drain-time page-out: one sealed record per session, none lost.
+        assert summary["arena_records_after_drain"] == 24
 
 
 @pytest.mark.slow

@@ -120,19 +120,23 @@ def _carry_nbytes(model) -> int:
 class TestWarmStore:
     def test_lru_demotes_stalest_first_and_hits_refresh(self):
         store = WarmStore(max_bytes=300, max_sessions=64)
-        for sid in ("a", "b", "c"):
-            assert store.put(sid, rows=sid.upper(), nbytes=100) == []
+        for i, sid in enumerate(("a", "b", "c")):
+            assert store.put(sid, rows=sid.upper(), nbytes=100,
+                             steps=i + 1) == []
         assert store.bytes == 300 and len(store) == 3
-        # A hit removes the entry (unpark moves it back to hot)...
-        assert store.pop("a") == "A"
+        # A hit removes the entry and hands back the carry WITH its
+        # park-time step stamp (the adoption clock travels with the
+        # carry — ISSUE 20)...
+        assert store.pop("a") == ("A", 1)
         assert store.bytes == 200
         # ...and re-parking makes it the FRESHEST: the next overflow
-        # demotes b (now stalest), not a.
-        assert store.put("a", "A2", 100) == []
-        assert store.put("d", "D", 100) == ["b"]
+        # demotes b (now stalest) as a full (sid, rows, nbytes, steps)
+        # entry — exactly what the spill tier seals to disk.
+        assert store.put("a", "A2", 100, steps=4) == []
+        assert store.put("d", "D", 100) == [("b", "B", 100, 2)]
         assert store.demotions == 1
         assert store.pop("b") is None           # demoted = cold
-        assert store.pop("a") == "A2"
+        assert store.pop("a") == ("A2", 4)
 
     def test_byte_budget_refuses_oversize_carry(self):
         store = WarmStore(max_bytes=100, max_sessions=64)
@@ -146,7 +150,7 @@ class TestWarmStore:
         store = WarmStore(max_bytes=1 << 20, max_sessions=2)
         store.put("a", "A", 10)
         store.put("b", "B", 10)
-        assert store.put("c", "C", 10) == ["a"]
+        assert store.put("c", "C", 10) == [("a", "A", 10, 0)]
         assert len(store) == 2 and store.bytes == 20
 
     def test_reput_same_session_replaces_bytes(self):
@@ -154,7 +158,7 @@ class TestWarmStore:
         store.put("a", "A", 100)
         store.put("a", "A2", 200)               # replace, not accumulate
         assert store.bytes == 200 and len(store) == 1
-        assert store.pop("a") == "A2"
+        assert store.pop("a") == ("A2", 0)
 
 
 def test_slot_pool_lru_order_and_pinned_exemption():

@@ -83,7 +83,9 @@ def eprint(*args):
 
 def build_config(workdir: str, engines: int,
                  wire_backend: str = "evloop", *,
-                 autoscale_ceiling: int = 0) -> str:
+                 autoscale_ceiling: int = 0,
+                 spill_profile: bool = False,
+                 spill_control: bool = False) -> str:
     """The soak's config: tiny MLP serve workload, journaled-DQN
     learner with session-feed ingest, fast swap/telemetry cadences.
     All paths ABSOLUTE into the scratch dir (children run from the
@@ -145,6 +147,35 @@ def build_config(workdir: str, engines: int,
         # well above max_batch, the overflow sits in the ingress queue
         # where the telemetry poller (and so the autoscaler) sees it.
         cfg.serve.batch_timeout_ms = 50.0
+    if spill_profile:
+        # Kill-under-population profile (ISSUE 20): an episode model
+        # whose sessions carry REAL state (a per-session K/V carry the
+        # warm/spill tiers page), tiny slot + warm budgets so a modest
+        # session population overflows device -> RAM-warm -> disk, and
+        # a shared crash-consistent arena under the fleet dir. The
+        # CONTROL variant is byte-identical except the spill tier is
+        # off — state dies with the engine and every re-request after
+        # a kill cold-restarts through prefill.
+        cfg.learner.algo = "a2c"    # dqn is mlp-only; the policy net is
+        cfg.model.kind = "transformer"  # what matters here, not the algo
+        cfg.model.seq_mode = "episode"
+        cfg.model.num_layers = 2
+        cfg.model.num_heads = 2
+        cfg.model.head_dim = 8
+        cfg.model.hidden_dim = 32
+        cfg.serve.slots = 2
+        cfg.serve.max_batch = 2
+        import jax
+        from sharetrade_tpu.models import build_model
+        carry = build_model(cfg.model, OBS_DIM).init_carry()
+        nbytes = sum(int(leaf.size) * leaf.dtype.itemsize
+                     for leaf in jax.tree.leaves(carry))
+        # Room for ~2 carries RAM-warm per engine: the third park
+        # demotes the stalest carry to disk (or drops it, control).
+        cfg.serve.warm_bytes = int(2.5 * nbytes)
+        if not spill_control:
+            cfg.serve.spill_bytes = 64 << 20
+            cfg.serve.spill_dir = os.path.join(workdir, "fleet", "spill")
     path = os.path.join(workdir, "fleet_soak_config.json")
     cfg.save(path)
     return path
@@ -499,6 +530,270 @@ def run_soak(*, engines: int, kills: int, ramp_s: float,
             shutil.rmtree(workdir, ignore_errors=True)
 
 
+def run_spill_soak(*, engines: int = 2, sessions: int = 24,
+                   rounds: int = 3, control: bool = False,
+                   workdir: str | None = None, keep: bool = False,
+                   wire_backend: str = "evloop") -> dict:
+    """Kill-under-population profile (ISSUE 20): SIGKILL an engine
+    whose sessions straddle every tier of the paging hierarchy and
+    assert the spill arena turns the crash into WARM adoptions.
+
+    One serve-only fleet (episode model — real per-session carries),
+    slot + warm budgets tiny enough that a sequential round-robin
+    population pushes most carries onto the shared disk arena. Then:
+    census which engine owns each session (the router splices the
+    serving engine id into every 200) and which sessions have a sealed
+    arena record; corrupt ONE record of the victim's (bit flip in the
+    payload); SIGKILL the victim; sweep every one of its sessions once
+    and reconcile the fleet counters EXACTLY:
+
+    - ``fleet_adopt_warm_total``  == victim's spilled sessions - 1
+      (every sealed record adopts warm on a foreign incarnation...),
+    - ``fleet_spill_corrupt_total`` == 1 and the corrupted session's
+      request still COMPLETES (...except the flipped one, which the
+      CRC demotes to a cold restart — latency, never wrong bytes),
+    - ``fleet_adopt_cold_total``  == victim's in-memory sessions + 1
+      (slot/warm carries died with the process, plus the corrupt one),
+    - ``fleet_spill_stale_total`` == 0 (the router's session clock
+      matches every sealed stamp once traffic quiesces),
+    - majority-warm: warm adoptions strictly outnumber cold ones.
+
+    The SIGTERM drain then seals EVERY live carry (exit 75), so the
+    arena ends the run holding one record per session. ``control=True``
+    runs the identical scenario with the spill tier OFF — the latency
+    control for the BASELINE.md kill-recovery table. The sweep metric
+    is STATE-EQUIVALENT recovery per session (time until the session's
+    carry is back at pre-kill depth plus one fresh step): one warm
+    adoption with spill on; a full observation-history REPLAY through
+    prefill with it off — the recompute the arena exists to avoid. A
+    raw one-request comparison would flatter the control by silently
+    downgrading every recovered session to an empty carry."""
+    import numpy as np
+    from sharetrade_tpu.fleet.wire import FleetClient
+    from sharetrade_tpu.serve.spill import SPILL_SUFFIX, record_name
+    own_dir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="fleet_spill_")
+    cfg_path = build_config(workdir, engines, wire_backend,
+                            spill_profile=True, spill_control=control)
+    status_path = os.path.join(workdir, "fleet", "fleet_status.json")
+    arena_dir = os.path.join(workdir, "fleet", "spill")
+    log_path = os.path.join(workdir, "fleet.log")
+    profile = "spill-control" if control else "spill"
+    result: dict = {"profile": profile, "engines": engines,
+                    "sessions": sessions, "rounds": rounds,
+                    "workdir": workdir}
+    sids = [f"spill-{i:03d}" for i in range(sessions)]
+    rngs = {sid: np.random.default_rng(1000 + i)
+            for i, sid in enumerate(sids)}
+
+    def counters() -> dict:
+        return ((read_json(status_path) or {}).get("counters")) or {}
+
+    def sealed() -> set:
+        try:
+            return {f for f in os.listdir(arena_dir)
+                    if f.endswith(SPILL_SUFFIX)}
+        except OSError:
+            return set()
+
+    proc = launch_cli("fleet", cfg_path, log_path, symbol="MSFT",
+                      extra_args=["--engines", str(engines),
+                                  "--duration", "0"])
+    client = None
+    try:
+        ready = wait_ready(proc, log_path, timeout_s=240.0)
+        host, port = ready["host"], ready["port"]
+        eprint(f"[{profile}] fleet ready on {host}:{port} "
+               f"({ready['engines']}/{engines} engines, pid {proc.pid})")
+        if ready["engines"] != engines:
+            raise SoakError(
+                f"only {ready['engines']}/{engines} engines came up")
+        client = FleetClient(host, port, timeout_s=30.0)
+
+        def step(sid: str, obs) -> dict:
+            try:
+                return client.submit(sid, obs, timeout_s=30.0)
+            except Exception as exc:   # noqa: BLE001
+                raise SoakError(
+                    f"[{profile}] request for {sid} failed: {exc!r}")
+
+        # ---- populate: sequential round-robin over every session ----
+        # Sequential on purpose: each session's clock and its sealed
+        # stamp advance in lockstep with NOTHING in flight, so the
+        # post-kill reconciliation below can demand exact equality.
+        # Every obs is kept: the control's recovery path replays it.
+        census: dict[str, str] = {}
+        hist: dict[str, list] = {sid: [] for sid in sids}
+        for _ in range(rounds):
+            for sid in sids:
+                obs = rngs[sid].uniform(1.0, 2.0, OBS_DIM)
+                hist[sid].append(obs)
+                out = step(sid, obs)
+                census[sid] = out.get("engine", "?")
+        time.sleep(2.0)     # quiesce: trailing demotions + a poll pass
+
+        spilled_all = {sid for sid in sids
+                       if record_name(sid) in sealed()}
+        by_engine: dict[str, list[str]] = {}
+        for sid, eid in census.items():
+            by_engine.setdefault(eid, []).append(sid)
+        if not control:
+            # The shared-arena census gauges are LIVE on the status
+            # file (each engine scans the whole shared dir, so the
+            # fleet sum over-counts by the sharing factor — a load
+            # signal, not an exact census; >= is the honest bound).
+            gauges = ((read_json(status_path) or {}).get("gauges")) or {}
+            if gauges.get("fleet_spill_sessions", 0) < len(spilled_all):
+                raise SoakError(
+                    f"fleet_spill_sessions gauge "
+                    f"{gauges.get('fleet_spill_sessions')} < sealed "
+                    f"census {len(spilled_all)}")
+            if not gauges.get("fleet_spill_bytes", 0) > 0:
+                raise SoakError("fleet_spill_bytes gauge not live")
+        # Victim: the engine owning the most spilled sessions (most
+        # state to carry over); any engine in the control run.
+        victim_id = max(by_engine,
+                        key=lambda e: (len([s for s in by_engine[e]
+                                            if s in spilled_all]),
+                                       len(by_engine[e])))
+        v_sids = sorted(by_engine[victim_id])
+        v_spill = [s for s in v_sids if s in spilled_all]
+        v_mem = [s for s in v_sids if s not in spilled_all]
+        result["census"] = {
+            "victim": victim_id, "victim_sessions": len(v_sids),
+            "victim_spilled": len(v_spill),
+            "victim_memory": len(v_mem),
+            "sealed_total": len(spilled_all)}
+        eprint(f"[{profile}] census: victim {victim_id} holds "
+               f"{len(v_sids)} sessions ({len(v_spill)} sealed on disk, "
+               f"{len(v_mem)} in memory); arena holds "
+               f"{len(spilled_all)} records")
+        corrupted = None
+        if not control:
+            if len(v_spill) < 3:
+                raise SoakError(
+                    f"population too shallow: victim has only "
+                    f"{len(v_spill)} spilled sessions (need >= 3)")
+            # Bit-flip the PAYLOAD tail of one sealed record: the CRC
+            # must demote this session to a cold restart — injected
+            # corruption may cost latency, never wrong bytes.
+            corrupted = v_spill[0]
+            from soak_common import flip_byte
+            flip_byte(os.path.join(arena_dir, record_name(corrupted)),
+                      offset_frac=0.99)
+            eprint(f"[{profile}] corrupted the sealed record of "
+                   f"{corrupted}")
+
+        # ---- SIGKILL the victim, sweep its sessions once ------------
+        base = counters()
+        pids = live_engine_pids(status_path)
+        if victim_id not in pids:
+            raise SoakError(f"victim {victim_id} not alive in {pids}")
+        eprint(f"[{profile}] SIGKILL engine {victim_id} "
+               f"(pid {pids[victim_id]})")
+        os.kill(pids[victim_id], signal.SIGKILL)
+        # Per-session STATE-EQUIVALENT recovery: with spill on, one
+        # request adopts the sealed carry warm; with it off the carry
+        # died with the process and equivalence costs a full history
+        # replay through prefill. Both end one fresh step past the
+        # session's pre-kill depth.
+        sweep_ms: list[float] = []
+        for sid in v_sids:
+            nxt = rngs[sid].uniform(1.0, 2.0, OBS_DIM)
+            t0 = time.perf_counter()
+            if control:
+                for obs in hist[sid]:
+                    step(sid, obs)
+            out = step(sid, nxt)
+            sweep_ms.append((time.perf_counter() - t0) * 1e3)
+            if out.get("action") is None:
+                raise SoakError(
+                    f"[{profile}] post-kill sweep of {sid} returned "
+                    f"{out}")
+        sweep_sorted = sorted(sweep_ms)
+        result["recovery_p50_ms"] = round(
+            sweep_sorted[len(sweep_sorted) // 2], 2)
+        result["recovery_p99_ms"] = round(
+            sweep_sorted[min(len(sweep_sorted) - 1,
+                             int(0.99 * len(sweep_sorted)))], 2)
+        eprint(f"[{profile}] recovery sweep of {len(v_sids)} sessions: "
+               f"p50 {result['recovery_p50_ms']}ms "
+               f"p99 {result['recovery_p99_ms']}ms")
+
+        # ---- exact reconciliation -----------------------------------
+        if control:
+            expect = {"fleet_adopt_warm_total": 0,
+                      "fleet_adopt_cold_total": len(v_sids),
+                      "fleet_spill_corrupt_total": 0,
+                      "fleet_spill_stale_total": 0}
+        else:
+            expect = {"fleet_adopt_warm_total": len(v_spill) - 1,
+                      "fleet_adopt_cold_total": len(v_mem) + 1,
+                      "fleet_spill_corrupt_total": 1,
+                      "fleet_spill_stale_total": 0}
+
+        def deltas() -> dict:
+            cur = counters()
+            return {k: cur.get(k, 0) - base.get(k, 0) for k in expect}
+
+        wait_until(lambda: deltas() == expect, 30.0,
+                   desc=f"[{profile}] adoption counters reconcile")
+        time.sleep(1.0)     # stability: one more poll, still exact
+        got = deltas()
+        if got != expect:
+            raise SoakError(
+                f"[{profile}] adoption counters drifted after "
+                f"reconciling: {got} != {expect}")
+        result["recon"] = got
+        if not control:
+            warm, cold = got["fleet_adopt_warm_total"], \
+                got["fleet_adopt_cold_total"]
+            if not warm > cold:
+                raise SoakError(
+                    f"no warm majority: {warm} warm vs {cold} cold "
+                    "adoptions (the arena should carry most sessions)")
+            eprint(f"[{profile}] reconciled exactly: {warm} warm / "
+                   f"{cold} cold adoptions, 1 corrupt, 0 stale")
+        # Supervised recovery: exactly the one injected kill.
+        wait_until(
+            lambda: ((read_json(status_path) or {}).get("pool") or {})
+            .get("restarts_total", -1) == 1,
+            60.0, desc="restarts_total == 1")
+        wait_until(lambda: len(live_engine_pids(status_path)) == engines,
+                   120.0, desc="membership back to N")
+
+        # ---- drain: every live carry seals into the arena -----------
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+        if rc != 75:
+            raise SoakError(
+                f"fleet drain exited {rc}, want 75: {log_tail(proc)}")
+        result["drain_rc"] = rc
+        if not control:
+            missing = [sid for sid in sids
+                       if record_name(sid) not in sealed()]
+            if missing:
+                raise SoakError(
+                    f"drain page-out left {len(missing)} sessions "
+                    f"unsealed: {missing[:5]}")
+            result["arena_records_after_drain"] = len(sealed())
+            eprint(f"[{profile}] drain sealed every session: "
+                   f"{len(sealed())} records for {sessions} sessions")
+        result["ok"] = True
+        return result
+    finally:
+        if client is not None:
+            try:
+                client.close()
+            except Exception:   # noqa: BLE001
+                pass
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        if own_dir and not keep:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
 def run_autoscale_soak(*, ceiling: int = 2, sessions: int = 32,
                        concurrency: int = 16,
                        surge_budget_s: float = 120.0,
@@ -657,11 +952,52 @@ def main() -> int:
                         help="diurnal autoscale profile instead of the "
                              "kill-test: surge to the ceiling, quiet "
                              "back to the floor, zero restart storms")
+    parser.add_argument("--spill", action="store_true",
+                        help="kill-under-population profile: SIGKILL an "
+                             "engine whose sessions straddle the paging "
+                             "tiers, reconcile warm/cold adoptions "
+                             "exactly; the full (non-quick) run also "
+                             "measures the no-spill control")
+    parser.add_argument("--rounds", type=int, default=3,
+                        help="spill profile: population passes over the "
+                             "session list before the kill")
     parser.add_argument("--ceiling", type=int, default=2,
                         help="autoscale profile's membership ceiling")
     parser.add_argument("--keep", action="store_true",
                         help="keep the scratch dir for forensics")
     args = parser.parse_args()
+    if args.spill:
+        sessions = min(args.sessions, 24) if args.quick else args.sessions
+        rounds = min(args.rounds, 2) if args.quick else args.rounds
+        t0 = time.monotonic()
+        try:
+            result = run_spill_soak(engines=2, sessions=sessions,
+                                    rounds=rounds, keep=args.keep,
+                                    wire_backend=args.wire_backend)
+            if not args.quick:
+                # The no-spill control: identical scenario, arena off.
+                # Its sweep is all cold restarts — the latency baseline
+                # the BASELINE.md kill-recovery table compares against.
+                result["control"] = run_spill_soak(
+                    engines=2, sessions=sessions, rounds=rounds,
+                    control=True, keep=args.keep,
+                    wire_backend=args.wire_backend)
+                spill_p99 = result["recovery_p99_ms"]
+                ctrl_p99 = result["control"]["recovery_p99_ms"]
+                if not spill_p99 < ctrl_p99:
+                    raise SoakError(
+                        f"post-kill state-equivalent recovery p99 "
+                        f"{spill_p99}ms is not strictly better than "
+                        f"the no-spill control's {ctrl_p99}ms")
+        except SoakError as exc:
+            print(json.dumps({"ok": False, "error": str(exc)}),
+                  flush=True)
+            eprint(f"FLEET SPILL SOAK FAILED: {exc}")
+            return 1
+        result["elapsed_s"] = round(time.monotonic() - t0, 1)
+        print(json.dumps(result), flush=True)
+        eprint(f"fleet spill soak OK in {result['elapsed_s']}s")
+        return 0
     if args.autoscale:
         t0 = time.monotonic()
         try:

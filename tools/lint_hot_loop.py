@@ -225,7 +225,8 @@ DISPATCH_BLOCK_PATTERN = re.compile(
 
 #: Files whose os.replace calls publish DURABLE payloads (checkpoints,
 #: journal compactions) and therefore need fsync evidence in-function.
-DURABLE_WRITE_FILES = ("checkpoint/manager.py", "data/journal.py")
+DURABLE_WRITE_FILES = ("checkpoint/manager.py", "data/journal.py",
+                       "serve/spill.py")
 #: Evidence that a function fsyncs what its os.replace publishes: an ACTUAL
 #: CALL (matched in the AST, not a substring — a comment or an `if
 #: self.fsync:` gate with the real os.fsync deleted must not satisfy the
@@ -550,6 +551,31 @@ NATIVE_WIRE_MARKER = "native-wire-ok"
 NATIVE_WIRE_CC = TARGET.parent.parent.parent / "native" / "wire.cc"
 GIL_BEGIN = "Py_BEGIN_ALLOW_THREADS"
 GIL_END = "Py_END_ALLOW_THREADS"
+
+#: Check 19: the crash-consistent spill arena (serve/spill.py). (a)
+#: Arena record file I/O — the ``.spill`` suffix / ``SPILL_SUFFIX`` /
+#: ``record_name(`` — appears nowhere in ``sharetrade_tpu/`` outside
+#: SPILL_MODULE: a second reader/writer forks the record format away
+#: from the CRC/seal/consume-on-take contract the adoption tests pin;
+#: marker-exempt on the line or the two above (``spill-io-ok``). (b)
+#: Every SpillArena method that PUBLISHES a record (calls os.replace)
+#: must also CALL crc32 in the same method (AST call scan — a comment
+#: or a dead ``if self.checksum:`` gate cannot satisfy it); the seal
+#: half (fsync before the rename) rides check 5 via
+#: DURABLE_WRITE_FILES. (c) ``SpillArena.__init__`` builds no
+#: container: the record census lives on disk (os.scandir re-anchor),
+#: so an in-memory dict/set/list index would drift across engine
+#: incarnations sharing one arena and grow with session population;
+#: marker-exempt (``spill-index-ok``).
+SPILL_MODULE = "serve/spill.py"
+SPILL_IO_PATTERN = re.compile(
+    r"""['"]\.spill['"]|\bSPILL_SUFFIX\b|\brecord_name\s*\(""")
+SPILL_IO_MARKER = "spill-io-ok"
+SPILL_INDEX_MARKER = "spill-index-ok"
+SPILL_CLASS = "SpillArena"
+#: Container constructors that would anchor an arena census in memory.
+SPILL_CONTAINER_CALLS = {"dict", "set", "list", "OrderedDict",
+                        "defaultdict", "deque", "Counter"}
 
 
 def lint_hot_loop_syncs() -> tuple[list[tuple[str, int, str]], set[str]]:
@@ -996,6 +1022,112 @@ def lint_native_wire(
     return binding_bad, gil_bad, import_bad
 
 
+def _is_spill_container(val: ast.AST) -> bool:
+    """True for an expression that constructs a dict/set/list-family
+    container (literal, comprehension, or a bare constructor call)."""
+    if isinstance(val, (ast.Dict, ast.DictComp, ast.Set, ast.SetComp,
+                        ast.List, ast.ListComp)):
+        return True
+    if isinstance(val, ast.Call):
+        f = val.func
+        name = f.attr if isinstance(f, ast.Attribute) \
+            else getattr(f, "id", "")
+        return name in SPILL_CONTAINER_CALLS
+    return False
+
+
+def lint_spill_arena(
+        root: pathlib.Path | None = None,
+        spill_py: pathlib.Path | None = None) -> tuple[
+            list[tuple[str, int, str]], list[tuple[str, int, str]],
+            list[tuple[str, int, str]], set[str]]:
+    """Check 19: (a) arena record file I/O confined to SPILL_MODULE
+    (``spill-io-ok`` escape on the line or the two above); (b) every
+    SpillArena method publishing a record via os.replace also calls
+    crc32 — the fsync-before-rename seal itself is enforced by check 5
+    (SPILL_MODULE sits in DURABLE_WRITE_FILES); (c) SpillArena.__init__
+    keeps no in-memory container over arena records (``spill-index-ok``
+    escape). Returns ``(io_hits, crc_hits, index_hits, found class
+    names)``. ``root``/``spill_py`` override the scanned tree (tests
+    exercise the semantics on fixtures)."""
+    root = pathlib.Path(root) if root is not None else TARGET.parent.parent
+    spill_py = pathlib.Path(spill_py) if spill_py is not None \
+        else root / SPILL_MODULE
+    io_bad: list[tuple[str, int, str]] = []
+    for path in sorted(pathlib.Path(root).rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if rel == SPILL_MODULE or path == spill_py:
+            continue
+        lines = path.read_text().splitlines()
+        for ln, text in enumerate(lines, 1):
+            if text.lstrip().startswith("#"):
+                continue
+            if not SPILL_IO_PATTERN.search(text):
+                continue
+            window = lines[max(0, ln - 3):ln]
+            if any(SPILL_IO_MARKER in w for w in window):
+                continue
+            io_bad.append((rel, ln, text.strip()))
+    crc_bad: list[tuple[str, int, str]] = []
+    index_bad: list[tuple[str, int, str]] = []
+    found: set[str] = set()
+    if not spill_py.exists():
+        crc_bad.append((SPILL_MODULE, 0, "spill module is missing"))
+        return io_bad, crc_bad, index_bad, found
+    src = spill_py.read_text()
+    lines = src.splitlines()
+    publishers = 0
+    for cls in ast.walk(ast.parse(src)):
+        if not (isinstance(cls, ast.ClassDef) and cls.name == SPILL_CLASS):
+            continue
+        found.add(cls.name)
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            called: set[str] = set()
+            replaces = False
+            for child in ast.walk(fn):
+                if not isinstance(child, ast.Call):
+                    continue
+                f = child.func
+                called.add(f.attr if isinstance(f, ast.Attribute)
+                           else getattr(f, "id", None))
+                if (isinstance(f, ast.Attribute) and f.attr == "replace"
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "os"):
+                    replaces = True
+            if replaces:
+                publishers += 1
+                if "crc32" not in called:
+                    crc_bad.append(
+                        (SPILL_MODULE, fn.lineno,
+                         f"{fn.name}() publishes via os.replace without "
+                         "calling crc32"))
+            if fn.name != "__init__":
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    val = node.value
+                elif isinstance(node, ast.AnnAssign) \
+                        and node.value is not None:
+                    val = node.value
+                else:
+                    continue
+                if not _is_spill_container(val):
+                    continue
+                window = lines[max(0, node.lineno - 3):node.lineno]
+                if any(SPILL_INDEX_MARKER in w for w in window):
+                    continue
+                index_bad.append((SPILL_MODULE, node.lineno,
+                                  lines[node.lineno - 1].strip()))
+        if publishers == 0:
+            crc_bad.append(
+                (SPILL_MODULE, cls.lineno,
+                 f"{SPILL_CLASS} has no os.replace publish — record "
+                 "writes are not atomically sealed"))
+    return io_bad, crc_bad, index_bad, found
+
+
 def lint_dispatcher_blocking() -> tuple[list[tuple[str, int, str]], set[str]]:
     """Check 4: no unmarked blocking host calls in the dispatcher section;
     the consumer-side functions must still exist. Returns (hits, found
@@ -1369,6 +1501,47 @@ def main() -> int:
               "write_framed_bytes — or tag the line "
               f"'# {REPLACE_MARKER}: <why durability is not needed here>'")
         return 1
+    sp_io_bad, sp_crc_bad, sp_index_bad, sp_found = lint_spill_arena()
+    if SPILL_CLASS not in sp_found:
+        print(f"spill-arena lint: class {SPILL_CLASS} not found in "
+              f"sharetrade_tpu/{SPILL_MODULE} — the disk spill tier was "
+              "renamed; update tools/lint_hot_loop.py SPILL_CLASS/"
+              "SPILL_MODULE")
+        return 1
+    if sp_io_bad:
+        print("spill-arena record-I/O confinement lint FAILED:")
+        for rel, ln, text in sp_io_bad:
+            print(f"  sharetrade_tpu/{rel}:{ln}: {text}")
+        print("arena record files are read and written through "
+              f"sharetrade_tpu/{SPILL_MODULE} ONLY — a second site "
+              "touching .spill records forks the record format away "
+              "from the CRC/seal/consume-on-take contract the bitwise "
+              "adoption tests pin; go through SpillArena/sweep_debris, "
+              f"or tag the line (or the two above) '# {SPILL_IO_MARKER}: "
+              "<why this site must touch records directly>'")
+        return 1
+    if sp_crc_bad:
+        print("spill-arena record-integrity lint FAILED:")
+        for rel, ln, text in sp_crc_bad:
+            print(f"  sharetrade_tpu/{rel}:{ln}: {text}")
+        print("every spill record publish must stamp a crc32 over the "
+              "payload before the atomic os.replace seal — an adopting "
+              "engine decides warm-vs-cold from that checksum, and a "
+              "torn or bit-flipped record without one would replay "
+              "WRONG session state instead of demoting to a cold "
+              "restart (fsync-before-rename itself is check 5)")
+        return 1
+    if sp_index_bad:
+        print("spill-arena in-memory index lint FAILED:")
+        for rel, ln, text in sp_index_bad:
+            print(f"  sharetrade_tpu/{rel}:{ln}: {text}")
+        print("the arena keeps NO in-memory record index: the census "
+              "lives on disk (os.scandir re-anchor in scan_usage) so "
+              "that engine incarnations sharing one arena cannot drift "
+              "and memory cannot grow with session population; if the "
+              "container is not a record index, tag the line (or the "
+              f"two above) '# {SPILL_INDEX_MARKER}: <what bounds it>'")
+        return 1
     print(f"hot-loop sync lint OK ({', '.join(sorted(found))}); "
           f"parallel device_put lint OK; "
           f"device-code host-call lint OK ({', '.join(DEVICE_PACKAGES)}); "
@@ -1392,7 +1565,9 @@ def main() -> int:
           f"{', '.join(SERVE_PAGE_FUNCS)}); "
           f"native-wire lint OK ({NATIVE_WIRE_MODULE} seam, "
           f"GIL released in wire.cc); "
-          f"durable-rename fsync lint OK ({', '.join(DURABLE_WRITE_FILES)})")
+          f"durable-rename fsync lint OK ({', '.join(DURABLE_WRITE_FILES)}); "
+          f"spill-arena lint OK ({SPILL_MODULE} confinement, CRC'd + "
+          f"sealed records, disk-anchored census)")
     return 0
 
 
